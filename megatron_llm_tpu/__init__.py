@@ -1,0 +1,39 @@
+"""megatron_llm_tpu — a TPU-native LLM training framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of
+Megatron-LLM (the epfLLM fork of NVIDIA Megatron-LM): pretraining,
+finetuning and instruct-tuning of Llama 1/2, Code Llama, Falcon, Mistral
+and GPT-2-style models with 3-way parallelism (TP x PP x DP), Megatron-style
+sequence parallelism, a ZeRO-1 distributed optimizer, checkpointing with
+HF interchange, and an inference/serving stack.
+
+Design stance (TPU-first, not a port):
+
+- One ``jax.sharding.Mesh`` with axes ``('dp', 'pp', 'tp')`` replaces
+  the reference's NCCL process groups (reference:
+  ``megatron/core/parallel_state.py``).
+- Tensor parallelism is expressed with sharding specs; XLA/GSPMD inserts
+  ``all-reduce`` / ``all-gather`` / ``reduce-scatter`` over ICI where the
+  reference calls collectives by hand
+  (reference: ``megatron/core/tensor_parallel/layers.py``).
+- The pipeline engine is a compiled loop (``lax.scan`` [+ remat]) with
+  ``lax.ppermute`` between stages, instead of imperative Python with
+  batched NCCL isend/irecv (reference: ``megatron/schedules.py``,
+  ``megatron/p2p_communication.py``).
+- Hot device kernels (flash attention with causal/sliding-window/GQA,
+  fused RMSNorm, scaled-masked-softmax) are Pallas Mosaic-TPU kernels
+  where the reference has CUDA (reference: ``megatron/fused_kernels/``).
+- Host-side native code (dataset index building) is C++ like the
+  reference's ``megatron/data/helpers.cpp``, bound via ctypes.
+"""
+
+__version__ = "0.1.0"
+
+from megatron_llm_tpu.global_vars import (  # noqa: F401
+    get_args,
+    get_timers,
+    get_tokenizer,
+    get_counters,
+    get_num_microbatches,
+    update_num_microbatches,
+)

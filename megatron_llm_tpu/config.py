@@ -1,0 +1,236 @@
+"""Model / parallelism configuration.
+
+The reference spreads configuration over a 225-flag argparse namespace
+(``megatron/arguments.py``) consumed through a global singleton.  Here the
+model-shape portion is a frozen, hashable dataclass so it can be a static
+argument to ``jax.jit`` — everything the compiled step function needs to
+specialise on lives here.  The argparse-compatible CLI surface lives in
+``megatron_llm_tpu/arguments.py`` and is *lowered* into this dataclass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+class PositionEmbeddingType(str, Enum):
+    # reference: megatron/model/enums.py:20-23
+    rotary = "rotary"
+    learned_absolute = "learned_absolute"
+
+
+class AttnMaskType(str, Enum):
+    # reference: megatron/model/enums.py (padding/causal)
+    padding = "padding"
+    causal = "causal"
+
+
+DTYPES = {
+    "fp32": jnp.float32,
+    "fp16": jnp.float16,
+    "bf16": jnp.bfloat16,
+}
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Mesh shape + parallelism behaviour.
+
+    Replaces the process-group bookkeeping of
+    ``megatron/core/parallel_state.py:51-205``: on TPU the entire fabric is
+    one ``Mesh(devices, ('dp', 'pp', 'tp'))`` and these sizes are the axis
+    lengths.
+    """
+
+    tensor_model_parallel_size: int = 1
+    pipeline_model_parallel_size: int = 1
+    data_parallel_size: int = 1
+    # reference: --num_layers_per_virtual_pipeline_stage (arguments.py:121-132)
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+    # Megatron-style sequence parallelism (activation sharding along the
+    # sequence axis in non-TP regions).  reference: arguments.py:698.
+    sequence_parallel: bool = False
+    # ZeRO-1: shard optimizer state over the dp axis.
+    # reference: --use_distributed_optimizer (distrib_optimizer.py)
+    use_distributed_optimizer: bool = False
+    # Expert parallelism size (MoE). The reference has no MoE; we support it
+    # as a TPU-native extension (axis folded into dp during non-MoE ops).
+    expert_model_parallel_size: int = 1
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.tensor_model_parallel_size
+            * self.pipeline_model_parallel_size
+            * self.data_parallel_size
+        )
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    """Architecture hyper-parameters.
+
+    Field names mirror the reference flags (``megatron/arguments.py``) so the
+    CLI and checkpoint-args machinery map 1:1.
+    """
+
+    num_layers: int = 2
+    hidden_size: int = 128
+    num_attention_heads: int = 4
+    # GQA/MQA: number of KV heads (reference: --num_attention_heads_kv,
+    # packed QKV layout at megatron/model/transformer.py:334-365,458-465).
+    num_attention_heads_kv: Optional[int] = None
+    ffn_hidden_size: Optional[int] = None
+    kv_channels: Optional[int] = None
+    seq_length: int = 512
+    max_position_embeddings: Optional[int] = None
+    padded_vocab_size: int = 50304
+
+    # --- embeddings / head ---
+    position_embedding_type: PositionEmbeddingType = PositionEmbeddingType.learned_absolute
+    # RoPE position-interpolation context extension
+    # (reference: megatron/model/positional_embeddings.py:7-14, --rope_scaling_factor)
+    rope_scaling_factor: float = 1.0
+    rope_theta: float = 10000.0
+    # reference: --no_tie_embed_logits -> untied lm_head
+    # (megatron/model/language_model.py:436-457)
+    tie_embed_logits: bool = True
+
+    # --- norm / activation / structure ---
+    # 'layernorm' | 'rmsnorm'  (reference: megatron/model/fused_layer_norm.py)
+    normalization: str = "layernorm"
+    layernorm_epsilon: float = 1e-5
+    # post-LN (original transformer) vs pre-LN
+    # (reference: --use_post_ln, transformer.py:660-664)
+    use_post_ln: bool = False
+    # GLU family: None | 'swiglu' | 'geglu' | 'reglu' | 'liglu'
+    # (reference: megatron/model/glu_activations.py:8-49)
+    glu_activation: Optional[str] = None
+    # bias toggles (reference: --use_bias / --no_bias in arguments.py)
+    add_bias_linear: bool = True
+    # Falcon-style parallel attention+MLP (reference: transformer.py:635-664)
+    parallel_attn: bool = False
+    # Falcon-40B parallel layernorm (reference: transformer.py:804-845)
+    parallel_layernorm: bool = False
+    # Mistral sliding-window attention (reference: transformer.py:528-537)
+    sliding_window_size: Optional[int] = None
+
+    # --- dropout / init ---
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    init_method_std: float = 0.02
+    # divide output-layer init by sqrt(2*num_layers)
+    # (reference: --init_method_xavier_uniform absent; scaled init in layers)
+    use_scaled_init_method: bool = True
+
+    # --- dtypes ---
+    params_dtype: str = "fp32"          # storage dtype of the trained params
+    compute_dtype: str = "fp32"         # activation/computation dtype
+    softmax_in_fp32: bool = True        # attention-softmax accumulation dtype
+    # upcast LN/RMSNorm compute to fp32 (reference rmsnorm does fp32 compute,
+    # fused_layer_norm.py:125-139)
+    norm_in_fp32: bool = True
+
+    # --- attention numerics ---
+    attn_mask_type: AttnMaskType = AttnMaskType.causal
+    apply_query_key_layer_scaling: bool = False
+    attention_softmax_in_fp32: bool = True
+    # divide qk^T by sqrt(head_dim) (standard)
+    use_flash_attn: bool = True         # Pallas flash-attention kernel
+    use_fused_rmsnorm: bool = True      # Pallas fused RMSNorm kernel
+
+    # --- recompute (reference: transformer.py:1110-1176) ---
+    # None | 'uniform' | 'block' | 'selective'
+    recompute_granularity: Optional[str] = None
+    recompute_num_layers: int = 1
+
+    # --- lima dropout (reference: --lima_dropout, transformer.py) ---
+    lima_dropout: bool = False
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
+        if self.kv_channels is None:
+            object.__setattr__(
+                self, "kv_channels", self.hidden_size // self.num_attention_heads
+            )
+        if self.num_attention_heads_kv is None:
+            object.__setattr__(
+                self, "num_attention_heads_kv", self.num_attention_heads
+            )
+        if self.max_position_embeddings is None:
+            object.__setattr__(self, "max_position_embeddings", self.seq_length)
+        if isinstance(self.position_embedding_type, str):
+            object.__setattr__(
+                self,
+                "position_embedding_type",
+                PositionEmbeddingType(self.position_embedding_type),
+            )
+
+    # convenience ------------------------------------------------------
+    @property
+    def head_dim(self) -> int:
+        return self.kv_channels
+
+    @property
+    def num_query_groups(self) -> int:
+        return self.num_attention_heads_kv
+
+    @property
+    def params_jnp_dtype(self):
+        return DTYPES[self.params_dtype]
+
+    @property
+    def compute_jnp_dtype(self):
+        return DTYPES[self.compute_dtype]
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Optimization / schedule configuration (reference: _add_training_args,
+    _add_learning_rate_args, _add_mixed_precision_args in arguments.py)."""
+
+    micro_batch_size: int = 1
+    global_batch_size: int = 1
+    rampup_batch_size: Optional[Tuple[int, int, int]] = None  # (start, incr, samples)
+    train_iters: int = 0
+    # optimizer
+    optimizer: str = "adam"             # 'adam' | 'sgd'
+    lr: float = 1e-4
+    min_lr: float = 0.0
+    lr_decay_style: str = "linear"      # constant|linear|cosine|inverse-square-root
+    lr_decay_iters: Optional[int] = None
+    lr_warmup_iters: int = 0
+    lr_warmup_fraction: Optional[float] = None
+    weight_decay: float = 0.01
+    start_weight_decay: Optional[float] = None
+    end_weight_decay: Optional[float] = None
+    weight_decay_incr_style: str = "constant"
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+    sgd_momentum: float = 0.9
+    clip_grad: float = 1.0
+    # mixed precision
+    fp16: bool = False
+    bf16: bool = False
+    loss_scale: Optional[float] = None          # static scale; None -> dynamic
+    initial_loss_scale: float = 2.0 ** 32
+    min_loss_scale: float = 1.0
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    # misc
+    seed: int = 1234
+    data_parallel_random_init: bool = False
+
+    @property
+    def grad_accum_steps_fn(self):
+        return None

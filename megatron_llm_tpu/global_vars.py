@@ -1,0 +1,111 @@
+"""Global singletons (args, timers, tokenizer, counters, microbatch calculator).
+
+Mirrors the accessor surface of ``megatron/global_vars.py:24-105`` so entry
+points written against the reference API carry over.  Internally the
+framework is functional — these globals only hold *host-side* objects
+(parsed args, timers, tokenizer); no device state lives here.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Optional
+
+_GLOBAL_ARGS: Optional[Any] = None
+_GLOBAL_TOKENIZER: Optional[Any] = None
+_GLOBAL_TIMERS: Optional[Any] = None
+_GLOBAL_TENSORBOARD_WRITER: Optional[Any] = None
+_GLOBAL_WANDB_LOGGER: Optional[Any] = None
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR: Optional[Any] = None
+# token/sample counters (reference: global_vars.py counters dict used by
+# finetune.py:129-140 for tokens/sec)
+_GLOBAL_COUNTERS: "defaultdict[str, int]" = defaultdict(int)
+
+
+def _ensure(var, name):
+    if var is None:
+        raise RuntimeError(f"{name} is not initialized")
+    return var
+
+
+def get_args():
+    return _ensure(_GLOBAL_ARGS, "args")
+
+
+def set_args(args) -> None:
+    global _GLOBAL_ARGS
+    _GLOBAL_ARGS = args
+
+
+def get_tokenizer():
+    return _ensure(_GLOBAL_TOKENIZER, "tokenizer")
+
+
+def set_tokenizer(tok) -> None:
+    global _GLOBAL_TOKENIZER
+    _GLOBAL_TOKENIZER = tok
+
+
+def get_timers():
+    return _ensure(_GLOBAL_TIMERS, "timers")
+
+
+def set_timers(timers) -> None:
+    global _GLOBAL_TIMERS
+    _GLOBAL_TIMERS = timers
+
+
+def get_counters():
+    return _GLOBAL_COUNTERS
+
+
+def reset_counters() -> None:
+    _GLOBAL_COUNTERS.clear()
+
+
+def get_tensorboard_writer():
+    return _GLOBAL_TENSORBOARD_WRITER
+
+
+def set_tensorboard_writer(writer) -> None:
+    global _GLOBAL_TENSORBOARD_WRITER
+    _GLOBAL_TENSORBOARD_WRITER = writer
+
+
+def get_wandb_logger():
+    return _GLOBAL_WANDB_LOGGER
+
+
+def set_wandb_logger(logger) -> None:
+    global _GLOBAL_WANDB_LOGGER
+    _GLOBAL_WANDB_LOGGER = logger
+
+
+def get_num_microbatches_calculator():
+    return _ensure(_GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num-microbatches calculator")
+
+
+def set_num_microbatches_calculator(calc) -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = calc
+
+
+def get_num_microbatches() -> int:
+    return get_num_microbatches_calculator().get()
+
+
+def get_current_global_batch_size() -> int:
+    return get_num_microbatches_calculator().get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int, consistency_check: bool = True):
+    get_num_microbatches_calculator().update(consumed_samples, consistency_check)
+
+
+def set_global_variables(args, tokenizer=None, timers=None) -> None:
+    """Reference: global_vars.py:89 ``set_global_variables``."""
+    set_args(args)
+    if tokenizer is not None:
+        set_tokenizer(tokenizer)
+    if timers is not None:
+        set_timers(timers)

@@ -1,0 +1,122 @@
+"""Microbatch / global-batch management.
+
+Reference: ``megatron/microbatches.py:9-144`` — a constant calculator and a
+linear ramp-up calculator; ``update_num_microbatches`` is called every
+iteration from the train loop (training.py:682).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional, Sequence
+
+
+def build_num_microbatches_calculator(
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+    rampup_batch_size: Optional[Sequence[int]] = None,
+):
+    if rampup_batch_size is None:
+        return ConstantNumMicroBatches(
+            global_batch_size, micro_batch_size, data_parallel_size
+        )
+    if len(rampup_batch_size) != 3:
+        raise ValueError(
+            "rampup_batch_size must be (start_batch, increment, ramp_samples)"
+        )
+    start, incr, samples = (int(v) for v in rampup_batch_size)
+    return RampupBatchsizeNumMicroBatches(
+        start, incr, samples, global_batch_size, micro_batch_size, data_parallel_size
+    )
+
+
+class NumMicroBatchesCalculator(ABC):
+    def __init__(self):
+        self.num_micro_batches: Optional[int] = None
+        self.current_global_batch_size: Optional[int] = None
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    @abstractmethod
+    def update(self, consumed_samples: int, consistency_check: bool) -> None:
+        ...
+
+
+class ConstantNumMicroBatches(NumMicroBatchesCalculator):
+    # reference: microbatches.py:41-61
+    def __init__(self, global_batch_size, micro_batch_size, data_parallel_size):
+        super().__init__()
+        micro_batch_times_dp = micro_batch_size * data_parallel_size
+        if global_batch_size % micro_batch_times_dp != 0:
+            raise ValueError(
+                f"global batch size ({global_batch_size}) is not divisible by "
+                f"micro batch size ({micro_batch_size}) x data parallel size "
+                f"({data_parallel_size})"
+            )
+        self.num_micro_batches = global_batch_size // micro_batch_times_dp
+        self.current_global_batch_size = global_batch_size
+
+    def update(self, consumed_samples, consistency_check):
+        pass
+
+
+class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
+    # reference: microbatches.py:64-144
+    def __init__(
+        self,
+        start_batch_size,
+        batch_size_increment,
+        ramup_samples,
+        global_batch_size,
+        micro_batch_size,
+        data_parallel_size,
+    ):
+        super().__init__()
+        assert global_batch_size > 0 and start_batch_size > 0
+        assert batch_size_increment > 0 and ramup_samples >= 0
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size
+        )
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+        diff = global_batch_size - start_batch_size
+        assert diff >= 0 and diff % batch_size_increment == 0
+        num_increments = diff // batch_size_increment
+        self.rampup_samples_per_increment = (
+            self.ramup_samples / num_increments if num_increments > 0 else 0
+        )
+        self.update(0, False)
+
+    def update(self, consumed_samples, consistency_check):
+        if consumed_samples > self.ramup_samples:
+            self.current_global_batch_size = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            self.current_global_batch_size = (
+                self.start_batch_size + steps * self.batch_size_increment
+            )
+            assert self.current_global_batch_size <= self.global_batch_size
+        if consistency_check:
+            assert (
+                self.current_global_batch_size
+                % self.micro_batch_times_data_parallel_size
+                == 0
+            ), (
+                "current global batch size "
+                f"({self.current_global_batch_size}) is not divisible by "
+                "micro-batch-size x data-parallel-size "
+                f"({self.micro_batch_times_data_parallel_size})"
+            )
+        self.num_micro_batches = (
+            self.current_global_batch_size
+            // self.micro_batch_times_data_parallel_size
+        )

@@ -1,0 +1,20 @@
+"""Model library: transformer core + architecture wrappers.
+
+Reference: ``megatron/model/`` — ``ParallelTransformer`` and friends plus
+GPT/Llama/Falcon/Mistral wrapper classes that assert architecture flags.
+"""
+
+from megatron_llm_tpu.models.gpt import GPTModel
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+from megatron_llm_tpu.models.falcon import FalconModel, falcon_config
+from megatron_llm_tpu.models.mistral import MistralModel, mistral_config
+from megatron_llm_tpu.models.gpt2 import gpt2_config
+
+MODEL_REGISTRY = {
+    "gpt": GPTModel,
+    "llama": LlamaModel,
+    "llama2": LlamaModel,
+    "codellama": LlamaModel,
+    "falcon": FalconModel,
+    "mistral": MistralModel,
+}

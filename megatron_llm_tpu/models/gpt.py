@@ -1,0 +1,74 @@
+"""GPT-style decoder-only model wrapper.
+
+Reference: ``megatron/model/gpt_model.py`` — ``GPTModel`` wraps the
+language model and ``post_language_model_processing`` (:21-41) turns
+logits into the vocab-parallel CE loss (per-token; masking/averaging is the
+entry point's loss_func, finetune.py:201-218).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import TransformerConfig
+from megatron_llm_tpu.models.language_model import (
+    init_language_model_params,
+    language_model_forward,
+    language_model_param_specs,
+    flops_per_token,
+)
+from megatron_llm_tpu.ops.cross_entropy import vocab_parallel_cross_entropy
+
+
+class GPTModel:
+    """Functional model: holds only the (hashable) config; params live in a
+    pytree owned by the caller."""
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+
+    # -- params ------------------------------------------------------------
+    def init(self, key) -> dict:
+        return init_language_model_params(key, self.cfg)
+
+    def param_specs(self, params) -> dict:
+        return language_model_param_specs(params, self.cfg)
+
+    def num_params(self, params) -> int:
+        return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+    def flops_per_token(self, seq_len=None) -> float:
+        return flops_per_token(self.cfg, seq_len)
+
+    # -- forward -----------------------------------------------------------
+    def __call__(
+        self,
+        params,
+        tokens: jax.Array,
+        position_ids: Optional[jax.Array] = None,
+        attention_mask: Optional[jax.Array] = None,
+        labels: Optional[jax.Array] = None,
+        *,
+        rng_key=None,
+        train: bool = False,
+        sequence_parallel: bool = False,
+        kv_caches=None,
+    ):
+        """Returns per-token loss [b, s] when labels given, else logits
+        [b, s, V] (reference: gpt_model.py:82-100)."""
+        out = language_model_forward(
+            params, tokens, position_ids, attention_mask, self.cfg,
+            rng_key=rng_key, train=train, sequence_parallel=sequence_parallel,
+            kv_caches=kv_caches,
+        )
+        if kv_caches is not None:
+            logits, new_caches = out
+        else:
+            logits, new_caches = out, None
+        if labels is None:
+            return (logits, new_caches) if kv_caches is not None else logits
+        loss = vocab_parallel_cross_entropy(logits.astype(jnp.float32), labels)
+        return (loss, new_caches) if kv_caches is not None else loss

@@ -1,0 +1,236 @@
+"""Embedding + transformer + LM head.
+
+Reference: ``megatron/model/language_model.py`` — ``Embedding`` (:163-262,
+vocab-parallel word embedding + optional learned absolute position
+embedding + embedding dropout with the sequence-parallel scatter at
+:255-258), ``TransformerLanguageModel`` (:488+), ``parallel_lm_logits``
+(:24-53), untied lm_head (:436-457), and the per-forward FLOP estimate
+(:370-384) used for MFU accounting.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import TransformerConfig, PositionEmbeddingType
+from megatron_llm_tpu.parallel.layers import (
+    init_embedding_params,
+    init_method_normal,
+    parallel_lm_logits,
+    vocab_parallel_embedding,
+)
+from megatron_llm_tpu.parallel.sharding import constrain
+from megatron_llm_tpu.models.transformer import (
+    init_stack_params,
+    rotary_freqs,
+    transformer_stack,
+)
+from megatron_llm_tpu import random as mrandom
+
+
+def init_language_model_params(key, cfg: TransformerConfig, dtype=None):
+    """Param pytree:
+
+    {
+      'embedding': {'word': {'embedding': [V, H]},
+                    'position'?: {'embedding': [P, H]}},
+      'transformer': {'layers': {...stacked [L, ...]}, 'final_norm': {...}},
+      'lm_head'?: {'weight': [V, H]}   (when not tie_embed_logits)
+    }
+    """
+    dtype = dtype or cfg.params_jnp_dtype
+    k_emb, k_pos, k_stack, k_head = jax.random.split(key, 4)
+    init = init_method_normal(cfg.init_method_std)
+    params = {
+        "embedding": {
+            "word": init_embedding_params(
+                k_emb, cfg.padded_vocab_size, cfg.hidden_size,
+                init_method=init, dtype=dtype,
+            )
+        },
+        "transformer": init_stack_params(k_stack, cfg, dtype),
+    }
+    if cfg.position_embedding_type == PositionEmbeddingType.learned_absolute:
+        params["embedding"]["position"] = init_embedding_params(
+            k_pos, cfg.max_position_embeddings, cfg.hidden_size,
+            init_method=init, dtype=dtype,
+        )
+    if not cfg.tie_embed_logits:
+        # untied lm_head parameter (reference: language_model.py:436-457)
+        params["lm_head"] = {
+            "weight": init(k_head, (cfg.padded_vocab_size, cfg.hidden_size), dtype)
+        }
+    return params
+
+
+def language_model_param_specs(params, cfg: TransformerConfig):
+    """Logical-axis spec pytree matching ``init_language_model_params``
+    (consumed by ``parallel.sharding.shard_params``)."""
+
+    def linear_spec(p, in_ax, out_ax, stacked):
+        lead = ("stage",) if stacked else ()
+        spec = {"kernel": lead + (in_ax, out_ax)}
+        if "bias" in p:
+            spec["bias"] = lead + (out_ax,)
+        return spec
+
+    def norm_spec(p, stacked):
+        lead = ("stage",) if stacked else ()
+        return {k: lead + (None,) for k in p}
+
+    layers = params["transformer"]["layers"]
+    layer_specs = {
+        "input_norm": norm_spec(layers["input_norm"], True),
+        "attention": {
+            "query_key_value": linear_spec(
+                layers["attention"]["query_key_value"], None, "heads", True
+            ),
+            "dense": linear_spec(layers["attention"]["dense"], "heads", None, True),
+        },
+        "mlp": {
+            "dense_h_to_4h": linear_spec(
+                layers["mlp"]["dense_h_to_4h"], None, "ffn", True
+            ),
+            "dense_4h_to_h": linear_spec(
+                layers["mlp"]["dense_4h_to_h"], "ffn", None, True
+            ),
+        },
+    }
+    if "post_attention_norm" in layers:
+        layer_specs["post_attention_norm"] = norm_spec(
+            layers["post_attention_norm"], True
+        )
+    if "mlp_norm" in layers:
+        layer_specs["mlp_norm"] = norm_spec(layers["mlp_norm"], True)
+
+    specs = {
+        "embedding": {"word": {"embedding": ("vocab", None)}},
+        "transformer": {
+            "layers": layer_specs,
+            "final_norm": norm_spec(params["transformer"]["final_norm"], False),
+        },
+    }
+    if "position" in params["embedding"]:
+        specs["embedding"]["position"] = {"embedding": (None, None)}
+    if "lm_head" in params:
+        specs["lm_head"] = {"weight": ("vocab", None)}
+    return specs
+
+
+def embedding_forward(
+    tokens: jax.Array,
+    position_ids: Optional[jax.Array],
+    params,
+    cfg: TransformerConfig,
+    *,
+    rng_key=None,
+    train: bool = False,
+) -> jax.Array:
+    """Word (+position) embedding with dropout; under sequence parallelism
+    the output is scattered along the sequence axis
+    (reference: language_model.py:230-262)."""
+    h = vocab_parallel_embedding(
+        tokens, params["word"], compute_dtype=cfg.compute_jnp_dtype
+    )
+    if "position" in params:
+        if position_ids is None:
+            position_ids = jnp.arange(tokens.shape[1])[None, :]
+        pos = jnp.take(
+            params["position"]["embedding"].astype(cfg.compute_jnp_dtype),
+            position_ids, axis=0,
+        )
+        h = h + pos
+    if train and cfg.hidden_dropout > 0.0 and rng_key is not None:
+        keep = jax.random.bernoulli(rng_key, 1.0 - cfg.hidden_dropout, h.shape)
+        h = h * keep.astype(h.dtype) / (1.0 - cfg.hidden_dropout)
+    return h
+
+
+def language_model_forward(
+    params,
+    tokens: jax.Array,
+    position_ids: Optional[jax.Array],
+    attention_mask: Optional[jax.Array],
+    cfg: TransformerConfig,
+    *,
+    rng_key=None,
+    train: bool = False,
+    sequence_parallel: bool = False,
+    compute_logits: bool = True,
+    kv_caches=None,
+    freqs=None,
+):
+    """Full LM forward -> logits [b, s, V] (vocab-sharded under tp) or the
+    final hidden states when ``compute_logits=False``.
+
+    Reference: TransformerLanguageModel.forward (language_model.py:488+)
+    -> GPTModel.post_language_model_processing (gpt_model.py:21-41).
+    """
+    if rng_key is not None:
+        k_embed, k_stack = jax.random.split(rng_key)
+    else:
+        k_embed = k_stack = None
+    h = embedding_forward(
+        tokens, position_ids, params["embedding"], cfg, rng_key=k_embed, train=train
+    )
+    if sequence_parallel:
+        h = constrain(h, "batch", "seq_tp", None)
+    if freqs is None:
+        freqs = rotary_freqs(cfg, seq_len=None)
+
+    if kv_caches is not None:
+        h, new_caches = transformer_stack(
+            h, params["transformer"], cfg,
+            freqs=freqs, attention_mask=attention_mask, position_ids=position_ids,
+            rng_key=None, train=False, sequence_parallel=sequence_parallel,
+            kv_caches=kv_caches,
+        )
+    else:
+        h = transformer_stack(
+            h, params["transformer"], cfg,
+            freqs=freqs, attention_mask=attention_mask, position_ids=position_ids,
+            rng_key=k_stack, train=train, sequence_parallel=sequence_parallel,
+        )
+        new_caches = None
+
+    if not compute_logits:
+        return (h, new_caches) if kv_caches is not None else h
+
+    head = (
+        params["lm_head"]["weight"]
+        if "lm_head" in params
+        else params["embedding"]["word"]["embedding"]
+    )
+    logits = parallel_lm_logits(
+        h, head,
+        sequence_parallel=sequence_parallel,
+        compute_dtype=cfg.compute_jnp_dtype,
+    )
+    if kv_caches is not None:
+        return logits, new_caches
+    return logits
+
+
+def flops_per_token(cfg: TransformerConfig, seq_len: Optional[int] = None) -> float:
+    """Per-token fwd+bwd FLOPs for MFU accounting (reference FLOP estimate:
+    language_model.py:370-384; 6ND approximation + attention term)."""
+    s = seq_len or cfg.seq_length
+    h = cfg.hidden_size
+    L = cfg.num_layers
+    ffn = cfg.ffn_hidden_size
+    ng = cfg.num_query_groups
+    nh = cfg.num_attention_heads
+    d = cfg.head_dim
+    mult = 2 if cfg.glu_activation else 1
+    # per layer matmul params: qkv + out proj + mlp
+    qkv = h * (nh + 2 * ng) * d
+    proj = nh * d * h
+    mlp_p = h * ffn * mult + ffn * h
+    dense = L * (qkv + proj + mlp_p)
+    emb = cfg.padded_vocab_size * h
+    # fwd = 2 flops/param/token, bwd = 4, attention = 2*2*s*nh*d per layer fwd
+    attn = L * 2 * 2 * s * nh * d
+    return 6.0 * (dense + emb) + 3.0 * attn

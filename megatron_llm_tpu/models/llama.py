@@ -1,0 +1,56 @@
+"""Llama 1/2 / Code Llama wrapper.
+
+Reference: ``megatron/model/llama_model.py:22-31`` — a GPTModel subclass
+that *asserts* the architecture flags (rotary, swiglu, RMSNorm, no bias,
+untied embeddings, no parallel attention).
+"""
+
+from __future__ import annotations
+
+from megatron_llm_tpu.config import TransformerConfig, PositionEmbeddingType
+from megatron_llm_tpu.models.gpt import GPTModel
+
+
+class LlamaModel(GPTModel):
+    def __init__(self, cfg: TransformerConfig):
+        # reference asserts (llama_model.py:22-31)
+        assert cfg.position_embedding_type == PositionEmbeddingType.rotary, \
+            "llama requires rotary position embeddings"
+        assert cfg.glu_activation == "swiglu", "llama requires swiglu"
+        assert cfg.normalization == "rmsnorm", "llama requires RMSNorm"
+        assert not cfg.add_bias_linear, "llama has no linear biases"
+        assert not cfg.tie_embed_logits, "llama does not tie embeddings with logits"
+        assert not cfg.parallel_attn, "llama uses sequential attn/mlp"
+        assert not cfg.use_post_ln, "llama is pre-LN"
+        super().__init__(cfg)
+
+
+def llama_config(size: str = "7B", **overrides) -> TransformerConfig:
+    """Llama-2 family shapes (reference: weights_conversion tables +
+    examples/finetune.sh LLAMA_ARGS)."""
+    shapes = {
+        "tiny": dict(num_layers=2, hidden_size=128, num_attention_heads=4,
+                     ffn_hidden_size=352, padded_vocab_size=32000),
+        "7B": dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+                   ffn_hidden_size=11008, padded_vocab_size=32000),
+        "13B": dict(num_layers=40, hidden_size=5120, num_attention_heads=40,
+                    ffn_hidden_size=13824, padded_vocab_size=32000),
+        "70B": dict(num_layers=80, hidden_size=8192, num_attention_heads=64,
+                    num_attention_heads_kv=8, ffn_hidden_size=28672,
+                    padded_vocab_size=32000),
+    }
+    base = dict(
+        position_embedding_type=PositionEmbeddingType.rotary,
+        glu_activation="swiglu",
+        normalization="rmsnorm",
+        add_bias_linear=False,
+        tie_embed_logits=False,
+        layernorm_epsilon=1e-5,
+        seq_length=4096,
+        max_position_embeddings=4096,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+    )
+    base.update(shapes[size])
+    base.update(overrides)
+    return TransformerConfig(**base)

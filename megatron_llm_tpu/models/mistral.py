@@ -1,0 +1,50 @@
+"""Mistral wrapper.
+
+Reference: ``megatron/model/mistral_model.py:22-34`` — asserts llama-style
+flags plus ``sliding_window_size == 4096``.
+"""
+
+from __future__ import annotations
+
+from megatron_llm_tpu.config import TransformerConfig, PositionEmbeddingType
+from megatron_llm_tpu.models.gpt import GPTModel
+
+
+class MistralModel(GPTModel):
+    def __init__(self, cfg: TransformerConfig):
+        # reference asserts (mistral_model.py:22-34)
+        assert cfg.position_embedding_type == PositionEmbeddingType.rotary
+        assert cfg.glu_activation == "swiglu"
+        assert cfg.normalization == "rmsnorm"
+        assert not cfg.add_bias_linear
+        assert not cfg.tie_embed_logits
+        assert cfg.sliding_window_size == 4096, \
+            "mistral uses a 4096 sliding attention window"
+        super().__init__(cfg)
+
+
+def mistral_config(size: str = "7B", **overrides) -> TransformerConfig:
+    shapes = {
+        "tiny": dict(num_layers=2, hidden_size=128, num_attention_heads=4,
+                     num_attention_heads_kv=2, ffn_hidden_size=352,
+                     padded_vocab_size=32000),
+        "7B": dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+                   num_attention_heads_kv=8, ffn_hidden_size=14336,
+                   padded_vocab_size=32000),
+    }
+    base = dict(
+        position_embedding_type=PositionEmbeddingType.rotary,
+        glu_activation="swiglu",
+        normalization="rmsnorm",
+        add_bias_linear=False,
+        tie_embed_logits=False,
+        sliding_window_size=4096,
+        rope_theta=10000.0,
+        seq_length=4096,
+        max_position_embeddings=32768,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+    )
+    base.update(shapes[size])
+    base.update(overrides)
+    return TransformerConfig(**base)

@@ -1,0 +1,24 @@
+"""Compute ops: norms, activations, rotary embeddings, attention, losses.
+
+Replaces the reference's ``megatron/fused_kernels`` (CUDA) +
+``megatron/model/fused_*.py`` wrappers.  On TPU the default path is plain
+jnp — XLA fuses elementwise chains into the surrounding matmuls — with
+Pallas kernels (``megatron_llm_tpu.ops.pallas``) for the ops where a
+hand-written kernel beats XLA (flash attention, long-seq softmax,
+fused RMSNorm).
+"""
+
+from megatron_llm_tpu.ops.layernorm import layer_norm, rms_norm, init_norm_params, apply_norm
+from megatron_llm_tpu.ops.activations import (
+    GLU_ACTIVATIONS,
+    bias_gelu,
+    gelu,
+    glu_activation,
+    squared_relu,
+)
+from megatron_llm_tpu.ops.rope import precompute_freqs_cis, apply_rotary_emb
+from megatron_llm_tpu.ops.softmax import fused_scale_mask_softmax
+from megatron_llm_tpu.ops.cross_entropy import (
+    vocab_parallel_cross_entropy,
+    vocab_parallel_max_indices,
+)

@@ -1,0 +1,69 @@
+"""Activations: GLU family + (bias-)GeLU.
+
+Reference: ``megatron/model/glu_activations.py:8-49`` (liglu/geglu/reglu/
+swiglu as chunk-multiply modules) and ``megatron/model/fused_bias_gelu.py``
+(a torch.jit fused bias+tanh-gelu with hand-written backward).
+
+On TPU none of these need custom kernels: XLA fuses bias-add + gelu into
+the producing matmul's epilogue, and the GLU chunk-multiply is a single
+fused elementwise op.  The math (tanh-approximate gelu constants) matches
+the reference so losses are comparable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    """Tanh-approximate gelu — same polynomial as the reference's
+    fused_bias_gelu.py:15-20."""
+    return 0.5 * x * (1.0 + jnp.tanh(0.79788456 * x * (1.0 + 0.044715 * x * x)))
+
+
+def bias_gelu(bias: jax.Array, x: jax.Array) -> jax.Array:
+    # reference: fused_bias_gelu.py:18-20
+    return gelu(x + bias)
+
+
+def squared_relu(x: jax.Array) -> jax.Array:
+    return jnp.square(jax.nn.relu(x))
+
+
+def _split2(x: jax.Array):
+    return jnp.split(x, 2, axis=-1)
+
+
+def liglu(x: jax.Array) -> jax.Array:
+    # reference: glu_activations.py (LiGLU: linear gate)
+    a, b = _split2(x)
+    return a * b
+
+
+def geglu(x: jax.Array) -> jax.Array:
+    a, b = _split2(x)
+    return gelu(a) * b
+
+
+def reglu(x: jax.Array) -> jax.Array:
+    a, b = _split2(x)
+    return jax.nn.relu(a) * b
+
+
+def swiglu(x: jax.Array) -> jax.Array:
+    # reference: glu_activations.py:38-42 (silu(a) * b)
+    a, b = _split2(x)
+    return jax.nn.silu(a) * b
+
+
+GLU_ACTIVATIONS = {
+    "liglu": liglu,
+    "geglu": geglu,
+    "reglu": reglu,
+    "swiglu": swiglu,
+}
+
+
+def glu_activation(name: str, x: jax.Array) -> jax.Array:
+    return GLU_ACTIVATIONS[name](x)

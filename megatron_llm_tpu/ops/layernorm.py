@@ -1,0 +1,90 @@
+"""LayerNorm / RMSNorm.
+
+Reference: ``megatron/model/fused_layer_norm.py`` — a CUDA mixed-precision
+fused LayerNorm (``layer_norm_cuda_kernel.cu``, Welford accumulation in
+fp32 with fp16/bf16 I/O) and a plain-PyTorch RMSNorm computed in fp32
+(``fused_layer_norm.py:125-139``).
+
+TPU design: the math is written in plain jnp with fp32 internal
+accumulation; XLA fuses it into neighbouring ops, which already removes
+the memory round-trips the CUDA fusion exists for.  A Pallas fused RMSNorm
+(``ops/pallas/rmsnorm.py``) is used on the TPU backend for long rows where
+a single-pass kernel beats the XLA fusion.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def init_norm_params(hidden_size: int, normalization: str, dtype=jnp.float32):
+    """Norm parameter pytree.  LayerNorm: {'scale','bias'}; RMSNorm: {'scale'}."""
+    if normalization == "rmsnorm":
+        return {"scale": jnp.ones((hidden_size,), dtype=dtype)}
+    elif normalization == "layernorm":
+        return {
+            "scale": jnp.ones((hidden_size,), dtype=dtype),
+            "bias": jnp.zeros((hidden_size,), dtype=dtype),
+        }
+    raise ValueError(f"unknown normalization {normalization!r}")
+
+
+def layer_norm(
+    x: jax.Array,
+    scale: jax.Array,
+    bias: Optional[jax.Array],
+    eps: float = 1e-5,
+    fp32_compute: bool = True,
+) -> jax.Array:
+    """LayerNorm over the last axis with fp32 accumulation (matching the
+    reference CUDA kernel's mixed-precision contract)."""
+    dtype = x.dtype
+    if fp32_compute:
+        x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    y = y * scale.astype(y.dtype)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y.astype(dtype)
+
+
+def rms_norm(
+    x: jax.Array,
+    scale: jax.Array,
+    eps: float = 1e-5,
+    fp32_compute: bool = True,
+) -> jax.Array:
+    """RMSNorm (reference: fused_layer_norm.py:125-139 — fp32 compute,
+    cast back to input dtype, elementwise scale)."""
+    dtype = x.dtype
+    if fp32_compute:
+        x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(y.dtype)).astype(dtype)
+
+
+def apply_norm(
+    x: jax.Array,
+    params,
+    normalization: str,
+    eps: float = 1e-5,
+    fp32_compute: bool = True,
+    use_pallas: bool = False,
+) -> jax.Array:
+    if normalization == "rmsnorm":
+        if use_pallas:
+            from megatron_llm_tpu.ops.pallas.rmsnorm import fused_rms_norm
+
+            return fused_rms_norm(x, params["scale"], eps=eps)
+        return rms_norm(x, params["scale"], eps=eps, fp32_compute=fp32_compute)
+    elif normalization == "layernorm":
+        return layer_norm(
+            x, params["scale"], params.get("bias"), eps=eps, fp32_compute=fp32_compute
+        )
+    raise ValueError(f"unknown normalization {normalization!r}")

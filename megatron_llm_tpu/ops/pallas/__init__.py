@@ -1,0 +1,6 @@
+"""Pallas Mosaic-TPU kernels — the TPU-native replacement for the
+reference's CUDA ``megatron/fused_kernels`` + FlashAttention-2.
+
+Every kernel has an XLA (plain jnp) fallback used on non-TPU backends and
+in interpret-mode tests; dispatch is by ``jax.default_backend()``.
+"""

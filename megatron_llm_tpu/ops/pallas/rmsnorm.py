@@ -1,0 +1,14 @@
+"""Fused RMSNorm Pallas kernel (stub dispatching to jnp until the kernel
+milestone; the jnp path matches the reference RMSNorm numerics,
+``megatron/model/fused_layer_norm.py:125-139``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.ops.layernorm import rms_norm
+
+
+def fused_rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
+    return rms_norm(x, scale, eps=eps, fp32_compute=True)

@@ -1,0 +1,77 @@
+"""Rotary positional embeddings with position-interpolation scaling.
+
+Reference: ``megatron/model/positional_embeddings.py:7-51`` —
+``precompute_freqs_cis`` builds complex e^{i t theta^-2k/d} with the RoPE
+*scaling* divisor ``t /= scaling_factor`` (linear position interpolation
+for context extension, flag ``--rope_scaling_factor`` arguments.py:465),
+and ``apply_rotary_emb`` rotates (q, k) by complex multiply over
+*interleaved* even/odd feature pairs, with optional non-monotonic
+``position_ids``.
+
+TPU design: complex dtypes lower poorly on TPU, so the rotation is done as
+the equivalent real cos/sin rotation over interleaved pairs — numerically
+identical (same pairing as the Meta/Llama layout, which is why the HF
+converter's rotary permutation in ``weights_conversion/hf_to_megatron.py:
+117-160`` has an exact analogue here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def precompute_freqs_cis(
+    dim: int,
+    end: int,
+    theta: float = 10000.0,
+    scaling_factor: float = 1.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (cos, sin), each [end, dim // 2], fp32.
+
+    reference: positional_embeddings.py:7-14 (including ``t /= scaling_factor``).
+    """
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32)[: dim // 2] / dim)
+    )
+    t = jnp.arange(end, dtype=jnp.float32) / scaling_factor
+    freqs = jnp.outer(t, freqs)  # [end, dim/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rotary_emb(
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    position_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Rotate interleaved feature pairs of ``x``.
+
+    x: [..., seq, heads, head_dim] (seq is axis -3)
+    cos/sin: [max_pos, head_dim // 2]
+    position_ids: optional int array broadcastable to x's batch+seq dims
+      (reference supports non-monotonic ids for packed sequences,
+      positional_embeddings.py:33-44).
+    """
+    orig_dtype = x.dtype
+    *lead, s, h, d = x.shape
+    if position_ids is None:
+        c = cos[:s]  # [s, d/2]
+        sn = sin[:s]
+        c = c[:, None, :]  # [s, 1, d/2]
+        sn = sn[:, None, :]
+    else:
+        c = cos[position_ids]  # [..., s, d/2]
+        sn = sin[position_ids]
+        c = c[..., :, None, :]
+        sn = sn[..., :, None, :]
+    xf = x.astype(jnp.float32).reshape(*lead, s, h, d // 2, 2)
+    x_even = xf[..., 0]
+    x_odd = xf[..., 1]
+    # (a + ib) * (cos + i sin) = (a cos - b sin) + i(a sin + b cos)
+    out_even = x_even * c - x_odd * sn
+    out_odd = x_even * sn + x_odd * c
+    out = jnp.stack([out_even, out_odd], axis=-1).reshape(*lead, s, h, d)
+    return out.astype(orig_dtype)

@@ -1,0 +1,61 @@
+"""Scaled / masked softmax for attention scores.
+
+Reference: the three CUDA kernel families in ``megatron/fused_kernels``
+(scaled_upper_triang_masked_softmax, scaled_masked_softmax, scaled_softmax)
+behind the eligibility-dispatch wrapper ``FusedScaleMaskSoftmax``
+(``megatron/model/fused_softmax.py:102-213``).
+
+TPU design: one function.  ``scale -> mask -> softmax`` is an elementwise
+chain plus a row reduction; XLA fuses it into a single pass over VMEM, so
+the CUDA kernels' raison d'etre (avoiding HBM round trips) is served by the
+compiler.  fp32 accumulation is kept when ``softmax_in_fp32`` (matching the
+reference's ``attention_softmax_in_fp32`` semantics).  The flash-attention
+path (``ops/pallas/flash_attention.py``) bypasses this entirely, as the
+reference bypasses it with FlashAttention-2.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -10000.0  # reference uses -10000.0 in get_ltor_masks / kernels
+
+
+def causal_mask(sq: int, sk: int, dtype=jnp.bool_) -> jax.Array:
+    """True = masked-out (reference mask convention: 1 means 'mask away',
+    utils.py:137-194)."""
+    # offset so the last sq rows of an sk-length history are causal
+    i = jnp.arange(sq)[:, None]
+    j = jnp.arange(sk)[None, :]
+    return (j > (i + (sk - sq))).astype(dtype)
+
+
+def sliding_window_mask(sq: int, sk: int, window: int, dtype=jnp.bool_) -> jax.Array:
+    """Causal + sliding window (reference: Mistral window_size through
+    FlashAttention-2, transformer.py:528-537)."""
+    i = jnp.arange(sq)[:, None] + (sk - sq)
+    j = jnp.arange(sk)[None, :]
+    causal = j > i
+    too_old = j <= i - window
+    return (causal | too_old).astype(dtype)
+
+
+def fused_scale_mask_softmax(
+    scores: jax.Array,
+    mask: Optional[jax.Array],
+    scale: Optional[float] = None,
+    softmax_in_fp32: bool = True,
+) -> jax.Array:
+    """scores: [..., sq, sk]; mask: broadcastable bool (True = masked)."""
+    dtype = scores.dtype
+    if softmax_in_fp32:
+        scores = scores.astype(jnp.float32)
+    if scale is not None:
+        scores = scores * scale
+    if mask is not None:
+        scores = jnp.where(mask, jnp.float32(NEG_INF), scores)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return probs.astype(dtype)

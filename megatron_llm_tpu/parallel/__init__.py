@@ -1,0 +1,21 @@
+"""Parallelism core: sharding specs, collective mappings, TP layers, pipeline.
+
+The TPU-native replacement for ``megatron/core/tensor_parallel`` +
+``megatron/schedules.py``/``p2p_communication.py``.
+"""
+
+from megatron_llm_tpu.parallel.sharding import (
+    constrain,
+    logical_to_mesh,
+    shard_params,
+    with_logical_constraint,
+)
+from megatron_llm_tpu.parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)

@@ -1,0 +1,174 @@
+"""Autograd-aware collective mappings over the tp axis, for shard_map code.
+
+Reference: ``megatron/core/tensor_parallel/mappings.py`` — seven
+torch.autograd.Function classes pairing a forward collective with its
+transpose in backward:
+
+| reference class (mappings.py line)              | here |
+|-------------------------------------------------|------|
+| _CopyToModelParallelRegion (:127)               | copy_to_tensor_model_parallel_region |
+| _ReduceFromModelParallelRegion (:143)           | reduce_from_tensor_model_parallel_region |
+| _ScatterToModelParallelRegion (:159)            | scatter_to_tensor_model_parallel_region |
+| _GatherFromModelParallelRegion (:175)           | gather_from_tensor_model_parallel_region |
+| _ScatterToSequenceParallelRegion (:191)         | scatter_to_sequence_parallel_region |
+| _GatherFromSequenceParallelRegion (:207)        | gather_from_sequence_parallel_region |
+| _ReduceScatterToSequenceParallelRegion (:233)   | reduce_scatter_to_sequence_parallel_region |
+
+These are used by the explicit shard_map implementation path (pipeline
+stages, tests mirroring ``tests/tensor_parallel/test_mappings.py``).  The
+pjit/GSPMD path doesn't call them — XLA inserts the same collectives from
+sharding constraints.
+
+Each is a ``jax.custom_vjp`` so the backward collective is exactly the
+reference's, independent of JAX's default transposition rules.
+All functions take the mesh axis name as a static first argument.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _split_last(x, n, idx):
+    size = x.shape[-1] // n
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=-1)
+
+
+def _split_first(x, n, idx):
+    size = x.shape[0] // n
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=0)
+
+
+# -- copy: identity fwd, allreduce bwd (mappings.py:127-141) ----------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def copy_to_tensor_model_parallel_region(axis_name: str, x):
+    return x
+
+
+def _copy_fwd(axis_name, x):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+# -- reduce: allreduce fwd, identity bwd (mappings.py:143-157) --------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def reduce_from_tensor_model_parallel_region(axis_name: str, x):
+    return lax.psum(x, axis_name)
+
+
+def _reduce_fwd(axis_name, x):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# -- scatter: split last dim fwd, all-gather bwd (mappings.py:159-173) ------
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def scatter_to_tensor_model_parallel_region(axis_name: str, x):
+    n = lax.psum(1, axis_name)
+    return _split_last(x, n, lax.axis_index(axis_name))
+
+
+def _scatter_fwd(axis_name, x):
+    return scatter_to_tensor_model_parallel_region(axis_name, x), None
+
+
+def _scatter_bwd(axis_name, _, g):
+    return (lax.all_gather(g, axis_name, axis=g.ndim - 1, tiled=True),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+# -- gather: all-gather last dim fwd, split bwd (mappings.py:175-189) -------
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def gather_from_tensor_model_parallel_region(axis_name: str, x):
+    return lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+
+
+def _gather_fwd(axis_name, x):
+    return gather_from_tensor_model_parallel_region(axis_name, x), None
+
+
+def _gather_bwd(axis_name, _, g):
+    n = lax.psum(1, axis_name)
+    return (_split_last(g, n, lax.axis_index(axis_name)),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# -- SP scatter: split seq (first) dim fwd, all-gather bwd (:191-205) -------
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def scatter_to_sequence_parallel_region(axis_name: str, x):
+    n = lax.psum(1, axis_name)
+    return _split_first(x, n, lax.axis_index(axis_name))
+
+
+def _sp_scatter_fwd(axis_name, x):
+    return scatter_to_sequence_parallel_region(axis_name, x), None
+
+
+def _sp_scatter_bwd(axis_name, _, g):
+    return (lax.all_gather(g, axis_name, axis=0, tiled=True),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
+
+
+# -- SP gather: all-gather seq fwd, reduce-scatter bwd (:207-231) -----------
+# (the backward is reduce-scatter, NOT split: forward output is consumed by
+# tp-replicated compute, so grads from all tp ranks must be summed)
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def gather_from_sequence_parallel_region(axis_name: str, x):
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def _sp_gather_fwd(axis_name, x):
+    return gather_from_sequence_parallel_region(axis_name, x), None
+
+
+def _sp_gather_bwd(axis_name, _, g):
+    return (lax.psum_scatter(g, axis_name, scatter_dimension=0, tiled=True),)
+
+
+gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+
+
+# -- SP reduce-scatter fwd, all-gather bwd (:233-251) -----------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def reduce_scatter_to_sequence_parallel_region(axis_name: str, x):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+
+
+def _sp_rs_fwd(axis_name, x):
+    return reduce_scatter_to_sequence_parallel_region(axis_name, x), None
+
+
+def _sp_rs_bwd(axis_name, _, g):
+    return (lax.all_gather(g, axis_name, axis=0, tiled=True),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

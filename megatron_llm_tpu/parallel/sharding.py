@@ -1,0 +1,101 @@
+"""Logical-axis sharding rules and constraint helpers (GSPMD path).
+
+The reference encodes parallel placement *imperatively*: each TP layer calls
+the right collective by hand (``megatron/core/tensor_parallel/layers.py``).
+The TPU-native equivalent is *declarative*: params and activations carry
+logical axis names, a rules table maps logical axes to mesh axes, and
+``with_sharding_constraint`` pins the placement; XLA/GSPMD inserts the
+collectives (the same allreduce/allgather/reduce-scatter pattern — see the
+module docstring of ``parallel/mappings.py`` for the explicit versions).
+
+Logical axes used across the framework:
+
+| logical    | meaning                           | mesh axis |
+|------------|-----------------------------------|-----------|
+| 'batch'    | microbatch dim of activations     | dp        |
+| 'seq'      | sequence dim (activations)        | None (tp when sequence-parallel region) |
+| 'hidden'   | model hidden dim                  | None      |
+| 'vocab'    | vocabulary dim (embedding, head)  | tp        |
+| 'ffn'      | MLP intermediate dim              | tp        |
+| 'heads'    | attention-head dim (q/k/v/o)      | tp        |
+| 'kv_heads' | KV-head dim under GQA             | tp        |
+| 'stage'    | stacked pipeline-stage dim        | pp        |
+| 'expert'   | MoE expert dim                    | dp (EP folded into dp) |
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu import topology
+
+DEFAULT_RULES = {
+    "batch": topology.DP_AXIS,
+    "seq": None,
+    "seq_tp": topology.TP_AXIS,   # sequence-parallel regions
+    "hidden": None,
+    "vocab": topology.TP_AXIS,
+    "ffn": topology.TP_AXIS,
+    "heads": topology.TP_AXIS,
+    "kv_heads": topology.TP_AXIS,
+    "stage": topology.PP_AXIS,
+    "expert": topology.DP_AXIS,
+    "dp_shard": topology.DP_AXIS,  # ZeRO-1 optimizer-state sharding
+    None: None,
+}
+
+
+def logical_to_mesh(
+    logical_spec: Sequence[Optional[str]], rules=None
+) -> P:
+    rules = rules or DEFAULT_RULES
+    return P(*(rules.get(a) for a in logical_spec))
+
+
+def _mesh() -> Optional[Mesh]:
+    return topology._MESH
+
+
+def constrain(x: jax.Array, *logical_axes: Optional[str], rules=None) -> jax.Array:
+    """``with_sharding_constraint`` by logical axis names; no-op when no mesh
+    is initialized (pure single-device runs and numpy-golden tests)."""
+    mesh = _mesh()
+    if mesh is None or all(a is None for a in logical_axes):
+        return x
+    spec = logical_to_mesh(logical_axes, rules)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def with_logical_constraint(tree, specs, rules=None):
+    """Tree-map constrain: ``specs`` is a pytree of logical-axis tuples
+    matching ``tree``."""
+    mesh = _mesh()
+    if mesh is None:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, logical_to_mesh(s, rules))
+        ),
+        tree,
+        specs,
+        is_leaf=lambda v: v is None,
+    )
+
+
+def make_shardings(specs, rules=None, mesh: Optional[Mesh] = None):
+    """Pytree of logical-axis tuples -> pytree of NamedShardings."""
+    mesh = mesh or topology.get_mesh()
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, logical_to_mesh(s, rules)),
+        specs,
+        is_leaf=lambda v: isinstance(v, tuple) or v is None,
+    )
+
+
+def shard_params(params, specs, rules=None, mesh: Optional[Mesh] = None):
+    """device_put a host-side param pytree onto the mesh per its specs."""
+    shardings = make_shardings(specs, rules, mesh)
+    return jax.device_put(params, shardings)

@@ -1,0 +1,70 @@
+"""RNG seed-domain design.
+
+Reference: ``megatron/core/tensor_parallel/random.py`` — a stateful
+``CudaRNGStatesTracker`` with two seed domains (``model_parallel_cuda_manual_seed``
+:144-172): a *default* stream equal across TP ranks (DP-uniform) and a
+*tensor-model-parallel* stream distinct per TP rank (seed + 2718 + tp_rank),
+plus ``initialize.py:179``'s base-seed offset ``seed + 100 * pp_rank
+[+ 10 * dp_rank]``; dropout inside TP regions forks to the TP-distinct
+stream so each rank drops a different slice.
+
+TPU design: there are no per-rank RNG states to keep consistent.
+``jax.random`` is counter-based and *shape-global*: under GSPMD a dropout
+mask drawn for a logical [b, s, h] activation is one global stream whose
+shards each rank materialises locally — the exact property the reference's
+two-domain machinery exists to emulate (TP ranks see different bits for
+different activation slices, the same bits for replicated tensors).  So the
+whole tracker collapses to key-folding discipline:
+
+* one base key per run from ``--seed``;
+* ``fold_in`` by purpose (init / dropout / data) and by (layer, step) so
+  streams never collide;
+* per-microbatch keys derived by folding the microbatch index.
+
+The ``CheckpointFunction`` RNG save/restore (:175-252) is likewise
+subsumed: ``jax.checkpoint`` replays the same functional keys on recompute
+by construction.
+"""
+
+from __future__ import annotations
+
+from enum import IntEnum
+
+import jax
+
+
+class RngDomain(IntEnum):
+    INIT = 0
+    DROPOUT = 1
+    DATA = 2
+    SAMPLING = 3
+
+
+def base_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def domain_key(key: jax.Array, domain: RngDomain) -> jax.Array:
+    return jax.random.fold_in(key, int(domain))
+
+
+def dropout_key(key: jax.Array, layer: int, step: int = 0, micro: int = 0) -> jax.Array:
+    k = domain_key(key, RngDomain.DROPOUT)
+    k = jax.random.fold_in(k, layer)
+    k = jax.random.fold_in(k, step)
+    return jax.random.fold_in(k, micro)
+
+
+class KeySeq:
+    """Host-side convenience: hands out fresh fold_in'd subkeys for init."""
+
+    def __init__(self, seed_or_key):
+        if isinstance(seed_or_key, int):
+            self._key = base_key(seed_or_key)
+        else:
+            self._key = seed_or_key
+        self._n = 0
+
+    def next(self) -> jax.Array:
+        self._n += 1
+        return jax.random.fold_in(self._key, self._n)

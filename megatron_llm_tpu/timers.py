@@ -1,0 +1,131 @@
+"""Hierarchical timers with log levels.
+
+Reference: ``megatron/timers.py:123-303`` — a registry of named timers with
+per-timer log levels (0-2) and optional barrier-synchronized start/stop.
+
+TPU adaptation: device work is async under jit; a wall-clock timer only
+sees dispatch time unless we block.  ``Timer.stop(barrier=True)`` calls
+``jax.block_until_ready`` on a sentinel (or ``jax.effects_barrier``), the
+XLA analogue of the reference's ``torch.cuda.synchronize``-backed barrier.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+
+class Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._elapsed = 0.0
+        self._started = False
+        self._start_time = 0.0
+        self._count = 0
+
+    def start(self, barrier: bool = False):
+        if self._started:
+            raise RuntimeError(f"timer {self.name} has already been started")
+        if barrier:
+            jax.effects_barrier()
+        self._start_time = time.perf_counter()
+        self._started = True
+
+    def stop(self, barrier: bool = False):
+        if not self._started:
+            raise RuntimeError(f"timer {self.name} is not started")
+        if barrier:
+            jax.effects_barrier()
+        self._elapsed += time.perf_counter() - self._start_time
+        self._count += 1
+        self._started = False
+
+    def reset(self):
+        self._elapsed = 0.0
+        self._count = 0
+
+    def elapsed(self, reset: bool = True) -> float:
+        started = self._started
+        if started:
+            self.stop()
+        elapsed = self._elapsed
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return elapsed
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+
+class _DummyTimer:
+    """Returned for timers above the configured log level (reference:
+    timers.py:107-121)."""
+
+    def start(self, barrier=False):
+        pass
+
+    def stop(self, barrier=False):
+        pass
+
+    def reset(self):
+        pass
+
+    def elapsed(self, reset=True):
+        raise RuntimeError("elapsed() on a dummy timer")
+
+
+class Timers:
+    """Reference: timers.py:123-303."""
+
+    def __init__(self, log_level: int = 0, log_option: str = "minmax"):
+        self._log_level = log_level
+        self._log_option = log_option
+        self._timers: Dict[str, Timer] = {}
+        self._log_levels: Dict[str, int] = {}
+        self._dummy = _DummyTimer()
+        self._max_log_level = 2
+
+    def __call__(self, name: str, log_level: Optional[int] = None):
+        if name in self._timers:
+            return self._timers[name]
+        if log_level is None:
+            log_level = self._max_log_level
+        if log_level > self._log_level:
+            return self._dummy
+        t = Timer(name)
+        self._timers[name] = t
+        self._log_levels[name] = log_level
+        return t
+
+    def names(self) -> List[str]:
+        return list(self._timers)
+
+    def get_elapsed(self, names=None, reset=True, normalizer=1.0) -> Dict[str, float]:
+        if names is None:
+            names = self.names()
+        out = {}
+        for n in names:
+            if n in self._timers:
+                out[n] = self._timers[n].elapsed(reset=reset) / normalizer
+        return out
+
+    def log(self, names=None, normalizer=1.0, reset=True, printer=print):
+        elapsed = self.get_elapsed(names, reset=reset, normalizer=normalizer)
+        if not elapsed:
+            return
+        string = "time (ms)"
+        for n, e in elapsed.items():
+            string += f" | {n}: {e * 1000.0:.2f}"
+        printer(string)
+
+    def write(self, names, writer, iteration, normalizer=1.0, reset=False):
+        """Write timer values to a tensorboard-like writer
+        (reference: timers.py:264-303)."""
+        elapsed = self.get_elapsed(names, reset=reset, normalizer=normalizer)
+        for n, e in elapsed.items():
+            writer.add_scalar(f"{n}-time", e, iteration)

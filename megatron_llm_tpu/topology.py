@@ -1,0 +1,190 @@
+"""Device-mesh topology — the TPU-native replacement for
+``megatron/core/parallel_state.py``.
+
+The reference builds ~7 families of NCCL process groups from the
+(tp, pp, vpp) sizes with rank arithmetic (``parallel_state.py:51-205``) and
+exposes ~40 getters.  On TPU the entire fabric is one
+``jax.sharding.Mesh`` with axes ``('pp', 'dp', 'tp')`` — the same rank
+order as the reference (pp outer, dp middle, tp inner; TP groups are
+contiguous device blocks, ``parallel_state.py:146-151``) so TP collectives
+ride nearest-neighbour ICI links.
+
+"Groups" become mesh axes; "group getters" become axis-size/axis-index
+queries.  Rank predicates used inside sharded code (e.g.
+``is_pipeline_last_stage`` inside the 1F1B loop) use ``jax.lax.axis_index``
+under ``shard_map`` instead of global rank math.
+
+Multi-host bootstrap: ``jax.distributed.initialize`` over DCN replaces the
+torchrun/NCCL rendezvous (reference: ``megatron/initialize.py:124-151``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical mesh-axis names.
+PP_AXIS = "pp"
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+MESH_AXES = (PP_AXIS, DP_AXIS, TP_AXIS)
+
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE: Optional[int] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global device mesh.
+
+    Mirrors ``initialize_model_parallel`` (parallel_state.py:51-205) but
+    returns a Mesh; dp size is derived as world // (tp*pp) exactly like the
+    reference derives it in arguments.py:76.
+    """
+    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE
+    if devices is None:
+        devices = jax.devices()
+    world = len(devices)
+    tp, pp = tensor_model_parallel_size, pipeline_model_parallel_size
+    if world % (tp * pp) != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by tensor parallel size "
+            f"({tp}) x pipeline parallel size ({pp})"
+        )
+    dp = world // (tp * pp)
+    # Rank order (pp outer, dp middle, tp inner) — parallel_state.py:116-171.
+    dev_array = np.asarray(devices).reshape(pp, dp, tp)
+    _MESH = Mesh(dev_array, MESH_AXES)
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE = virtual_pipeline_model_parallel_size
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError("model parallel mesh is not initialized")
+    return _MESH
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _MESH
+    _MESH = mesh
+
+
+def destroy_model_parallel() -> None:
+    # reference: parallel_state.py:497
+    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE
+    _MESH = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE = None
+
+
+# ---------------------------------------------------------------------------
+# Size getters (reference: parallel_state.py:217-320).
+# ---------------------------------------------------------------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    return get_mesh().shape[TP_AXIS]
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return get_mesh().shape[PP_AXIS]
+
+
+def get_data_parallel_world_size() -> int:
+    return get_mesh().shape[DP_AXIS]
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE
+
+
+def get_world_size() -> int:
+    m = get_mesh()
+    return m.shape[PP_AXIS] * m.shape[DP_AXIS] * m.shape[TP_AXIS]
+
+
+# ---------------------------------------------------------------------------
+# In-shard rank queries — valid *inside* shard_map over the mesh.
+# (reference rank getters parallel_state.py:322-481 are process-global;
+# under SPMD the analogue is the per-shard axis index.)
+# ---------------------------------------------------------------------------
+
+def get_tensor_model_parallel_rank():
+    return jax.lax.axis_index(TP_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PP_AXIS)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index(DP_AXIS)
+
+
+def is_pipeline_first_stage():
+    # reference: parallel_state.py:322-341
+    return jax.lax.axis_index(PP_AXIS) == 0
+
+
+def is_pipeline_last_stage():
+    return jax.lax.axis_index(PP_AXIS) == get_pipeline_model_parallel_world_size() - 1
+
+
+# ---------------------------------------------------------------------------
+# Host-side process queries (multi-host data loading).
+# ---------------------------------------------------------------------------
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bootstrap over DCN (reference: initialize.py:124-151 uses
+    torchrun env vars + NCCL TCP rendezvous; here it is
+    ``jax.distributed.initialize``, driven by the same env conventions)."""
+    if num_processes is None:
+        num_processes = int(os.environ.get("WORLD_SIZE", "1"))
+    if num_processes <= 1:
+        return
+    if process_id is None:
+        process_id = int(os.environ.get("RANK", "0"))
+    if coordinator_address is None:
+        addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        port = os.environ.get("MASTER_PORT", "8476")
+        coordinator_address = f"{addr}:{port}"
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sharding constructors.
+# ---------------------------------------------------------------------------
+
+def named_sharding(*spec) -> NamedSharding:
+    return NamedSharding(get_mesh(), P(*spec))
+
+
+def replicated_sharding() -> NamedSharding:
+    return NamedSharding(get_mesh(), P())

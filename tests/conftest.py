@@ -1,0 +1,52 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference's distributed tests require >= 8 real GPUs under torchrun
+(``tests/test_utilities.py:6-30`` — real NCCL, no simulation).  We do
+better (as SURVEY.md §4 prescribes): XLA's host platform is forced to
+expose 8 virtual CPU devices, so every TP/PP/DP/SP test runs in CI with no
+hardware.
+"""
+
+import os
+
+# Must happen before jax initializes its backends.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The image's sitecustomize force-registers the axon TPU plugin; route the
+# test session back to the virtual-device CPU backend (must run before any
+# backend is initialized).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from megatron_llm_tpu import topology  # noqa: E402
+
+
+class Utils:
+    """Analogue of the reference's tests/test_utilities.py Utils."""
+
+    world_size = 8
+
+    @staticmethod
+    def initialize_model_parallel(tp=1, pp=1, vpp=None):
+        topology.destroy_model_parallel()
+        return topology.initialize_model_parallel(tp, pp, vpp)
+
+    @staticmethod
+    def destroy_model_parallel():
+        topology.destroy_model_parallel()
+
+
+@pytest.fixture
+def utils():
+    yield Utils
+    Utils.destroy_model_parallel()
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    yield
+    topology.destroy_model_parallel()

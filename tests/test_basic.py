@@ -1,0 +1,7 @@
+"""Smoke test (reference: tests/test_basic.py — `import megatron`)."""
+
+
+def test_import():
+    import megatron_llm_tpu  # noqa: F401
+
+    assert megatron_llm_tpu.__version__
