@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Benchmark: training throughput (tokens/sec/chip) + MFU on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Baseline: the reference's derived Llama-2-7B finetune throughput is
+~3.5k tokens/sec per A100-80GB (BASELINE.md).  A single v5e chip can't
+hold 7B training state, so the bench trains the largest Llama-family
+model that fits one chip and reports MFU alongside raw tokens/sec;
+``vs_baseline`` compares achieved MFU against the reference's implied
+A100 MFU on its 7B recipe (~3.5k tok/s x 6x7e9 FLOP/tok / 312 TFLOPs
+= 47%), i.e. vs_baseline > 1 means better hardware utilization than the
+reference's own headline recipe.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.config import ParallelConfig, TrainConfig
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+from megatron_llm_tpu.optimizer import MegatronOptimizer
+from megatron_llm_tpu.training import build_train_step
+
+PEAK_FLOPS = {
+    # bf16 peak per chip
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6e": 918e12,
+}
+A100_REFERENCE_MFU = 0.47  # BASELINE.md derivation
+
+
+def main():
+    dev = jax.devices()[0]
+    peak = next((v for k, v in PEAK_FLOPS.items() if k in dev.device_kind), 197e12)
+    on_tpu = jax.default_backend() in ("tpu", "axon") or "TPU" in dev.device_kind
+
+    # ~350M-param llama (fits one 16GB chip with fp32 master + adam state)
+    cfg = llama_config(
+        "tiny",
+        num_layers=24, hidden_size=1024, num_attention_heads=16,
+        ffn_hidden_size=2816, padded_vocab_size=32000,
+        seq_length=2048, max_position_embeddings=2048,
+        params_dtype="bf16", compute_dtype="bf16",
+        recompute_granularity="selective",
+    )
+    micro_batch, num_micro = (8, 1) if on_tpu else (2, 1)
+    seq = cfg.seq_length
+
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    n_params = model.num_params(params)
+
+    tc = TrainConfig(
+        micro_batch_size=micro_batch, global_batch_size=micro_batch * num_micro,
+        train_iters=0, lr=1e-4, optimizer="adam", bf16=True, clip_grad=1.0,
+    )
+    pc = ParallelConfig()
+    opt = MegatronOptimizer(tc, params_dtype=jnp.bfloat16)
+    opt_state = opt.init(params)
+    step = build_train_step(model, opt, pc, num_micro)
+
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 32000, (num_micro, micro_batch, seq)))
+    batch = {
+        "tokens": toks,
+        "labels": jnp.roll(toks, -1, axis=-1),
+        "loss_mask": jnp.ones_like(toks, jnp.float32),
+    }
+    key = jax.random.PRNGKey(1)
+
+    # compile + warmup
+    params, opt_state, m = step(params, opt_state, batch, key, 1e-4, 0.0)
+    jax.block_until_ready(m["lm loss"])
+
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, opt_state, m = step(params, opt_state, batch, key, 1e-4, 0.0)
+    jax.block_until_ready(m["lm loss"])
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_iter = micro_batch * num_micro * seq
+    tps = tokens_per_iter / dt
+    flops_tok = model.flops_per_token()
+    mfu = tps * flops_tok / peak
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / A100_REFERENCE_MFU, 4),
+        "mfu": round(mfu, 4),
+        "model": "llama-354M",
+        "n_params": int(n_params),
+        "seq_length": seq,
+        "micro_batch": micro_batch,
+        "device": dev.device_kind,
+        "ms_per_iter": round(dt * 1000, 2),
+        "loss": float(m["lm loss"]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

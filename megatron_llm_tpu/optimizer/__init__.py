@@ -1,0 +1,35 @@
+"""Optimizer stack.
+
+Reference: ``megatron/optimizer/`` — ``MegatronOptimizer`` ABC,
+``Float16OptimizerWithFloat16Params`` (fp32 master params),
+``FP32Optimizer``, ``DistributedOptimizer`` (ZeRO-1),
+``clip_grad_norm_fp32``, ``ConstantGradScaler``/``DynamicGradScaler``, and
+``get_megatron_optimizer`` (``optimizer/__init__.py:63``).
+
+TPU design: one *functional* mixed-precision optimizer over pytrees.
+All the reference's imperative machinery maps onto pure state transitions:
+
+* fp32 master copies (optimizer.py:469-696)  -> ``state.master_params``
+* grad unscale + global inf/nan consensus (optimizer.py:384-466) ->
+  an fp32 ``isfinite`` all-reduce folded into the jitted step (under
+  GSPMD the consensus is just a reduction over the global grad pytree)
+* clip_grad_norm_fp32 with MP-group-reduced norm (clip_grads.py:16-107)
+  -> a global-norm clip on the (logically global) grad pytree
+* DistributedOptimizer's DP-sharded state (distrib_optimizer.py) ->
+  optimizer-state leaves carry an extra dp-axis sharding (ZeRO-1), see
+  ``zero1_state_specs``.
+* Apex FusedAdam / amp_C multi-tensor kernels -> XLA fuses the elementwise
+  update chain across the whole pytree; no custom kernel needed.
+"""
+
+from megatron_llm_tpu.optimizer.optimizer import (
+    MegatronOptimizer,
+    OptimizerState,
+    get_megatron_optimizer,
+)
+from megatron_llm_tpu.optimizer.grad_scaler import (
+    ConstantGradScaler,
+    DynamicGradScaler,
+    GradScalerState,
+)
+from megatron_llm_tpu.optimizer.scheduler import OptimizerParamScheduler

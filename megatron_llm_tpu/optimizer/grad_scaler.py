@@ -1,0 +1,83 @@
+"""Loss scaling for fp16 training.
+
+Reference: ``megatron/optimizer/grad_scaler.py:40-120`` —
+``ConstantGradScaler`` and ``DynamicGradScaler`` (growth / backoff with
+hysteresis).  Functional re-design: the scaler is a pure update on a small
+state pytree carried through the jitted train step, so the
+scale/inf-consensus runs on device with no host sync.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class GradScalerState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar
+    growth_tracker: jnp.ndarray  # i32: consecutive non-inf steps
+    hysteresis_tracker: jnp.ndarray  # i32: remaining tolerated inf steps
+
+
+class ConstantGradScaler:
+    # reference: grad_scaler.py:40-56
+    def __init__(self, scale: float):
+        self._scale = float(scale)
+
+    def init(self) -> GradScalerState:
+        return GradScalerState(
+            scale=jnp.float32(self._scale),
+            growth_tracker=jnp.int32(0),
+            hysteresis_tracker=jnp.int32(0),
+        )
+
+    def update(self, state: GradScalerState, found_inf) -> GradScalerState:
+        return state
+
+
+class DynamicGradScaler:
+    """reference: grad_scaler.py:58-120 — double every ``growth_interval``
+    clean steps; on inf/nan, consume hysteresis then halve (min_scale
+    floor)."""
+
+    def __init__(
+        self,
+        initial_scale: float = 2.0 ** 32,
+        min_scale: float = 1.0,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        growth_interval: int = 1000,
+        hysteresis: int = 2,
+    ):
+        self.initial_scale = float(initial_scale)
+        self.min_scale = float(min_scale)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.hysteresis = int(hysteresis)
+
+    def init(self) -> GradScalerState:
+        return GradScalerState(
+            scale=jnp.float32(self.initial_scale),
+            growth_tracker=jnp.int32(0),
+            hysteresis_tracker=jnp.int32(self.hysteresis),
+        )
+
+    def update(self, state: GradScalerState, found_inf) -> GradScalerState:
+        found_inf = found_inf.astype(jnp.bool_)
+        hys = jnp.where(
+            found_inf, state.hysteresis_tracker - 1, jnp.int32(self.hysteresis)
+        )
+        backoff = found_inf & (hys <= 0)
+        new_scale = jnp.where(
+            backoff,
+            jnp.maximum(state.scale * self.backoff_factor, self.min_scale),
+            state.scale,
+        )
+        growth = jnp.where(found_inf, jnp.int32(0), state.growth_tracker + 1)
+        grow_now = (~found_inf) & (growth >= self.growth_interval)
+        new_scale = jnp.where(grow_now, new_scale * self.growth_factor, new_scale)
+        growth = jnp.where(grow_now, jnp.int32(0), growth)
+        return GradScalerState(scale=new_scale, growth_tracker=growth,
+                               hysteresis_tracker=hys)

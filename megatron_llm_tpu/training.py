@@ -1,0 +1,279 @@
+"""Training runtime: train_step + pretrain driver.
+
+Reference: ``megatron/training.py`` — ``pretrain`` (:55-169), ``train_step``
+(:393-459), ``_train`` loop (:654-770), ``training_log`` (:462-641).
+
+TPU re-design: the reference's train_step is imperative — a Python
+microbatch loop (schedules.py) each issuing fwd/bwd, then three grad-sync
+phases, then the optimizer.  Here the *entire* step — microbatch
+accumulation loop, loss scaling, grad clip, inf check, Adam, master->param
+cast — is one jitted function: ``lax.scan`` over the microbatch axis, then
+the functional optimizer.  GSPMD turns the dp-sharded batch into data
+parallelism (grad psum over dp is inserted where the loss mean crosses the
+batch axis), so ``reduce_model_grads``/``allreduce_gradients``
+(optimizer.py:280-302, distributed.py:202) have no hand-written analogue.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from functools import partial
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import TrainConfig, TransformerConfig, ParallelConfig
+from megatron_llm_tpu.optimizer import MegatronOptimizer, OptimizerParamScheduler
+from megatron_llm_tpu import random as mrandom
+from megatron_llm_tpu.global_vars import get_counters
+
+logger = logging.getLogger("megatron_llm_tpu")
+
+
+def average_losses_across_data_parallel_group(losses):
+    """Reference: megatron/utils.py:100-107 — with a single-controller mesh
+    the loss pytree is already global; the mean is the DP-averaged value."""
+    return jax.tree_util.tree_map(jnp.mean, losses)
+
+
+def default_loss_func(loss_tok: jax.Array, loss_mask: jax.Array):
+    """Masked token-mean loss (reference: finetune.py:201-218)."""
+    loss_mask = loss_mask.astype(jnp.float32)
+    return jnp.sum(loss_tok * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+
+
+def build_train_step(
+    model,
+    optimizer: MegatronOptimizer,
+    parallel_cfg: ParallelConfig,
+    num_microbatches: int,
+    loss_func: Callable = default_loss_func,
+    forward_only: bool = False,
+):
+    """Compile one global training step.
+
+    Batch layout: dict of arrays with leading axes [num_micro, batch, seq]
+    where ``batch`` is the *global* batch per microbatch (dp-sharded).
+    Expected keys: tokens, labels, loss_mask; optional position_ids,
+    attention_mask.
+    """
+    sp = parallel_cfg.sequence_parallel
+
+    def microbatch_loss(params, micro, rng_key, scale):
+        loss_tok = model(
+            params,
+            micro["tokens"],
+            position_ids=micro.get("position_ids"),
+            attention_mask=micro.get("attention_mask"),
+            labels=micro["labels"],
+            rng_key=rng_key,
+            train=not forward_only,
+            sequence_parallel=sp,
+        )
+        loss = loss_func(loss_tok, micro["loss_mask"])
+        # scaled loss for fp16 (reference: optimizer.scale_loss,
+        # schedules.py:142-202); scale==1 for bf16/fp32
+        return loss * scale / num_microbatches, loss
+
+    if forward_only:
+
+        def eval_step(params, batch, rng_key):
+            def body(carry, micro):
+                _, loss = microbatch_loss(params, micro, None, 1.0)
+                return carry, loss
+
+            _, losses = jax.lax.scan(body, 0, batch)
+            return jnp.mean(losses)
+
+        return jax.jit(eval_step)
+
+    def train_step(params, opt_state, batch, rng_key, lr, wd):
+        scale = opt_state.grad_scaler.scale
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+
+        def body(carry, scanned):
+            grads_acc = carry
+            micro, idx = scanned
+            mkey = jax.random.fold_in(rng_key, idx)
+            grad_fn = jax.value_and_grad(microbatch_loss, has_aux=True)
+            (_, loss), g = grad_fn(params, micro, mkey, scale)
+            grads_acc = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), grads_acc, g
+            )
+            return grads_acc, loss
+
+        grads, losses = jax.lax.scan(
+            body, zeros, (batch, jnp.arange(num_microbatches))
+        )
+        new_params, new_opt_state, stats = optimizer.step(
+            params, grads, opt_state, lr, wd
+        )
+        metrics = {
+            "lm loss": jnp.mean(losses),
+            "grad_norm": stats["grad_norm"],
+            "loss_scale": stats["loss_scale"],
+            "skipped_iter": stats["found_inf"].astype(jnp.int32),
+        }
+        return new_params, new_opt_state, metrics
+
+    return jax.jit(train_step, donate_argnums=(0, 1))
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+def training_log(
+    iteration: int,
+    train_iters: int,
+    metrics: Dict[str, float],
+    elapsed_per_iter: float,
+    tokens_per_iter: float,
+    lr: float,
+    writer=None,
+    printer=print,
+):
+    """One console/TB log line (reference: training.py:462-641,
+    tokens/sec at :591-609)."""
+    tps = tokens_per_iter / max(elapsed_per_iter, 1e-9)
+    line = (
+        f" iteration {iteration:8d}/{train_iters:8d} |"
+        f" elapsed time per iteration (ms): {elapsed_per_iter * 1000.0:.1f} |"
+        f" tokens per second: {tps:.1f} |"
+        f" learning rate: {lr:.3E} |"
+        f" lm loss: {float(metrics.get('lm loss', 0.0)):.6E} |"
+        f" loss scale: {float(metrics.get('loss_scale', 1.0)):.1f} |"
+        f" grad norm: {float(metrics.get('grad_norm', 0.0)):.3f} |"
+        f" skipped iterations: {int(metrics.get('skipped_iter', 0))} |"
+    )
+    printer(line)
+    if writer is not None:
+        for k, v in metrics.items():
+            writer.add_scalar(k, float(v), iteration)
+        writer.add_scalar("tokens_per_sec", tps, iteration)
+        writer.add_scalar("learning_rate", lr, iteration)
+    return tps
+
+
+def pretrain(
+    model,
+    params,
+    train_cfg: TrainConfig,
+    parallel_cfg: ParallelConfig,
+    batch_iterator,
+    *,
+    scheduler: Optional[OptimizerParamScheduler] = None,
+    optimizer: Optional[MegatronOptimizer] = None,
+    loss_func: Callable = default_loss_func,
+    log_interval: int = 10,
+    save_interval: Optional[int] = None,
+    save_dir: Optional[str] = None,
+    eval_iterator=None,
+    eval_interval: Optional[int] = None,
+    eval_iters: int = 10,
+    exit_signal_handler=None,
+    start_iteration: int = 0,
+    opt_state=None,
+    on_metrics=None,
+):
+    """Minimal-dependency pretrain loop (the full CLI driver lives in
+    ``finetune.py`` / ``pretrain_gpt.py`` at the repo root).
+
+    ``batch_iterator`` yields batch dicts shaped
+    [num_micro, global_batch, seq] (see build_train_step).
+    """
+    from megatron_llm_tpu import checkpointing
+
+    num_micro = max(
+        train_cfg.global_batch_size
+        // (train_cfg.micro_batch_size * parallel_cfg.data_parallel_size),
+        1,
+    )
+    if optimizer is None:
+        optimizer = MegatronOptimizer(
+            train_cfg, params_dtype=jax.tree_util.tree_leaves(params)[0].dtype
+        )
+    if opt_state is None:
+        opt_state = optimizer.init(params)
+    if scheduler is None:
+        scheduler = OptimizerParamScheduler(
+            max_lr=train_cfg.lr,
+            min_lr=train_cfg.min_lr,
+            lr_warmup_steps=train_cfg.lr_warmup_iters,
+            lr_decay_steps=train_cfg.lr_decay_iters or max(train_cfg.train_iters, 1),
+            lr_decay_style=train_cfg.lr_decay_style,
+            start_wd=train_cfg.start_weight_decay or train_cfg.weight_decay,
+            end_wd=train_cfg.end_weight_decay or train_cfg.weight_decay,
+            wd_incr_steps=max(train_cfg.train_iters, 1),
+            wd_incr_style=train_cfg.weight_decay_incr_style,
+        )
+        scheduler.num_steps = start_iteration
+
+    train_step = build_train_step(
+        model, optimizer, parallel_cfg, num_micro, loss_func
+    )
+    eval_step = (
+        build_train_step(model, optimizer, parallel_cfg, num_micro, loss_func,
+                         forward_only=True)
+        if eval_iterator is not None
+        else None
+    )
+
+    base_key = mrandom.base_key(train_cfg.seed)
+    counters = get_counters()
+    iteration = start_iteration
+    last_time = time.perf_counter()
+
+    while iteration < train_cfg.train_iters:
+        batch = next(batch_iterator)
+        lr, wd = scheduler.step(1)
+        step_key = jax.random.fold_in(base_key, iteration)
+        params, opt_state, metrics = train_step(
+            params, opt_state, batch, step_key, lr, wd
+        )
+        iteration += 1
+        tokens = batch["tokens"].size
+        counters["tokens"] += tokens
+
+        if log_interval and iteration % log_interval == 0:
+            jax.block_until_ready(metrics["lm loss"])
+            now = time.perf_counter()
+            elapsed = (now - last_time) / log_interval
+            last_time = now
+            training_log(
+                iteration, train_cfg.train_iters,
+                {k: float(v) for k, v in metrics.items()},
+                elapsed, tokens, lr,
+            )
+            if on_metrics is not None:
+                on_metrics(iteration, metrics)
+
+        if eval_step is not None and eval_interval and iteration % eval_interval == 0:
+            losses = []
+            for _ in range(eval_iters):
+                eval_batch = next(eval_iterator)
+                losses.append(float(eval_step(params, eval_batch, None)))
+            print(f" validation loss at iteration {iteration}: "
+                  f"{sum(losses) / len(losses):.6E}")
+
+        if save_interval and save_dir and iteration % save_interval == 0:
+            checkpointing.save_checkpoint(
+                save_dir, iteration, params, opt_state, scheduler,
+                consumed_samples=counters.get("samples", 0),
+            )
+
+        if exit_signal_handler is not None and exit_signal_handler.signals_received():
+            print("exiting on termination signal: saving checkpoint")
+            if save_dir:
+                checkpointing.save_checkpoint(
+                    save_dir, iteration, params, opt_state, scheduler,
+                    consumed_samples=counters.get("samples", 0),
+                )
+            sys.exit(0)
+
+    return params, opt_state, iteration

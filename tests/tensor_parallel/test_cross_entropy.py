@@ -1,0 +1,95 @@
+"""Vocab-parallel CE tests (reference: tests/tensor_parallel/test_cross_entropy.py).
+
+Checks: GSPMD version vs pure-numpy log-softmax CE; explicit shard_map
+version vs GSPMD version; argmax across shards; label smoothing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from megatron_llm_tpu.ops.cross_entropy import (
+    shard_vocab_parallel_cross_entropy,
+    shard_vocab_parallel_max_indices,
+    vocab_parallel_cross_entropy,
+    vocab_parallel_max_indices,
+)
+
+
+def numpy_ce(logits, labels):
+    logits = np.asarray(logits, np.float64)
+    m = logits.max(-1, keepdims=True)
+    lse = np.log(np.exp(logits - m).sum(-1)) + m[..., 0]
+    tgt = np.take_along_axis(logits, labels[..., None], -1)[..., 0]
+    return lse - tgt
+
+
+@pytest.fixture
+def data():
+    rng = np.random.RandomState(42)
+    logits = jnp.asarray(rng.randn(4, 8, 64).astype(np.float32) * 3)
+    labels = jnp.asarray(rng.randint(0, 64, size=(4, 8)).astype(np.int32))
+    return logits, labels
+
+
+def test_ce_matches_numpy(data):
+    logits, labels = data
+    loss = vocab_parallel_cross_entropy(logits, labels)
+    np.testing.assert_allclose(loss, numpy_ce(logits, np.asarray(labels)), rtol=1e-5)
+
+
+def test_ce_grad_is_softmax_minus_onehot(data):
+    logits, labels = data
+    g = jax.grad(lambda l: vocab_parallel_cross_entropy(l, labels).sum())(logits)
+    probs = jax.nn.softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, 64)
+    np.testing.assert_allclose(g, probs - onehot, atol=1e-5)
+
+
+def test_shard_ce_matches_global(utils, data):
+    mesh = utils.initialize_model_parallel(tp=8)
+    logits, labels = data
+
+    f = shard_map(
+        lambda l, y: shard_vocab_parallel_cross_entropy(l, y, "tp"),
+        mesh=mesh,
+        in_specs=(P(None, None, "tp"), P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    loss = f(logits, labels)
+    np.testing.assert_allclose(
+        loss, vocab_parallel_cross_entropy(logits, labels), rtol=1e-5
+    )
+
+
+def test_shard_ce_label_smoothing(utils, data):
+    mesh = utils.initialize_model_parallel(tp=8)
+    logits, labels = data
+    f = shard_map(
+        lambda l, y: shard_vocab_parallel_cross_entropy(l, y, "tp", 0.1),
+        mesh=mesh,
+        in_specs=(P(None, None, "tp"), P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    np.testing.assert_allclose(
+        f(logits, labels),
+        vocab_parallel_cross_entropy(logits, labels, 0.1),
+        rtol=1e-5,
+    )
+
+
+def test_shard_max_indices(utils, data):
+    mesh = utils.initialize_model_parallel(tp=8)
+    logits, _ = data
+    f = shard_map(
+        lambda l: shard_vocab_parallel_max_indices(l, "tp"),
+        mesh=mesh,
+        in_specs=(P(None, None, "tp"),),
+        out_specs=P(),
+        check_rep=False,
+    )
+    np.testing.assert_array_equal(f(logits), vocab_parallel_max_indices(logits))

@@ -1,0 +1,58 @@
+"""GLU activation math vs reference formulas
+(reference: tests/test_activations.py:12-54)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.ops.activations import (
+    bias_gelu,
+    geglu,
+    gelu,
+    liglu,
+    reglu,
+    swiglu,
+)
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    return jnp.asarray(rng.randn(4, 10).astype(np.float32))
+
+
+def test_shapes_halved():
+    x = _data()
+    for fn in (liglu, geglu, reglu, swiglu):
+        assert fn(x).shape == (4, 5)
+
+
+def test_liglu_values():
+    x = _data()
+    a, b = np.split(np.asarray(x), 2, axis=-1)
+    np.testing.assert_allclose(liglu(x), a * b, rtol=1e-6)
+
+
+def test_reglu_values():
+    x = _data()
+    a, b = np.split(np.asarray(x), 2, axis=-1)
+    np.testing.assert_allclose(reglu(x), np.maximum(a, 0) * b, rtol=1e-6)
+
+
+def test_swiglu_values():
+    x = _data()
+    a, b = np.split(np.asarray(x), 2, axis=-1)
+    silu = a / (1 + np.exp(-a))
+    np.testing.assert_allclose(swiglu(x), silu * b, rtol=1e-5)
+
+
+def test_geglu_values():
+    x = _data()
+    a, b = np.split(np.asarray(x), 2, axis=-1)
+    g = 0.5 * a * (1 + np.tanh(0.79788456 * a * (1 + 0.044715 * a * a)))
+    np.testing.assert_allclose(geglu(x), g * b, rtol=1e-5)
+
+
+def test_bias_gelu_matches_gelu():
+    x = _data()
+    bias = jnp.ones((10,))
+    np.testing.assert_allclose(bias_gelu(bias, x), gelu(x + 1.0), rtol=1e-6)

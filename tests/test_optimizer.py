@@ -1,0 +1,140 @@
+"""Optimizer stack tests: AdamW math vs numpy, fp16 master-param flow,
+dynamic loss scaler growth/backoff/hysteresis, global-norm clip, inf skip
+(reference semantics: megatron/optimizer/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.config import TrainConfig
+from megatron_llm_tpu.optimizer import DynamicGradScaler, MegatronOptimizer
+from megatron_llm_tpu.optimizer.optimizer import global_grad_norm
+from megatron_llm_tpu.optimizer.scheduler import OptimizerParamScheduler
+
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {
+        "layer": {"kernel": jnp.asarray(rng.randn(4, 4), jnp.float32),
+                  "bias": jnp.zeros((4,), jnp.float32)}
+    }
+
+
+def test_adamw_matches_numpy():
+    tc = TrainConfig(optimizer="adam", lr=0.1, clip_grad=0.0, weight_decay=0.0)
+    opt = MegatronOptimizer(tc)
+    params = _params()
+    state = opt.init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+
+    p1, s1, stats = opt.step(params, grads, state, 0.1, 0.0)
+    # numpy adam step 1: m=0.1*g? no: m=(1-b1)*g=0.1, v=(1-b2)*g^2=0.001
+    # mhat=0.1/0.1=1, vhat=0.001/0.001=1 -> update=1/(1+eps)≈1
+    expected = np.asarray(params["layer"]["kernel"]) - 0.1 * 1.0 / (1.0 + 1e-8)
+    np.testing.assert_allclose(p1["layer"]["kernel"], expected, atol=2e-6)
+    assert not bool(stats["found_inf"])
+
+
+def test_weight_decay_skips_bias():
+    tc = TrainConfig(optimizer="adam", lr=0.0, clip_grad=0.0, weight_decay=0.5)
+    opt = MegatronOptimizer(tc)
+    params = _params()
+    state = opt.init(params)
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    # lr=0 -> no update at all regardless of wd (wd couples through lr)
+    p1, _, _ = opt.step(params, zeros, state, 0.0, 0.5)
+    np.testing.assert_allclose(p1["layer"]["kernel"], params["layer"]["kernel"])
+    # lr>0, zero grads: kernel decays, bias must not
+    p2, _, _ = opt.step(params, zeros, state, 0.1, 0.5)
+    assert np.all(np.abs(p2["layer"]["kernel"]) < np.abs(params["layer"]["kernel"]))
+    np.testing.assert_allclose(p2["layer"]["bias"], params["layer"]["bias"])
+
+
+def test_inf_grad_skips_step():
+    tc = TrainConfig(optimizer="adam", lr=0.1, fp16=True)
+    opt = MegatronOptimizer(tc, params_dtype=jnp.float16)
+    params = jax.tree_util.tree_map(lambda p: p.astype(jnp.float16), _params())
+    state = opt.init(params)
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, jnp.inf, dtype=jnp.float32), params
+    )
+    p1, s1, stats = opt.step(params, grads, state, 0.1, 0.0)
+    assert bool(stats["found_inf"])
+    np.testing.assert_allclose(
+        np.asarray(p1["layer"]["kernel"], np.float32),
+        np.asarray(params["layer"]["kernel"], np.float32),
+    )
+    assert int(s1.step) == 0
+    # hysteresis consumed
+    assert int(s1.grad_scaler.hysteresis_tracker) == 1
+
+
+def test_fp16_master_params_preserve_precision():
+    tc = TrainConfig(optimizer="adam", lr=1e-4, fp16=True, loss_scale=128.0,
+                     clip_grad=0.0)
+    opt = MegatronOptimizer(tc, params_dtype=jnp.float16)
+    params = jax.tree_util.tree_map(lambda p: p.astype(jnp.float16), _params())
+    state = opt.init(params)
+    assert state.master_params is not None
+    g = jax.tree_util.tree_map(
+        lambda p: 128.0 * 1e-3 * jnp.ones_like(p, jnp.float32), params
+    )
+    p1, s1, _ = opt.step(params, g, state, 1e-4, 0.0)
+    # master moved even though fp16 cast may round
+    assert float(jnp.max(jnp.abs(
+        s1.master_params["layer"]["kernel"]
+        - state.master_params["layer"]["kernel"]))) > 0
+
+
+def test_dynamic_scaler_backoff_and_growth():
+    sc = DynamicGradScaler(initial_scale=2.0 ** 10, growth_interval=2, hysteresis=1)
+    st = sc.init()
+    st = sc.update(st, jnp.array(True))  # inf -> halve (hysteresis 1)
+    assert float(st.scale) == 2.0 ** 9
+    st = sc.update(st, jnp.array(False))
+    st = sc.update(st, jnp.array(False))  # 2 clean -> double
+    assert float(st.scale) == 2.0 ** 10
+
+
+def test_global_grad_norm_and_clip():
+    tc = TrainConfig(optimizer="sgd", lr=1.0, clip_grad=1.0, weight_decay=0.0,
+                     sgd_momentum=0.0)
+    opt = MegatronOptimizer(tc)
+    params = {"w": jnp.zeros((3,), jnp.float32)}
+    grads = {"w": jnp.asarray([3.0, 4.0, 0.0])}
+    assert float(global_grad_norm(grads)) == 5.0
+    p1, _, stats = opt.step(params, grads, opt.init(params), 1.0, 0.0)
+    np.testing.assert_allclose(float(stats["grad_norm"]), 5.0, rtol=1e-5)
+    # clipped to norm 1 -> step = g/5
+    np.testing.assert_allclose(p1["w"], [-0.6, -0.8, 0.0], rtol=1e-4)
+
+
+def test_scheduler_styles():
+    s = OptimizerParamScheduler(max_lr=1.0, min_lr=0.1, lr_warmup_steps=10,
+                                lr_decay_steps=110, lr_decay_style="linear")
+    assert s.get_lr(5) == 0.5
+    assert s.get_lr(10) == 1.0
+    np.testing.assert_allclose(s.get_lr(60), 0.55)
+    assert s.get_lr(110) == 0.1
+    assert s.get_lr(1000) == 0.1
+
+    c = OptimizerParamScheduler(max_lr=1.0, min_lr=0.0, lr_warmup_steps=0,
+                                lr_decay_steps=100, lr_decay_style="cosine")
+    np.testing.assert_allclose(c.get_lr(50), 0.5, atol=1e-6)
+
+    r = OptimizerParamScheduler(max_lr=1.0, min_lr=0.0, lr_warmup_steps=4,
+                                lr_decay_steps=100,
+                                lr_decay_style="inverse-square-root")
+    np.testing.assert_allclose(r.get_lr(16), 0.5)
+
+
+def test_scheduler_state_roundtrip():
+    s = OptimizerParamScheduler(max_lr=1.0, min_lr=0.1, lr_warmup_steps=10,
+                                lr_decay_steps=110)
+    s.step(7)
+    sd = s.state_dict()
+    s2 = OptimizerParamScheduler(max_lr=1.0, min_lr=0.1, lr_warmup_steps=10,
+                                 lr_decay_steps=110)
+    s2.load_state_dict(sd)
+    assert s2.num_steps == 7
+    assert s2.get_lr() == s.get_lr()

@@ -1,0 +1,45 @@
+"""Mesh topology tests (reference: tests/test_parallel_state.py:9-105 —
+group construction and rank math for tp=2 x pp=4 on 8 devices)."""
+
+import pytest
+
+from megatron_llm_tpu import topology
+
+
+def test_initialize_and_destroy_model_parallel(utils):
+    utils.initialize_model_parallel(tp=2, pp=4)
+    assert topology.model_parallel_is_initialized()
+    assert topology.get_tensor_model_parallel_world_size() == 2
+    assert topology.get_pipeline_model_parallel_world_size() == 4
+    assert topology.get_data_parallel_world_size() == 1
+    assert topology.get_world_size() == 8
+    utils.destroy_model_parallel()
+    assert not topology.model_parallel_is_initialized()
+
+
+def test_dp_derivation(utils):
+    utils.initialize_model_parallel(tp=2, pp=1)
+    assert topology.get_data_parallel_world_size() == 4
+
+
+def test_invalid_sizes(utils):
+    with pytest.raises(RuntimeError):
+        utils.initialize_model_parallel(tp=3, pp=1)
+
+
+def test_vpp_state(utils):
+    utils.initialize_model_parallel(tp=1, pp=4, vpp=2)
+    assert topology.get_virtual_pipeline_model_parallel_world_size() == 2
+
+
+def test_mesh_rank_order(utils):
+    """TP groups are contiguous device blocks (reference:
+    parallel_state.py:146-151 — rank order pp outer, dp middle, tp inner)."""
+    mesh = utils.initialize_model_parallel(tp=2, pp=2)
+    devs = mesh.devices  # [pp, dp, tp]
+    assert devs.shape == (2, 2, 2)
+    ids = [[[d.id for d in row] for row in plane] for plane in devs]
+    # tp neighbours adjacent, dp strides tp, pp strides dp*tp
+    assert ids[0][0] == [0, 1]
+    assert ids[0][1] == [2, 3]
+    assert ids[1][0] == [4, 5]

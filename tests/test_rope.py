@@ -1,0 +1,69 @@
+"""Rotary embedding tests: parity with the reference's complex-multiply
+formulation (``megatron/model/positional_embeddings.py:7-51``), RoPE
+scaling, position_ids."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.ops.rope import apply_rotary_emb, precompute_freqs_cis
+
+
+def reference_complex_rope(x, end, theta=10000.0, scaling=1.0, position_ids=None):
+    """Numpy re-derivation of the reference math: freqs_cis complex,
+    interleaved pairs viewed as complex, elementwise multiply."""
+    x = np.asarray(x, np.float32)
+    b, s, h, d = x.shape
+    freqs = 1.0 / (theta ** (np.arange(0, d, 2)[: d // 2] / d))
+    t = np.arange(end) / scaling
+    freqs_cis = np.exp(1j * np.outer(t, freqs))  # [end, d/2]
+    if position_ids is None:
+        fc = freqs_cis[:s][None, :, None, :]
+    else:
+        fc = freqs_cis[position_ids][:, :, None, :]
+    xc = x.reshape(b, s, h, d // 2, 2)
+    xc = xc[..., 0] + 1j * xc[..., 1]
+    out = xc * fc
+    res = np.stack([out.real, out.imag], axis=-1).reshape(b, s, h, d)
+    return res.astype(np.float32)
+
+
+def _x():
+    rng = np.random.RandomState(7)
+    return rng.randn(2, 16, 4, 8).astype(np.float32)
+
+
+def test_matches_complex_reference():
+    x = _x()
+    cos, sin = precompute_freqs_cis(8, 32)
+    out = apply_rotary_emb(jnp.asarray(x), cos, sin)
+    np.testing.assert_allclose(out, reference_complex_rope(x, 32), atol=1e-5)
+
+
+def test_rope_scaling():
+    x = _x()
+    cos, sin = precompute_freqs_cis(8, 32, scaling_factor=4.0)
+    out = apply_rotary_emb(jnp.asarray(x), cos, sin)
+    np.testing.assert_allclose(
+        out, reference_complex_rope(x, 32, scaling=4.0), atol=1e-5
+    )
+
+
+def test_position_ids():
+    x = _x()
+    rng = np.random.RandomState(3)
+    pos = rng.randint(0, 32, size=(2, 16))
+    cos, sin = precompute_freqs_cis(8, 32)
+    out = apply_rotary_emb(jnp.asarray(x), cos, sin, jnp.asarray(pos))
+    np.testing.assert_allclose(
+        out, reference_complex_rope(x, 32, position_ids=pos), atol=1e-5
+    )
+
+
+def test_norm_preserved():
+    # rotation must preserve pairwise norms
+    x = _x()
+    cos, sin = precompute_freqs_cis(8, 32)
+    out = np.asarray(apply_rotary_emb(jnp.asarray(x), cos, sin))
+    n_in = np.linalg.norm(x.reshape(2, 16, 4, 4, 2), axis=-1)
+    n_out = np.linalg.norm(out.reshape(2, 16, 4, 4, 2), axis=-1)
+    np.testing.assert_allclose(n_in, n_out, atol=1e-4)
