@@ -1,0 +1,234 @@
+"""Pipeline-parallel engine: a compiled 1F1B-class schedule over the ``pp``
+mesh axis.
+
+Reference: ``megatron/schedules.py`` (1F1B :606-722, interleaved :253-502)
++ ``megatron/p2p_communication.py`` (batched NCCL isend/irecv :101-251) +
+layer-to-stage assignment (``megatron/model/transformer.py:1045-1090``) +
+embedding-tie grad sync across first/last stages
+(``megatron/optimizer/optimizer.py:203-229``).
+
+TPU re-design — none of that machinery survives translation:
+
+* The schedule is a **single jitted ``lax.scan`` over pipeline ticks**
+  inside a ``shard_map`` that is *manual over pp only* (dp/tp stay under
+  GSPMD, so tensor-parallel collectives inside each stage remain
+  compiler-placed).  Tick ``t``: stage 0 ingests microbatch ``t``'s
+  embedded activations; every stage applies its layer block;
+  ``lax.ppermute`` rotates activations to the next stage over ICI (the
+  p2p isend/irecv replacement); each stage's per-tick output is emitted
+  as scan ``ys`` — the last stage's emissions, re-indexed, are the
+  completed microbatches.
+* **Embedding and LM head run outside the shard_map** under plain GSPMD:
+  all microbatches are embedded up front and the head consumes the
+  stacked last-stage outputs.  This is both the robust partitioning path
+  (XLA's gather partitioner dislikes vocab-sharded gathers under a
+  manual submesh) and good MXU shape hygiene (one big [M*mb*s, h] x
+  [h, V] matmul instead of M small ones).
+* **Backward is autodiff through the scan**: the transpose of ``ppermute``
+  is the reverse rotation, so XLA derives the backward pipeline
+  (warmup/cooldown) mechanically; fwd/bwd interleaving — the point of
+  1F1B — is XLA scheduling freedom.  Per-tick ``jax.checkpoint`` bounds
+  live activations to one carry per tick plus the emitted last-stage
+  outputs, the same asymptotics as 1F1B's activation stash.
+* **Embedding tie**: the word embedding is one logical parameter used at
+  ingest (lookup) and by the head (logits); its gradient sums both uses
+  by linearity — the reference's embedding-group all-reduce
+  (optimizer.py:203-229) has no analogue to write.
+
+Layer-to-stage assignment is a *sharding spec*, not code: the stacked
+layer axis [L, ...] is sharded over pp, giving each stage the contiguous
+block of L/pp layers (transformer.py:1045-1090 semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from megatron_llm_tpu import topology
+from megatron_llm_tpu.config import TransformerConfig
+from megatron_llm_tpu.models.language_model import embedding_forward
+from megatron_llm_tpu.models.transformer import rotary_freqs, transformer_layer
+from megatron_llm_tpu.ops.cross_entropy import vocab_parallel_cross_entropy
+from megatron_llm_tpu.ops.layernorm import apply_norm
+from megatron_llm_tpu.parallel.layers import parallel_lm_logits
+from megatron_llm_tpu.parallel.sharding import constrain
+
+
+def build_pipeline_loss_fn(
+    model,
+    pp_size: int,
+    num_microbatches: int,
+    *,
+    num_virtual: int = 1,
+    sequence_parallel: bool = False,
+):
+    """Returns ``loss_fn(params, batch, rng_key, scale) -> (scaled_loss, loss)``
+    computing the full pipelined global-batch loss.
+
+    ``batch``: dict with tokens/labels/loss_mask of shape [M, mb, s].
+    ``params``: the standard model pytree; ``transformer.layers`` leaves
+    (leading axis L) must be sharded over pp (logical axis 'stage').
+    """
+    cfg: TransformerConfig = model.cfg
+    S = pp_size
+    V = num_virtual
+    M = num_microbatches
+    L = cfg.num_layers
+    if V > 1:
+        raise NotImplementedError(
+            "interleaved virtual pipeline (VPP>1) requires per-stage "
+            "multi-buffer chunk scheduling; planned — use VPP=1"
+        )
+    assert L % S == 0, f"num_layers ({L}) must divide pp ({S})"
+    chunk = L // S
+    T = M + S - 1  # pipeline ticks
+
+    train_has_dropout = cfg.hidden_dropout > 0.0 or cfg.attention_dropout > 0.0
+
+    def loss_fn(params, batch, rng_key, scale=1.0, train: bool = True):
+        mesh = topology.get_mesh()
+        emb_p = params["embedding"]
+        trans = params["transformer"]
+        head_w = (
+            params["lm_head"]["weight"]
+            if "lm_head" in params
+            else emb_p["word"]["embedding"]
+        )
+        freqs = rotary_freqs(cfg)
+        tokens, labels, loss_mask = (
+            batch["tokens"], batch["labels"], batch["loss_mask"],
+        )
+        mb, s = tokens.shape[1], tokens.shape[2]
+        use_dropout = train and train_has_dropout
+
+        # ---- embed all microbatches under plain GSPMD -------------------
+        def embed_one(toks, key):
+            return embedding_forward(
+                toks, None, emb_p, cfg,
+                rng_key=key if use_dropout else None, train=use_dropout,
+            )
+
+        emb_keys = jax.random.split(jax.random.fold_in(rng_key, 1), M)
+        h_all = jax.vmap(embed_one)(tokens, emb_keys)  # [M, mb, s, h]
+        h_all = h_all.astype(cfg.compute_jnp_dtype)
+
+        # ---- pipelined stack under shard_map(manual pp) -----------------
+        def shmap_fn(layers_local, h_all, rng_key):
+            pp_rank = lax.axis_index("pp")
+            is_first = pp_rank == 0
+
+            def run_chunk(h, tick_key):
+                def layer_body(carry, i):
+                    lp = jax.tree_util.tree_map(
+                        lambda x: lax.dynamic_index_in_dim(x, i, 0,
+                                                           keepdims=False),
+                        layers_local,
+                    )
+                    key = jax.random.fold_in(tick_key, i)
+                    out = transformer_layer(
+                        carry, lp, cfg,
+                        freqs=freqs, attention_mask=None, position_ids=None,
+                        rng_key=key if use_dropout else None,
+                        train=use_dropout,
+                        sequence_parallel=sequence_parallel,
+                    )
+                    return out, None
+
+                h, _ = lax.scan(layer_body, h, jnp.arange(chunk))
+                return h
+
+            def tick(carry, t):
+                act = carry
+                tick_key = jax.random.fold_in(jax.random.fold_in(rng_key, 2), t)
+                m_in = jnp.clip(t, 0, M - 1)
+                h_in = lax.dynamic_index_in_dim(h_all, m_in, 0, keepdims=False)
+                inp = jnp.where(is_first, h_in, act)
+                out = run_chunk(inp, tick_key)
+                act_next = lax.ppermute(
+                    out, "pp", [(i, (i + 1) % S) for i in range(S)]
+                )
+                return act_next, out
+
+            tick_fn = jax.checkpoint(
+                tick, policy=jax.checkpoint_policies.nothing_saveable
+            )
+            act0 = jnp.zeros((mb, s, cfg.hidden_size), cfg.compute_jnp_dtype)
+            _, outs = lax.scan(tick_fn, act0, jnp.arange(T))
+            return outs  # [T, mb, s, h] per stage
+
+        layer_in_spec = jax.tree_util.tree_map(lambda _: P("pp"),
+                                               trans["layers"])
+        outs = jax.shard_map(
+            shmap_fn,
+            mesh=mesh,
+            in_specs=(layer_in_spec, P(), P()),
+            out_specs=P("pp"),            # stacked: [S*T, mb, s, h]
+            axis_names={"pp"},
+            check_vma=False,
+        )(trans["layers"], h_all, rng_key)
+
+        # last stage's emissions, ticks S-1 .. T-1 == microbatches 0..M-1
+        last = lax.slice_in_dim(outs, (S - 1) * T + (S - 1), S * T, axis=0)
+        # [M, mb, s, h]
+
+        # ---- final norm + head + CE under plain GSPMD -------------------
+        h_fin = apply_norm(
+            last, trans["final_norm"], cfg.normalization,
+            eps=cfg.layernorm_epsilon, fp32_compute=cfg.norm_in_fp32,
+        )
+        logits = parallel_lm_logits(
+            h_fin.reshape(M * mb, s, -1), head_w,
+            sequence_parallel=False,
+            compute_dtype=cfg.compute_jnp_dtype,
+        )
+        loss_tok = vocab_parallel_cross_entropy(
+            logits.astype(jnp.float32), labels.reshape(M * mb, s)
+        )
+        lm = loss_mask.reshape(M * mb, s).astype(jnp.float32)
+        loss = jnp.sum(loss_tok * lm) / jnp.maximum(jnp.sum(lm), 1.0)
+        return loss * scale, loss
+
+    return loss_fn
+
+
+def build_pipeline_train_step(
+    model,
+    optimizer,
+    parallel_cfg,
+    num_microbatches: int,
+):
+    """Pipelined analogue of ``training.build_train_step``: full global batch
+    through the pipeline, then the functional optimizer step."""
+    pp = parallel_cfg.pipeline_model_parallel_size
+    vpp = parallel_cfg.virtual_pipeline_model_parallel_size or 1
+    loss_fn = build_pipeline_loss_fn(
+        model, pp, num_microbatches,
+        num_virtual=vpp,
+        sequence_parallel=parallel_cfg.sequence_parallel,
+    )
+
+    def train_step(params, opt_state, batch, rng_key, lr, wd):
+        scale = opt_state.grad_scaler.scale
+
+        def scaled_loss(p):
+            return loss_fn(p, batch, rng_key, scale)
+
+        (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
+        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        new_params, new_opt_state, stats = optimizer.step(
+            params, grads, opt_state, lr, wd
+        )
+        metrics = {
+            "lm loss": loss,
+            "grad_norm": stats["grad_norm"],
+            "loss_scale": stats["loss_scale"],
+            "skipped_iter": stats["found_inf"].astype(jnp.int32),
+        }
+        return new_params, new_opt_state, metrics
+
+    return jax.jit(train_step, donate_argnums=(0, 1))
