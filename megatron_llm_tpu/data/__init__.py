@@ -1,0 +1,28 @@
+"""Data pipeline: memory-mapped token datasets, packing, blending, samplers.
+
+Reference: ``megatron/data/`` — ``indexed_dataset.py`` (mmap bin/idx),
+``gpt_dataset.py`` (packed GPT samples with cached index triples),
+``instruction_dataset.py``, ``blendable_dataset.py``, ``data_samplers.py``,
+and the C++ index builders in ``helpers.cpp``.
+
+The C++ helpers here (``megatron_llm_tpu/data/helpers.cpp``) are a fresh
+implementation of the same O(tokens) index-building loops, exposed through
+ctypes (no pybind11 dependency), with pure-numpy fallbacks.
+"""
+
+from megatron_llm_tpu.data.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    best_fitting_dtype,
+    make_dataset,
+)
+from megatron_llm_tpu.data.gpt_dataset import (
+    GPTDataset,
+    build_train_valid_test_datasets,
+)
+from megatron_llm_tpu.data.blendable_dataset import BlendableDataset
+from megatron_llm_tpu.data.data_samplers import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+    build_pretraining_data_loader,
+)

@@ -1,0 +1,108 @@
+// Native dataset index builders.
+//
+// Capability parity with the reference's pybind11 module
+// `megatron/data/helpers.cpp` (build_sample_idx :83, build_blending_indices
+// :20): the O(total-tokens) loops that are too slow in Python for
+// billion-token corpora.  Fresh implementation, exported with a C ABI and
+// bound via ctypes (no pybind11 in the image).
+//
+// Build: `make` in this directory (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstdio>
+
+extern "C" {
+
+// Map sample i -> (document-index position, token offset) pairs for packed
+// GPT samples of exactly `seq_length` tokens (+1 for the shifted label),
+// crossing document boundaries.  Output buffer sample_idx must hold
+// 2*(num_samples+1) int64.
+//
+// sizes:    per-sequence token counts               [num_seqs]
+// doc_idx:  epoch-shuffled document order           [num_docs_total]
+//           (values index into sizes)
+// Returns the number of samples written (== num_samples).
+int64_t build_sample_idx(const int32_t* sizes,
+                         const int64_t* doc_idx,
+                         int64_t num_docs_total,
+                         int32_t seq_length,
+                         int64_t num_samples,
+                         int64_t* sample_idx) {
+  int64_t sample = 0;
+  int64_t di = 0;       // position in doc_idx
+  int64_t offset = 0;   // token offset within current document
+  sample_idx[0] = 0;
+  sample_idx[1] = 0;
+  while (sample < num_samples) {
+    // consume seq_length + 1 tokens (labels are inputs shifted by one)
+    int64_t remaining = seq_length + 1;
+    while (remaining > 0 && di < num_docs_total) {
+      int64_t doc_len = sizes[doc_idx[di]] - offset;
+      if (doc_len > remaining) {
+        offset += remaining - 1;  // last token reused as next sample's first
+        remaining = 0;
+      } else {
+        remaining -= doc_len;
+        ++di;
+        offset = 0;
+        if (remaining == 0 && di <= num_docs_total) {
+          // sample ended exactly at a document boundary; back up one token
+          // so the next sample overlaps by one (label/input shift)
+          --di;
+          offset = sizes[doc_idx[di]] - 1;
+        }
+      }
+    }
+    ++sample;
+    sample_idx[2 * sample] = di;
+    sample_idx[2 * sample + 1] = offset;
+    if (di >= num_docs_total && sample < num_samples) {
+      return sample;  // ran out of tokens (caller sized num_samples wrong)
+    }
+  }
+  return sample;
+}
+
+// Greedy proportional interleave of `num_datasets` datasets with the given
+// weights over `size` output samples (reference: build_blending_indices).
+// dataset_index: uint8[size] out; dataset_sample_index: int64[size] out.
+void build_blending_indices(uint8_t* dataset_index,
+                            int64_t* dataset_sample_index,
+                            const double* weights,
+                            int32_t num_datasets,
+                            int64_t size,
+                            int32_t verbose) {
+  int64_t* current_samples = new int64_t[num_datasets]();
+  for (int64_t i = 0; i < size; ++i) {
+    // pick the dataset furthest behind its target fraction
+    double max_error = -1.0;
+    int32_t max_idx = 0;
+    for (int32_t d = 0; d < num_datasets; ++d) {
+      double error =
+          weights[d] * static_cast<double>(i + 1) -
+          static_cast<double>(current_samples[d]);
+      if (error > max_error) {
+        max_error = error;
+        max_idx = d;
+      }
+    }
+    dataset_index[i] = static_cast<uint8_t>(max_idx);
+    dataset_sample_index[i] = current_samples[max_idx];
+    ++current_samples[max_idx];
+  }
+  if (verbose) {
+    std::fprintf(stderr, "blending indices built for %lld samples over %d datasets\n",
+                 static_cast<long long>(size), num_datasets);
+  }
+  delete[] current_samples;
+}
+
+// Shuffle-invariant exact-epoch token count: sum of sizes over doc_idx.
+int64_t total_tokens(const int32_t* sizes, const int64_t* doc_idx,
+                     int64_t num_docs) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < num_docs; ++i) total += sizes[doc_idx[i]];
+  return total;
+}
+
+}  // extern "C"

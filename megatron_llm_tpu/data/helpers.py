@@ -1,0 +1,152 @@
+"""ctypes bindings for the native index helpers, with numpy fallbacks.
+
+Reference: ``megatron/data/helpers.cpp`` (pybind11) imported at
+``gpt_dataset.py:354-357``; the reference also ships a pure-Python fallback
+for ``build_sample_idx`` (``gpt_dataset.py:445-492``) — same structure here.
+The shared object is built on demand by ``make`` the first time it's needed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libhelpers.so")
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(["make", "-C", _HERE], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+        lib.build_sample_idx.restype = ctypes.c_int64
+        lib.build_sample_idx.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.build_blending_indices.restype = None
+        lib.build_blending_indices.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_int32,
+        ]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def build_sample_idx(
+    sizes: np.ndarray,
+    doc_idx: np.ndarray,
+    seq_length: int,
+    num_samples: int,
+) -> np.ndarray:
+    """[num_samples+1, 2] array of (doc_idx position, token offset)."""
+    sizes = np.ascontiguousarray(sizes, np.int32)
+    doc_idx = np.ascontiguousarray(doc_idx, np.int64)
+    out = np.zeros((num_samples + 1, 2), np.int64)
+    lib = _load()
+    if lib is not None:
+        written = lib.build_sample_idx(
+            _ptr(sizes, ctypes.c_int32),
+            _ptr(doc_idx, ctypes.c_int64),
+            len(doc_idx),
+            seq_length,
+            num_samples,
+            _ptr(out, ctypes.c_int64),
+        )
+        if written != num_samples:
+            raise RuntimeError(
+                f"build_sample_idx exhausted tokens at sample {written} "
+                f"(< {num_samples})"
+            )
+        return out
+    return _build_sample_idx_py(sizes, doc_idx, seq_length, num_samples)
+
+
+def _build_sample_idx_py(sizes, doc_idx, seq_length, num_samples):
+    """Pure-python fallback (reference: gpt_dataset.py:445-492)."""
+    out = np.zeros((num_samples + 1, 2), np.int64)
+    di, offset = 0, 0
+    for sample in range(1, num_samples + 1):
+        remaining = seq_length + 1
+        while remaining > 0:
+            if di >= len(doc_idx):
+                raise RuntimeError(
+                    f"build_sample_idx exhausted tokens at sample {sample - 1}"
+                )
+            doc_len = sizes[doc_idx[di]] - offset
+            if doc_len > remaining:
+                offset += remaining - 1
+                remaining = 0
+            else:
+                remaining -= doc_len
+                di += 1
+                offset = 0
+                if remaining == 0:
+                    di -= 1
+                    offset = sizes[doc_idx[di]] - 1
+        out[sample, 0] = di
+        out[sample, 1] = offset
+    return out
+
+
+def build_blending_indices(
+    weights: np.ndarray, size: int, verbose: bool = False
+):
+    """Greedy proportional interleave -> (dataset_index u8[size],
+    dataset_sample_index i64[size])."""
+    weights = np.ascontiguousarray(weights, np.float64)
+    ds_index = np.zeros(size, np.uint8)
+    ds_sample = np.zeros(size, np.int64)
+    lib = _load()
+    if lib is not None:
+        lib.build_blending_indices(
+            _ptr(ds_index, ctypes.c_uint8),
+            _ptr(ds_sample, ctypes.c_int64),
+            _ptr(weights, ctypes.c_double),
+            len(weights),
+            size,
+            int(verbose),
+        )
+        return ds_index, ds_sample
+    # numpy fallback
+    current = np.zeros(len(weights), np.int64)
+    for i in range(size):
+        err = weights * (i + 1) - current
+        d = int(np.argmax(err))
+        ds_index[i] = d
+        ds_sample[i] = current[d]
+        current[d] += 1
+    return ds_index, ds_sample
+
+
+def using_native() -> bool:
+    return _load() is not None

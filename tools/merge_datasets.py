@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Merge multiple mmap datasets with the same dtype into one
+(reference: tools/merge_datasets.py)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_llm_tpu.data.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    data_file_path,
+    index_file_path,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", nargs="+", required=True,
+                   help="dataset prefixes to merge, in order")
+    p.add_argument("--output_prefix", "--output-prefix",
+                   dest="output_prefix", required=True)
+    args = p.parse_args()
+
+    first = MMapIndexedDataset(args.input[0])
+    builder = MMapIndexedDatasetBuilder(
+        data_file_path(args.output_prefix), dtype=first.dtype
+    )
+    for prefix in args.input:
+        builder.merge_file_(prefix)
+        print(f" merged {prefix}")
+    builder.finalize(index_file_path(args.output_prefix))
+    print(f" done -> {args.output_prefix}.bin/.idx")
+
+
+if __name__ == "__main__":
+    main()

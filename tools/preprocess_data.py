@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""jsonl -> mmap indexed dataset (multi-process).
+
+Reference: ``tools/preprocess_data.py`` — reads a jsonl with one document
+per line, tokenizes (optionally splitting sentences / appending EOD), and
+writes the (bin, idx) pair with worker parallelism.
+"""
+
+import argparse
+import json
+import multiprocessing
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_llm_tpu.data.indexed_dataset import (
+    MMapIndexedDatasetBuilder,
+    best_fitting_dtype,
+    data_file_path,
+    index_file_path,
+)
+from megatron_llm_tpu.tokenizer import build_tokenizer
+
+_TOKENIZER = None
+_ARGS = None
+
+
+def _init_worker(args):
+    global _TOKENIZER, _ARGS
+    _ARGS = args
+    _TOKENIZER = build_tokenizer(args)
+
+
+def _encode(line):
+    line = line.strip()
+    if not line:
+        return None, 0
+    doc = json.loads(line)
+    text = doc[_ARGS.json_key]
+    ids = _TOKENIZER.tokenize(text)
+    if _ARGS.append_eod:
+        ids = list(ids) + [_TOKENIZER.eod]
+    return ids, len(line)
+
+
+def get_args():
+    p = argparse.ArgumentParser()
+    g = p.add_argument_group("input data")
+    g.add_argument("--input", required=True, help="jsonl input path")
+    g.add_argument("--json_key", "--json-keys", dest="json_key",
+                   default="text")
+    g = p.add_argument_group("tokenizer")
+    g.add_argument("--tokenizer_type", "--tokenizer-type",
+                   dest="tokenizer_type", required=True,
+                   choices=["GPT2BPETokenizer", "SentencePieceTokenizer",
+                            "FalconTokenizer", "HFAutoTokenizer",
+                            "BertWordPieceLowerCase", "BertWordPieceCase",
+                            "NullTokenizer"])
+    g.add_argument("--vocab_file", "--vocab-file", dest="vocab_file")
+    g.add_argument("--merge_file", "--merge-file", dest="merge_file")
+    g.add_argument("--tokenizer_path", dest="tokenizer_path")
+    g.add_argument("--vocab_size", type=int, default=None)
+    g.add_argument("--append_eod", "--append-eod", dest="append_eod",
+                   action="store_true")
+    g = p.add_argument_group("output")
+    g.add_argument("--output_prefix", "--output-prefix",
+                   dest="output_prefix", required=True)
+    g.add_argument("--workers", type=int, default=1)
+    g.add_argument("--log_interval", type=int, default=10000)
+    args = p.parse_args()
+    args.make_vocab_size_divisible_by = 128
+    args.tensor_model_parallel_size = 1
+    args.rank = 0
+    return args
+
+
+def main():
+    args = get_args()
+    _init_worker(args)
+    vocab_size = _TOKENIZER.vocab_size
+    builder = MMapIndexedDatasetBuilder(
+        data_file_path(args.output_prefix),
+        dtype=best_fitting_dtype(vocab_size),
+    )
+    t0 = time.time()
+    n_docs = n_bytes = 0
+    with open(args.input, "r", encoding="utf-8") as f:
+        if args.workers > 1:
+            pool = multiprocessing.Pool(
+                args.workers, initializer=_init_worker, initargs=(args,)
+            )
+            encoded = pool.imap(_encode, f, chunksize=32)
+        else:
+            encoded = (_encode(line) for line in f)
+        for ids, nb in encoded:
+            if ids is None:
+                continue
+            builder.add_item(ids)
+            builder.end_document()
+            n_docs += 1
+            n_bytes += nb
+            if n_docs % args.log_interval == 0:
+                el = time.time() - t0
+                print(f" processed {n_docs} documents "
+                      f"({n_docs / el:.1f} docs/s, "
+                      f"{n_bytes / el / 1024 / 1024:.2f} MB/s)", flush=True)
+    builder.finalize(index_file_path(args.output_prefix))
+    print(f" done: {n_docs} documents -> {args.output_prefix}.bin/.idx")
+
+
+if __name__ == "__main__":
+    main()
