@@ -1,0 +1,428 @@
+"""Argparse CLI surface.
+
+Reference: ``megatron/arguments.py`` (1,103 LoC, 225 flags across 16
+``_add_*_args`` groups, ~350 lines of ``validate_args`` cross-derivation).
+The flag *names* are kept so reference launch scripts carry over with
+``--device=tpu`` (BASELINE.json north star); the grouping/derivations are
+re-written for this framework.  Flags that are CUDA-implementation details
+(``--masked_softmax_fusion``, ``--gradient_accumulation_fusion``, nvFuser
+toggles, ``CUDA_DEVICE_MAX_CONNECTIONS`` checks, arguments.py:337-347) are
+accepted-and-ignored for compatibility: XLA owns fusion and program order
+on TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Callable, Optional
+
+from megatron_llm_tpu.config import ParallelConfig, TrainConfig, TransformerConfig
+
+
+def build_base_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="megatron_llm_tpu arguments", allow_abbrev=False
+    )
+    _add_network_size_args(parser)
+    _add_regularization_args(parser)
+    _add_training_args(parser)
+    _add_initialization_args(parser)
+    _add_learning_rate_args(parser)
+    _add_checkpointing_args(parser)
+    _add_mixed_precision_args(parser)
+    _add_distributed_args(parser)
+    _add_validation_args(parser)
+    _add_data_args(parser)
+    _add_logging_args(parser)
+    _add_inference_args(parser)
+    _add_compat_noop_args(parser)
+    return parser
+
+
+def parse_args(
+    extra_args_provider: Optional[Callable] = None,
+    args_defaults: Optional[dict] = None,
+    ignore_unknown_args: bool = False,
+    args_list=None,
+):
+    """Reference: arguments.py:38 ``parse_args`` + entry-point extension
+    hook (finetune.py:242-254)."""
+    parser = build_base_parser()
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+    if ignore_unknown_args:
+        args, _ = parser.parse_known_args(args_list)
+    else:
+        args = parser.parse_args(args_list)
+    if args_defaults:
+        for k, v in args_defaults.items():
+            if getattr(args, k, None) is None:
+                setattr(args, k, v)
+    return args
+
+
+# ---------------------------------------------------------------------------
+# groups
+# ---------------------------------------------------------------------------
+
+def _add_network_size_args(parser):
+    g = parser.add_argument_group("network size")
+    g.add_argument("--num_layers", type=int, default=None)
+    g.add_argument("--hidden_size", type=int, default=None)
+    g.add_argument("--ffn_hidden_size", type=int, default=None)
+    g.add_argument("--num_attention_heads", type=int, default=None)
+    g.add_argument("--num_attention_heads_kv", type=int, default=None)
+    g.add_argument("--kv_channels", type=int, default=None)
+    g.add_argument("--seq_length", type=int, default=None)
+    g.add_argument("--max_position_embeddings", type=int, default=None)
+    g.add_argument("--make_vocab_size_divisible_by", type=int, default=128)
+    g.add_argument("--padded_vocab_size", type=int, default=None)
+    g.add_argument("--position_embedding_type", type=str, default="learned_absolute",
+                   choices=["learned_absolute", "rotary"])
+    g.add_argument("--rope_scaling_factor", type=float, default=1.0)
+    g.add_argument("--rope_theta", type=float, default=10000.0)
+    g.add_argument("--layernorm_epsilon", type=float, default=1e-5)
+    g.add_argument("--use_rms_norm", action="store_true")
+    g.add_argument("--use_post_ln", action="store_true")
+    g.add_argument("--glu_activation", type=str, default=None,
+                   choices=[None, "liglu", "geglu", "reglu", "swiglu"])
+    g.add_argument("--no_bias", action="store_false", dest="use_bias")
+    g.add_argument("--parallel_attn", action="store_true")
+    g.add_argument("--parallel_layernorm", action="store_true")
+    g.add_argument("--sliding_window_size", type=int, default=None)
+    g.add_argument("--no_tie_embed_logits", action="store_false",
+                   dest="tie_embed_logits")
+    g.add_argument("--onnx_safe", action="store_true")  # compat
+
+
+def _add_regularization_args(parser):
+    g = parser.add_argument_group("regularization")
+    g.add_argument("--attention_dropout", type=float, default=0.1)
+    g.add_argument("--hidden_dropout", type=float, default=0.1)
+    g.add_argument("--lima_dropout", action="store_true")
+    g.add_argument("--weight_decay", type=float, default=0.01)
+    g.add_argument("--start_weight_decay", type=float, default=None)
+    g.add_argument("--end_weight_decay", type=float, default=None)
+    g.add_argument("--weight_decay_incr_style", default="constant",
+                   choices=["constant", "linear", "cosine"])
+    g.add_argument("--clip_grad", type=float, default=1.0)
+    g.add_argument("--adam_beta1", type=float, default=0.9)
+    g.add_argument("--adam_beta2", type=float, default=0.999)
+    g.add_argument("--adam_eps", type=float, default=1e-8)
+    g.add_argument("--sgd_momentum", type=float, default=0.9)
+
+
+def _add_training_args(parser):
+    g = parser.add_argument_group("training")
+    g.add_argument("--micro_batch_size", type=int, default=1)
+    g.add_argument("--global_batch_size", type=int, default=None)
+    g.add_argument("--rampup_batch_size", nargs=3, type=int, default=None)
+    g.add_argument("--train_iters", type=int, default=None)
+    g.add_argument("--train_samples", type=int, default=None)
+    g.add_argument("--exit_interval", type=int, default=None)
+    g.add_argument("--exit_duration_in_mins", type=int, default=None)
+    g.add_argument("--exit_signal_handler", action="store_true")
+    g.add_argument("--optimizer", default="adam", choices=["adam", "sgd"])
+    g.add_argument("--dataloader_type", default="single",
+                   choices=["single", "cyclic"])
+    g.add_argument("--recompute_granularity", default=None,
+                   choices=[None, "full", "uniform", "block", "selective"])
+    g.add_argument("--recompute_num_layers", type=int, default=1)
+    g.add_argument("--skip_iters", type=int, nargs="*", default=[])
+    g.add_argument("--use_flash_attn", action="store_true", default=True)
+    g.add_argument("--no_flash_attn", action="store_false",
+                   dest="use_flash_attn")
+
+
+def _add_initialization_args(parser):
+    g = parser.add_argument_group("initialization")
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--data_parallel_random_init", action="store_true")
+    g.add_argument("--init_method_std", type=float, default=0.02)
+
+
+def _add_learning_rate_args(parser):
+    g = parser.add_argument_group("learning rate")
+    g.add_argument("--lr", type=float, default=None)
+    g.add_argument("--lr_decay_style", default="linear",
+                   choices=["constant", "linear", "cosine",
+                            "inverse-square-root"])
+    g.add_argument("--lr_decay_iters", type=int, default=None)
+    g.add_argument("--lr_decay_samples", type=int, default=None)
+    g.add_argument("--lr_warmup_fraction", type=float, default=None)
+    g.add_argument("--lr_warmup_iters", type=int, default=0)
+    g.add_argument("--lr_warmup_samples", type=int, default=0)
+    g.add_argument("--min_lr", type=float, default=0.0)
+    g.add_argument("--override_opt_param_scheduler", action="store_true")
+    g.add_argument("--use_checkpoint_opt_param_scheduler", action="store_true")
+
+
+def _add_checkpointing_args(parser):
+    g = parser.add_argument_group("checkpointing")
+    g.add_argument("--save", type=str, default=None)
+    g.add_argument("--save_interval", type=int, default=None)
+    g.add_argument("--no_save_optim", action="store_true")
+    g.add_argument("--no_save_rng", action="store_true")
+    g.add_argument("--load", type=str, default=None)
+    g.add_argument("--no_load_optim", action="store_true")
+    g.add_argument("--no_load_rng", action="store_true")
+    g.add_argument("--finetune", action="store_true")
+    g.add_argument("--use_checkpoint_args", action="store_true")
+
+
+def _add_mixed_precision_args(parser):
+    g = parser.add_argument_group("mixed precision")
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss_scale", type=float, default=None)
+    g.add_argument("--initial_loss_scale", type=float, default=2.0 ** 32)
+    g.add_argument("--min_loss_scale", type=float, default=1.0)
+    g.add_argument("--loss_scale_window", type=int, default=1000)
+    g.add_argument("--hysteresis", type=int, default=2)
+    g.add_argument("--accumulate_allreduce_grads_in_fp32",
+                   action="store_true", default=True)
+
+
+def _add_distributed_args(parser):
+    g = parser.add_argument_group("distributed")
+    g.add_argument("--tensor_model_parallel_size", type=int, default=1)
+    g.add_argument("--pipeline_model_parallel_size", type=int, default=1)
+    g.add_argument("--num_layers_per_virtual_pipeline_stage", type=int,
+                   default=None)
+    g.add_argument("--sequence_parallel", action="store_true")
+    g.add_argument("--use_distributed_optimizer", action="store_true")
+    g.add_argument("--expert_model_parallel_size", type=int, default=1)
+    g.add_argument("--distributed_backend", default="xla",
+                   choices=["xla", "nccl", "gloo"])  # nccl/gloo accepted, mapped to xla
+    g.add_argument("--device", default="tpu", choices=["tpu", "cpu"])
+    g.add_argument("--local_rank", type=int, default=None)  # compat
+
+
+def _add_validation_args(parser):
+    g = parser.add_argument_group("validation")
+    g.add_argument("--eval_iters", type=int, default=100)
+    g.add_argument("--eval_interval", type=int, default=1000)
+    g.add_argument("--metrics", nargs="*", default=[])
+
+
+def _add_data_args(parser):
+    g = parser.add_argument_group("data")
+    g.add_argument("--data_path", nargs="*", default=None)
+    g.add_argument("--split", type=str, default="969,30,1")
+    g.add_argument("--train_data_path", nargs="*", default=None)
+    g.add_argument("--valid_data_path", nargs="*", default=None)
+    g.add_argument("--test_data_path", nargs="*", default=None)
+    g.add_argument("--data_impl", default="mmap")
+    g.add_argument("--mmap_warmup", action="store_true")
+    g.add_argument("--num_workers", type=int, default=2)
+    g.add_argument("--tokenizer_type", type=str, default=None)
+    g.add_argument("--vocab_file", type=str, default=None)
+    g.add_argument("--merge_file", type=str, default=None)
+    g.add_argument("--tokenizer_path", type=str, default=None)
+    g.add_argument("--vocab_size", type=int, default=None)
+    g.add_argument("--vocab_extra_ids", type=int, default=0)
+    g.add_argument("--no_new_tokens", action="store_false", dest="new_tokens")
+    g.add_argument("--variable_seq_lengths", action="store_true")
+    g.add_argument("--scalar_loss_mask", type=float, default=0.0)
+    g.add_argument("--data_type", default="gpt", choices=["gpt", "instruction"])
+    g.add_argument("--reset_position_ids", action="store_true")
+    g.add_argument("--reset_attention_mask", action="store_true")
+    g.add_argument("--eod_mask_loss", action="store_true")
+
+
+def _add_logging_args(parser):
+    g = parser.add_argument_group("logging")
+    g.add_argument("--log_interval", type=int, default=100)
+    g.add_argument("--log_timers_to_tensorboard", action="store_true")
+    g.add_argument("--timing_log_level", type=int, default=0, choices=[0, 1, 2])
+    g.add_argument("--tensorboard_dir", type=str, default=None)
+    g.add_argument("--wandb_logger", action="store_true")
+    g.add_argument("--wandb_project", type=str, default=None)
+    g.add_argument("--wandb_entity", type=str, default=None)
+    g.add_argument("--wandb_name", type=str, default=None)
+    g.add_argument("--wandb_id", type=str, default=None)
+    g.add_argument("--wandb_api_key", type=str, default=None)
+
+
+def _add_inference_args(parser):
+    g = parser.add_argument_group("inference")
+    g.add_argument("--inference_batch_times_seqlen_threshold", type=int,
+                   default=512)
+    g.add_argument("--max_tokens_to_oom", type=int, default=12000)
+
+
+def _add_compat_noop_args(parser):
+    """Reference flags that are CUDA implementation details — accepted and
+    ignored so A100 launch scripts run unchanged."""
+    g = parser.add_argument_group("compat (ignored on TPU)")
+    g.add_argument("--masked_softmax_fusion", action="store_true")
+    g.add_argument("--no_masked_softmax_fusion", action="store_false",
+                   dest="masked_softmax_fusion")
+    g.add_argument("--bias_gelu_fusion", action="store_true")
+    g.add_argument("--no_bias_gelu_fusion", action="store_false",
+                   dest="bias_gelu_fusion")
+    g.add_argument("--bias_dropout_fusion", action="store_true")
+    g.add_argument("--no_bias_dropout_fusion", action="store_false",
+                   dest="bias_dropout_fusion")
+    g.add_argument("--gradient_accumulation_fusion", action="store_true")
+    g.add_argument("--DDP_impl", default="local", choices=["local", "torch"])
+    g.add_argument("--use_ring_exchange_p2p", action="store_true")
+    g.add_argument("--empty_unused_memory_level", type=int, default=0)
+    g.add_argument("--transformer_impl", default="local")
+    g.add_argument("--fp8_e4m3", action="store_true")
+    g.add_argument("--fp8_hybrid", action="store_true")
+
+
+# ---------------------------------------------------------------------------
+# validation / derivation
+# ---------------------------------------------------------------------------
+
+def validate_args(args, world_size: Optional[int] = None):
+    """Cross-derivations (reference: arguments.py:53-345)."""
+    import jax
+
+    if world_size is None:
+        world_size = int(os.environ.get("MEGATRON_TPU_WORLD_SIZE", 0)) or \
+            len(jax.devices())
+
+    mp = args.tensor_model_parallel_size * args.pipeline_model_parallel_size
+    assert world_size % mp == 0, (
+        f"world size ({world_size}) not divisible by tp "
+        f"({args.tensor_model_parallel_size}) x pp "
+        f"({args.pipeline_model_parallel_size})"
+    )
+    args.world_size = world_size
+    args.data_parallel_size = world_size // mp   # reference: arguments.py:76
+
+    # virtual pipeline (reference: arguments.py:121-132)
+    if args.num_layers_per_virtual_pipeline_stage is not None:
+        assert args.pipeline_model_parallel_size > 1
+        assert args.num_layers % args.pipeline_model_parallel_size == 0
+        layers_per_pipeline = (
+            args.num_layers // args.pipeline_model_parallel_size
+        )
+        assert layers_per_pipeline % args.num_layers_per_virtual_pipeline_stage == 0
+        args.virtual_pipeline_model_parallel_size = (
+            layers_per_pipeline // args.num_layers_per_virtual_pipeline_stage
+        )
+    else:
+        args.virtual_pipeline_model_parallel_size = None
+
+    # dtype policy (reference: arguments.py:134-148)
+    assert not (args.fp16 and args.bf16)
+    args.params_dtype = "fp16" if args.fp16 else "bf16" if args.bf16 else "fp32"
+
+    if args.global_batch_size is None:
+        args.global_batch_size = args.micro_batch_size * args.data_parallel_size
+    assert args.global_batch_size % (
+        args.micro_batch_size * args.data_parallel_size
+    ) == 0
+
+    if args.ffn_hidden_size is None and args.hidden_size is not None:
+        args.ffn_hidden_size = 4 * args.hidden_size
+    if args.kv_channels is None and args.hidden_size is not None:
+        args.kv_channels = args.hidden_size // args.num_attention_heads
+    if args.max_position_embeddings is None:
+        args.max_position_embeddings = args.seq_length
+    if args.num_attention_heads_kv is None:
+        args.num_attention_heads_kv = args.num_attention_heads
+
+    # lr schedule derivations
+    if args.lr_decay_iters is None and args.train_iters:
+        args.lr_decay_iters = args.train_iters
+    if args.lr_warmup_fraction is not None:
+        args.lr_warmup_iters = int(
+            args.lr_warmup_fraction * (args.lr_decay_iters or 0)
+        )
+
+    # SP requires TP > 1 (reference: arguments.py:329-335)
+    if args.sequence_parallel and args.tensor_model_parallel_size == 1:
+        args.sequence_parallel = False
+    return args
+
+
+# ---------------------------------------------------------------------------
+# lowering into config dataclasses
+# ---------------------------------------------------------------------------
+
+def transformer_config_from_args(args, model_name: Optional[str] = None
+                                 ) -> TransformerConfig:
+    return TransformerConfig(
+        num_layers=args.num_layers,
+        hidden_size=args.hidden_size,
+        num_attention_heads=args.num_attention_heads,
+        num_attention_heads_kv=args.num_attention_heads_kv,
+        ffn_hidden_size=args.ffn_hidden_size,
+        kv_channels=args.kv_channels,
+        seq_length=args.seq_length,
+        max_position_embeddings=args.max_position_embeddings,
+        padded_vocab_size=args.padded_vocab_size,
+        position_embedding_type=args.position_embedding_type,
+        rope_scaling_factor=args.rope_scaling_factor,
+        rope_theta=args.rope_theta,
+        tie_embed_logits=args.tie_embed_logits,
+        normalization="rmsnorm" if args.use_rms_norm else "layernorm",
+        layernorm_epsilon=args.layernorm_epsilon,
+        use_post_ln=args.use_post_ln,
+        glu_activation=args.glu_activation,
+        add_bias_linear=args.use_bias,
+        parallel_attn=args.parallel_attn,
+        parallel_layernorm=args.parallel_layernorm,
+        sliding_window_size=args.sliding_window_size,
+        hidden_dropout=args.hidden_dropout,
+        attention_dropout=args.attention_dropout,
+        init_method_std=args.init_method_std,
+        params_dtype=args.params_dtype,
+        compute_dtype="bf16" if args.bf16 else "fp16" if args.fp16 else "fp32",
+        recompute_granularity=args.recompute_granularity,
+        recompute_num_layers=args.recompute_num_layers,
+        lima_dropout=args.lima_dropout,
+        use_flash_attn=args.use_flash_attn,
+    )
+
+
+def train_config_from_args(args) -> TrainConfig:
+    return TrainConfig(
+        micro_batch_size=args.micro_batch_size,
+        global_batch_size=args.global_batch_size,
+        rampup_batch_size=(tuple(args.rampup_batch_size)
+                           if args.rampup_batch_size else None),
+        train_iters=args.train_iters or 0,
+        optimizer=args.optimizer,
+        lr=args.lr or 1e-4,
+        min_lr=args.min_lr,
+        lr_decay_style=args.lr_decay_style,
+        lr_decay_iters=args.lr_decay_iters,
+        lr_warmup_iters=args.lr_warmup_iters,
+        weight_decay=args.weight_decay,
+        start_weight_decay=args.start_weight_decay,
+        end_weight_decay=args.end_weight_decay,
+        weight_decay_incr_style=args.weight_decay_incr_style,
+        adam_beta1=args.adam_beta1,
+        adam_beta2=args.adam_beta2,
+        adam_eps=args.adam_eps,
+        sgd_momentum=args.sgd_momentum,
+        clip_grad=args.clip_grad,
+        fp16=args.fp16,
+        bf16=args.bf16,
+        loss_scale=args.loss_scale,
+        initial_loss_scale=args.initial_loss_scale,
+        min_loss_scale=args.min_loss_scale,
+        loss_scale_window=args.loss_scale_window,
+        hysteresis=args.hysteresis,
+        seed=args.seed,
+        data_parallel_random_init=args.data_parallel_random_init,
+    )
+
+
+def parallel_config_from_args(args) -> ParallelConfig:
+    return ParallelConfig(
+        tensor_model_parallel_size=args.tensor_model_parallel_size,
+        pipeline_model_parallel_size=args.pipeline_model_parallel_size,
+        data_parallel_size=args.data_parallel_size,
+        virtual_pipeline_model_parallel_size=args.virtual_pipeline_model_parallel_size,
+        sequence_parallel=args.sequence_parallel,
+        use_distributed_optimizer=args.use_distributed_optimizer,
+        expert_model_parallel_size=args.expert_model_parallel_size,
+    )

@@ -1,0 +1,51 @@
+"""SIGTERM-coordinated checkpoint-and-exit.
+
+Reference: ``megatron/dist_signal_handler.py:50-81`` — installs a handler
+and all-gathers the flag so every rank agrees before saving.
+
+TPU: under a single controller the decision is process-local; multi-host
+agreement uses a tiny max-reduce over hosts (the analogue of the
+reference's all_gather consensus) via ``jax.experimental.multihost_utils``.
+"""
+
+from __future__ import annotations
+
+import signal
+
+import jax
+import numpy as np
+
+
+class DistributedSignalHandler:
+    def __init__(self, sig=signal.SIGTERM):
+        self.sig = sig
+        self._received = False
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = signal.getsignal(self.sig)
+        signal.signal(self.sig, self._handler)
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev is not None:
+            signal.signal(self.sig, self._prev)
+        return False
+
+    def install(self):
+        return self.__enter__()
+
+    def _handler(self, signum, frame):
+        self._received = True
+
+    def signals_received(self) -> bool:
+        """All hosts agree (max over hosts of the local flag)."""
+        local = self._received
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            flag = multihost_utils.process_allgather(
+                np.asarray([1 if local else 0])
+            )
+            return bool(np.max(flag) > 0)
+        return local

@@ -1,0 +1,69 @@
+"""Framework initialization.
+
+Reference: ``megatron/initialize.py`` — ``initialize_megatron`` (:26-66)
+parses/validates args, sets globals, boots torch.distributed + process
+groups (:124-193), seeds RNGs per (pp, dp) rank.
+
+TPU: ``jax.distributed.initialize`` (multi-host only) + one Mesh; RNG
+seeding is key-folding (``megatron_llm_tpu/random.py``), so "set the seed"
+is just recording it in args.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from megatron_llm_tpu import arguments, global_vars, topology
+from megatron_llm_tpu.timers import Timers
+
+
+def initialize_megatron(
+    extra_args_provider: Optional[Callable] = None,
+    args_defaults: Optional[dict] = None,
+    ignore_unknown_args: bool = False,
+    args_list=None,
+):
+    """Parse + validate args, build the mesh, set globals.  Returns args."""
+    args = arguments.parse_args(
+        extra_args_provider, args_defaults, ignore_unknown_args, args_list
+    )
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    # multi-host bootstrap over DCN (no-op single host)
+    topology.initialize_distributed()
+
+    args = arguments.validate_args(args)
+
+    # tokenizer before padded vocab is needed by the model
+    tokenizer = None
+    if args.tokenizer_type is not None:
+        from megatron_llm_tpu.tokenizer import build_tokenizer
+
+        tokenizer = build_tokenizer(args)   # sets args.padded_vocab_size
+    elif args.padded_vocab_size is None and args.vocab_size is not None:
+        mult = args.make_vocab_size_divisible_by * args.tensor_model_parallel_size
+        v = args.vocab_size
+        args.padded_vocab_size = ((v + mult - 1) // mult) * mult
+
+    timers = Timers(log_level=args.timing_log_level)
+    global_vars.set_global_variables(args, tokenizer=tokenizer, timers=timers)
+
+    from megatron_llm_tpu.microbatches import build_num_microbatches_calculator
+
+    global_vars.set_num_microbatches_calculator(
+        build_num_microbatches_calculator(
+            args.global_batch_size, args.micro_batch_size,
+            args.data_parallel_size, args.rampup_batch_size,
+        )
+    )
+
+    topology.initialize_model_parallel(
+        tensor_model_parallel_size=args.tensor_model_parallel_size,
+        pipeline_model_parallel_size=args.pipeline_model_parallel_size,
+        virtual_pipeline_model_parallel_size=args.virtual_pipeline_model_parallel_size,
+    )
+    return args

@@ -1,0 +1,93 @@
+"""Misc host/device utilities.
+
+Reference: ``megatron/utils.py`` — notably
+``get_ltor_masks_and_position_ids`` (:137-194) and memory reporting
+(:82-96).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_ltor_masks_and_position_ids(
+    tokens,
+    eod_token: Optional[int] = None,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+):
+    """Left-to-right masks + position ids (reference: utils.py:137-194).
+
+    Returns (attention_mask [b,1,s,s] bool True=masked, loss_mask [b,s],
+    position_ids [b,s]).  ``reset_*`` restart positions / block attention
+    at EOD boundaries for packed multi-doc samples.
+    """
+    tokens = jnp.asarray(tokens)
+    b, s = tokens.shape
+    causal = jnp.triu(jnp.ones((s, s), bool), k=1)  # True above diag = masked
+
+    loss_mask = jnp.ones((b, s), jnp.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask = jnp.where(tokens == eod_token, 0.0, loss_mask)
+
+    if not (reset_position_ids or reset_attention_mask) or eod_token is None:
+        position_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+        attention_mask = jnp.broadcast_to(causal[None, None], (b, 1, s, s))
+        return attention_mask, loss_mask, position_ids
+
+    # document ids: cumulative count of EODs *before* each position
+    is_eod = (tokens == eod_token).astype(jnp.int32)
+    doc_ids = jnp.cumsum(is_eod, axis=1) - is_eod  # eod belongs to its doc
+
+    if reset_position_ids:
+        # position within document: global pos - pos of doc start
+        pos = jnp.broadcast_to(jnp.arange(s), (b, s))
+        doc_start = jax.vmap(
+            lambda d: jnp.maximum.accumulate(
+                jnp.where(jnp.concatenate([jnp.zeros(1, bool),
+                                           d[1:] != d[:-1]]),
+                          jnp.arange(s), 0)
+            )
+        )(doc_ids)
+        position_ids = pos - doc_start
+    else:
+        position_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+    if reset_attention_mask:
+        same_doc = doc_ids[:, :, None] == doc_ids[:, None, :]
+        attention_mask = (~same_doc) | causal[None]
+        attention_mask = attention_mask[:, None]
+    else:
+        attention_mask = jnp.broadcast_to(causal[None, None], (b, 1, s, s))
+    return attention_mask, loss_mask, position_ids
+
+
+def report_memory(name: str = "") -> str:
+    """Device memory report (reference: utils.py:82-96 uses
+    torch.cuda.memory_allocated; here per-device live-buffer stats)."""
+    lines = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+            if stats:
+                used = stats.get("bytes_in_use", 0) / 2**30
+                peak = stats.get("peak_bytes_in_use", 0) / 2**30
+                lim = stats.get("bytes_limit", 0) / 2**30
+                lines.append(
+                    f"{name} | {d}: in_use {used:.2f} GiB | "
+                    f"peak {peak:.2f} GiB | limit {lim:.2f} GiB"
+                )
+        except Exception:
+            pass
+    report = "\n".join(lines) or f"{name} | memory stats unavailable"
+    print(report, flush=True)
+    return report
+
+
+def param_count(tree) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))

@@ -1,0 +1,13 @@
+#!/usr/bin/env python
+"""GPT pretraining entry point — thin alias over finetune.py with
+--model_name=gpt defaults (the reference drives GPT pretraining through
+the same driver; see examples/pretrain_gpt.sh upstream)."""
+
+import sys
+
+from finetune import main
+
+if __name__ == "__main__":
+    if not any(a.startswith("--model_name") for a in sys.argv[1:]):
+        sys.argv.append("--model_name=gpt")
+    main()
