@@ -1,0 +1,21 @@
+"""Inference / serving stack.
+
+Reference: ``megatron/text_generation/`` — sampling (:14-93), the
+KV-cached autoregressive loop (generation.py:89-287), beam search
+(:288-416), the broadcast-based API (api.py) and the Flask REST server
+(text_generation_server.py).
+
+TPU re-design: generation is ONE jitted ``lax.while_loop`` — prefill +
+per-token decode + sampling + EOD early-exit all on device (the reference
+runs a Python loop with per-token host sync and cross-rank broadcasts).
+Ragged prompts use the reference's scheme: decode starts at the shortest
+prompt length and prompt tokens override samples until each row's true
+length is passed (generation.py:160+ semantics).
+"""
+
+from megatron_llm_tpu.text_generation.api import (
+    beam_search_and_post_process,
+    generate,
+    generate_and_post_process,
+)
+from megatron_llm_tpu.text_generation.sampling import modify_logits, sample

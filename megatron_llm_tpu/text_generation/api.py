@@ -1,0 +1,112 @@
+"""High-level generation API.
+
+Reference: ``megatron/text_generation/api.py`` —
+``generate_and_post_process`` (:19) / ``beam_search_and_post_process``
+(:147).  The reference broadcasts inputs from rank 0 to all ranks before
+running (api.py:70-146); under a single JAX controller there is nothing to
+broadcast — the functions are plain calls.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.text_generation.generation import (
+    beam_search,
+    generate_tokens,
+)
+
+
+def _tokenize_prompts(tokenizer, prompts: Sequence[str], pad_id: int):
+    tokenized = [tokenizer.tokenize(p) for p in prompts]
+    lengths = [len(t) for t in tokenized]
+    max_len = max(lengths)
+    arr = np.full((len(prompts), max_len), pad_id, np.int32)
+    for i, t in enumerate(tokenized):
+        arr[i, : len(t)] = t
+    return jnp.asarray(arr), jnp.asarray(lengths, jnp.int32)
+
+
+def generate(
+    model,
+    params,
+    tokenizer,
+    prompts: Sequence[str],
+    tokens_to_generate: int = 64,
+    *,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    temperature: float = 1.0,
+    greedy: bool = False,
+    seed: int = 0,
+    return_log_probs: bool = False,
+):
+    """Returns (texts, token_lists, log_probs or None)."""
+    pad = getattr(tokenizer, "pad", 0) or 0
+    eod = getattr(tokenizer, "eod", None)
+    toks, lens = _tokenize_prompts(tokenizer, prompts, pad)
+    out_tokens, _, log_probs = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(seed),
+        max_new_tokens=tokens_to_generate,
+        min_prompt_len=int(lens.min()),
+        top_k=top_k, top_p=top_p, temperature=temperature, greedy=greedy,
+        eod_id=eod, return_log_probs=return_log_probs,
+    )
+    out_tokens = np.asarray(out_tokens)
+    texts, token_lists = [], []
+    for i, row in enumerate(out_tokens):
+        row = row.tolist()
+        # trim at eod after the prompt
+        if eod is not None and eod in row[int(lens[i]):]:
+            end = row.index(eod, int(lens[i])) + 1
+            row = row[:end]
+        token_lists.append(row)
+        texts.append(tokenizer.detokenize(row))
+    return texts, token_lists, (np.asarray(log_probs) if return_log_probs
+                                else None)
+
+
+def generate_and_post_process(
+    model, params, tokenizer, prompts,
+    tokens_to_generate: int = 64,
+    return_output_log_probs: bool = False,
+    top_k_sampling: int = 0,
+    top_p_sampling: float = 0.0,
+    temperature: float = 1.0,
+    random_seed: int = 0,
+    **_unused,
+):
+    """Reference signature compatibility (api.py:19-69)."""
+    texts, tokens, log_probs = generate(
+        model, params, tokenizer, prompts, tokens_to_generate,
+        top_k=top_k_sampling, top_p=top_p_sampling, temperature=temperature,
+        greedy=(top_k_sampling == 1), seed=random_seed,
+        return_log_probs=return_output_log_probs,
+    )
+    segments = [[tokenizer.detokenize([t]) for t in row] for row in tokens]
+    return texts, segments, log_probs, tokens
+
+
+def beam_search_and_post_process(
+    model, params, tokenizer, prompts,
+    tokens_to_generate: int = 64,
+    beam_size: int = 4,
+    length_penalty: float = 1.0,
+    **_unused,
+):
+    """Reference: api.py:147-201 (batch of 1)."""
+    assert len(prompts) == 1, "beam search supports a single prompt"
+    toks, lens = _tokenize_prompts(tokenizer, prompts,
+                                   getattr(tokenizer, "pad", 0) or 0)
+    beams, scores = beam_search(
+        model, params, toks[:1], beam_size=beam_size,
+        max_new_tokens=tokens_to_generate, eod_id=tokenizer.eod,
+        length_penalty=length_penalty,
+    )
+    beams = np.asarray(beams)
+    texts = [tokenizer.detokenize(b.tolist()) for b in beams]
+    return texts, np.asarray(scores)

@@ -1,0 +1,228 @@
+"""KV-cached autoregressive generation + beam search.
+
+Reference: ``megatron/text_generation/generation.py`` —
+``generate_tokens_probs_and_return_on_first_stage`` (:89-287): incremental
+forward with an inference KV cache, per-step sampling, EOD early stop,
+optional per-token log-probs; beam search (:288-416) with hypothesis
+management in ``beam_utils.py``.
+
+TPU design: the whole decode — prefill, while-loop over positions,
+sampling, done-flag early exit — is one compiled function; nothing
+round-trips to the host per token.  Ragged prompts follow the reference's
+scheme: decoding starts at the minimum prompt length and prompt tokens
+override samples until each row's length is passed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import TransformerConfig
+from megatron_llm_tpu.models.language_model import language_model_forward
+from megatron_llm_tpu.models.transformer import rotary_freqs
+from megatron_llm_tpu.text_generation.sampling import modify_logits, sample
+
+
+def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int,
+                   dtype=None):
+    dtype = dtype or cfg.compute_jnp_dtype
+    ng, d = cfg.num_query_groups, cfg.head_dim
+    return [
+        {
+            "k": jnp.zeros((batch, max_len, ng, d), dtype),
+            "v": jnp.zeros((batch, max_len, ng, d), dtype),
+            "index": jnp.int32(0),
+        }
+        for _ in range(cfg.num_layers)
+    ]
+
+
+def _forward_with_cache(model, params, tokens, caches, start_pos):
+    """Run the model over ``tokens`` [b, n] writing KV at ``start_pos``;
+    returns (logits [b, n, V], new caches)."""
+    cfg = model.cfg
+    caches = [dict(c, index=jnp.int32(start_pos)) for c in caches]
+    b, n = tokens.shape
+    position_ids = start_pos + jnp.arange(n)[None, :]
+    position_ids = jnp.broadcast_to(position_ids, (b, n))
+    logits, new_caches = language_model_forward(
+        params, tokens, position_ids, None, cfg,
+        rng_key=None, train=False, kv_caches=caches,
+    )
+    return logits, new_caches
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "min_prompt_len", "top_k",
+                     "top_p", "temperature", "greedy", "eod_id",
+                     "return_log_probs"),
+)
+def generate_tokens(
+    model,
+    params,
+    prompt_tokens: jax.Array,      # [b, max_prompt] right-padded
+    prompt_lengths: jax.Array,     # [b]
+    rng_key,
+    *,
+    max_new_tokens: int,
+    min_prompt_len: int,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    temperature: float = 1.0,
+    greedy: bool = False,
+    eod_id: Optional[int] = None,
+    return_log_probs: bool = False,
+):
+    """Returns (tokens [b, total], gen_lengths [b], log_probs [b, total])."""
+    cfg = model.cfg
+    b, max_prompt = prompt_tokens.shape
+    total = max_prompt + max_new_tokens
+    caches = init_kv_caches(cfg, b, total)
+
+    tokens = jnp.concatenate(
+        [prompt_tokens,
+         jnp.zeros((b, max_new_tokens), prompt_tokens.dtype)], axis=1
+    )
+    log_probs = jnp.zeros((b, total), jnp.float32)
+
+    # ---- prefill up to the shortest prompt --------------------------------
+    prefill = max(min_prompt_len, 1)
+    logits, caches = _forward_with_cache(
+        model, params, tokens[:, :prefill], caches, 0
+    )
+    if return_log_probs:
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        # log_probs[i, t] = logp of tokens[i, t] given prefix (t >= 1)
+        picked = jnp.take_along_axis(
+            lp[:, :-1], tokens[:, 1:prefill, None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        log_probs = jax.lax.dynamic_update_slice(
+            log_probs, picked, (0, 1)
+        )
+
+    last_logits = logits[:, -1]
+
+    # ---- decode loop ------------------------------------------------------
+    def cond(state):
+        pos, _, _, _, _, done, _ = state
+        return (pos < total) & ~jnp.all(done)
+
+    def body(state):
+        pos, tokens, caches, last_logits, log_probs, done, key = state
+        key, sub = jax.random.split(key)
+        nxt = sample(last_logits, sub, top_k=top_k, top_p=top_p,
+                     temperature=temperature, greedy=greedy)
+        in_prompt = pos < prompt_lengths
+        cur = jax.lax.dynamic_index_in_dim(tokens, pos, 1, keepdims=False)
+        new_tok = jnp.where(in_prompt, cur, nxt.astype(tokens.dtype))
+        new_tok = jnp.where(done, cur, new_tok)
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, new_tok[:, None], (0, pos)
+        )
+        if return_log_probs:
+            lp = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+            picked = jnp.take_along_axis(
+                lp, new_tok[:, None].astype(jnp.int32), axis=-1
+            )[..., 0]
+            log_probs = jax.lax.dynamic_update_slice(
+                log_probs, picked[:, None], (0, pos)
+            )
+        if eod_id is not None:
+            done = done | ((new_tok == eod_id) & ~in_prompt)
+        logits, caches = _forward_with_cache(
+            model, params, new_tok[:, None], caches, pos
+        )
+        return (pos + 1, tokens, caches, logits[:, -1], log_probs, done, key)
+
+    state = (jnp.int32(prefill), tokens, caches, last_logits, log_probs,
+             jnp.zeros((b,), bool), rng_key)
+    pos, tokens, caches, last_logits, log_probs, done, _ = (
+        jax.lax.while_loop(cond, body, state)
+    )
+    return tokens, pos, log_probs
+
+
+def greedy_generate(model, params, prompt_tokens, prompt_lengths,
+                    max_new_tokens, eod_id=None):
+    return generate_tokens(
+        model, params, prompt_tokens, prompt_lengths, jax.random.PRNGKey(0),
+        max_new_tokens=max_new_tokens,
+        min_prompt_len=int(prompt_lengths.min()),
+        greedy=True, eod_id=eod_id,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Beam search (reference: generation.py:288-416 + beam_utils.py)
+# ---------------------------------------------------------------------------
+
+def beam_search(
+    model,
+    params,
+    prompt_tokens: jax.Array,     # [1, prompt_len]
+    *,
+    beam_size: int,
+    max_new_tokens: int,
+    eod_id: int,
+    length_penalty: float = 1.0,
+):
+    """Single-prompt beam search.  Beams ride the batch axis; the KV cache
+    is gathered along batch on every reorder (the reference mutates
+    per-layer cache tensors in place, generation.py:288-416)."""
+    cfg = model.cfg
+    _, prompt_len = prompt_tokens.shape
+    total = prompt_len + max_new_tokens
+    B = beam_size
+
+    tokens = jnp.tile(prompt_tokens, (B, 1))
+    tokens = jnp.concatenate(
+        [tokens, jnp.zeros((B, max_new_tokens), tokens.dtype)], axis=1
+    )
+    caches = init_kv_caches(cfg, B, total)
+    logits, caches = _forward_with_cache(
+        model, params, tokens[:, :prompt_len], caches, 0
+    )
+    lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+
+    # first expansion: take top beam_size from beam 0 only
+    top_lp, top_idx = jax.lax.top_k(lp[0], B)
+    scores = top_lp
+    tokens = tokens.at[:, prompt_len].set(top_idx.astype(tokens.dtype))
+    done = top_idx == eod_id
+
+    V = lp.shape[-1]
+    for step in range(1, max_new_tokens):
+        pos = prompt_len + step - 1
+        logits, caches = _forward_with_cache(
+            model, params, tokens[:, pos][:, None], caches, pos
+        )
+        lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        # finished beams only propose EOD with frozen score
+        lp = jnp.where(done[:, None],
+                       jnp.full_like(lp, -1e9).at[:, eod_id].set(0.0), lp)
+        cand = scores[:, None] + lp               # [B, V]
+        flat_scores, flat_idx = jax.lax.top_k(cand.reshape(-1), B)
+        beam_src = flat_idx // V
+        tok_next = (flat_idx % V).astype(tokens.dtype)
+
+        tokens = tokens[beam_src]
+        tokens = tokens.at[:, pos + 1].set(tok_next)
+        caches = [
+            {"k": c["k"][beam_src], "v": c["v"][beam_src], "index": c["index"]}
+            for c in caches
+        ]
+        scores = flat_scores
+        done = done[beam_src] | (tok_next == eod_id)
+        if bool(jnp.all(done)):
+            break
+
+    # length-penalised final ranking (reference beam_utils score/len**alpha)
+    lengths = jnp.sum(tokens != 0, axis=1).astype(jnp.float32)
+    final = scores / (lengths ** length_penalty)
+    order = jnp.argsort(-final)
+    return tokens[order], final[order]

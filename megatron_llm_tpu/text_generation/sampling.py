@@ -1,0 +1,55 @@
+"""Top-k / top-p / temperature sampling.
+
+Reference: ``megatron/text_generation/sampling.py:14-93`` —
+``modify_logits_for_top_k/top_p`` + ``sample``.  Pure-jnp, jit-safe
+(static top_k; top_p via sorted cumulative mass).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e10
+
+
+def modify_logits(
+    logits: jax.Array,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    temperature: float = 1.0,
+) -> jax.Array:
+    """logits [..., V] -> filtered/scaled logits."""
+    logits = logits.astype(jnp.float32)
+    if temperature != 1.0 and temperature > 0:
+        logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p > 0.0 and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative mass exceeds top_p (always keep top-1)
+        cutoff_idx = jnp.sum((cum - probs) < top_p, axis=-1, keepdims=True) - 1
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, NEG_INF, logits)
+    return logits
+
+
+def sample(
+    logits: jax.Array,
+    key: jax.Array,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    temperature: float = 1.0,
+    greedy: bool = False,
+) -> jax.Array:
+    """Sample token ids from [..., V] logits (reference: sampling.py:45-93;
+    greedy when top_k==1 or temperature==0)."""
+    if greedy or top_k == 1 or temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = modify_logits(logits, top_k, top_p, temperature)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

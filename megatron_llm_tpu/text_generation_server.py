@@ -1,0 +1,123 @@
+"""REST text-generation server.
+
+Reference: ``megatron/text_generation_server.py`` — a Flask app where
+``MegatronGenerate.put`` validates the JSON request (prompts <= 128,
+tokens_to_generate, top-k/p, beams, logprobs; :31-233) and rank 0 serves
+while other ranks spin in a broadcast loop.
+
+TPU: a stdlib ``http.server`` implementation (Flask is not in the image)
+with the same ``PUT /api`` contract and validation rules; there is no
+broadcast loop — one controller drives all chips.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from megatron_llm_tpu.text_generation.api import (
+    beam_search_and_post_process,
+    generate_and_post_process,
+)
+
+MAX_PROMPTS = 128
+MAX_TOKENS = 1024
+
+
+class MegatronGenerate:
+    """Request validation + dispatch (reference: text_generation_server.py:31)."""
+
+    def __init__(self, model, params, tokenizer):
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer
+        self.lock = threading.Lock()
+
+    def handle(self, payload: dict):
+        if "prompts" not in payload:
+            return 400, {"message": "prompts argument required"}
+        prompts = payload["prompts"]
+        if not isinstance(prompts, list) or not prompts:
+            return 400, {"message": "prompts must be a non-empty list"}
+        if len(prompts) > MAX_PROMPTS:
+            return 400, {"message": f"maximum number of prompts is {MAX_PROMPTS}"}
+        tokens_to_generate = payload.get("tokens_to_generate", 64)
+        if not isinstance(tokens_to_generate, int) or tokens_to_generate < 0:
+            return 400, {"message": "tokens_to_generate must be an integer >= 0"}
+        if tokens_to_generate > MAX_TOKENS:
+            return 400, {"message": f"maximum tokens_to_generate is {MAX_TOKENS}"}
+        logprobs = bool(payload.get("logprobs", False))
+        top_k = int(payload.get("top_k", 0))
+        if top_k < 0 or top_k > 1000:
+            return 400, {"message": "top_k must be in [0, 1000]"}
+        top_p = float(payload.get("top_p", 0.0))
+        if top_p < 0.0 or top_p > 1.0:
+            return 400, {"message": "top_p must be in [0, 1]"}
+        temperature = float(payload.get("temperature", 1.0))
+        if temperature < 0.0 or temperature > 100.0:
+            return 400, {"message": "temperature must be in (0, 100]"}
+        beam_width = payload.get("beam_width", None)
+        random_seed = int(payload.get("random_seed", 0))
+
+        with self.lock:  # single in-flight generation (reference uses a lock)
+            if beam_width is not None:
+                if len(prompts) > 1:
+                    return 400, {"message": "beam search requires one prompt"}
+                texts, scores = beam_search_and_post_process(
+                    self.model, self.params, self.tokenizer, prompts,
+                    tokens_to_generate=tokens_to_generate,
+                    beam_size=int(beam_width),
+                )
+                return 200, {"text": texts, "scores": scores.tolist()}
+            texts, segments, log_probs, tokens = generate_and_post_process(
+                self.model, self.params, self.tokenizer, prompts,
+                tokens_to_generate=tokens_to_generate,
+                return_output_log_probs=logprobs,
+                top_k_sampling=top_k,
+                top_p_sampling=top_p,
+                temperature=temperature,
+                random_seed=random_seed,
+            )
+            out = {"text": texts, "segments": segments, "tokens": tokens}
+            if logprobs:
+                out["logprobs"] = log_probs.tolist()
+            return 200, out
+
+
+class MegatronServer:
+    """reference: text_generation_server.py:234-241."""
+
+    def __init__(self, model, params, tokenizer):
+        self.generator = MegatronGenerate(model, params, tokenizer)
+
+    def run(self, host: str = "0.0.0.0", port: int = 5000):
+        generator = self.generator
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_PUT(self):
+                if self.path not in ("/api", "/generate"):
+                    self.send_error(404)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    self.send_error(400, "invalid JSON")
+                    return
+                code, body = generator.handle(payload)
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_POST = do_PUT
+
+            def log_message(self, fmt, *args):
+                pass
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        print(f" * serving on http://{host}:{port}/api", flush=True)
+        server.serve_forever()

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Load a checkpoint and serve generation over REST
+(reference: tools/run_text_generation_server.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from megatron_llm_tpu import checkpointing, global_vars
+from megatron_llm_tpu.arguments import transformer_config_from_args
+from megatron_llm_tpu.initialize import initialize_megatron
+from megatron_llm_tpu.models import MODEL_REGISTRY
+from megatron_llm_tpu.parallel import sharding as sh
+from megatron_llm_tpu.text_generation_server import MegatronServer
+
+
+def extra_args(parser):
+    g = parser.add_argument_group("server")
+    g.add_argument("--model_name", required=True)
+    g.add_argument("--port", type=int, default=5000)
+    g.add_argument("--host", default="0.0.0.0")
+    return parser
+
+
+def main():
+    args = initialize_megatron(extra_args_provider=extra_args)
+    model = MODEL_REGISTRY[args.model_name](
+        transformer_config_from_args(args)
+    )
+    if args.load:
+        params, _, _ = checkpointing.load_checkpoint(args.load, finetune=True)
+    else:
+        print(" no --load given: serving a randomly initialized model")
+        params = model.init(jax.random.PRNGKey(args.seed))
+    params = sh.shard_params(params, model.param_specs(params))
+    tokenizer = global_vars.get_tokenizer()
+    MegatronServer(model, params, tokenizer).run(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

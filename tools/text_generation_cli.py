@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Interactive client for the generation server
+(reference: tools/text_generation_cli.py)."""
+
+import json
+import sys
+import urllib.request
+
+
+def main():
+    if len(sys.argv) != 2:
+        print("usage: text_generation_cli.py <host:port>")
+        sys.exit(1)
+    url = f"http://{sys.argv[1]}/api"
+    while True:
+        try:
+            prompt = input("Enter prompt: ")
+        except EOFError:
+            break
+        tokens = input("Enter number of tokens to generate: ")
+        req = urllib.request.Request(
+            url,
+            data=json.dumps({
+                "prompts": [prompt],
+                "tokens_to_generate": int(tokens),
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+            method="PUT",
+        )
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())
+        print("Megatron Response:")
+        print(out["text"][0])
+
+
+if __name__ == "__main__":
+    main()
