@@ -111,6 +111,9 @@ class TransformerConfig:
     # GLU family: None | 'swiglu' | 'geglu' | 'reglu' | 'liglu'
     # (reference: megatron/model/glu_activations.py:8-49)
     glu_activation: Optional[str] = None
+    # non-GLU MLP activation: 'tanh' = approximate gelu (GPT-2/Megatron
+    # bias-gelu fusion polynomial), 'exact' = erf gelu (Falcon / F.gelu)
+    gelu_variant: str = "tanh"
     # bias toggles (reference: --use_bias / --no_bias in arguments.py)
     add_bias_linear: bool = True
     # Falcon-style parallel attention+MLP (reference: transformer.py:635-664)

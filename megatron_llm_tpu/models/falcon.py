@@ -37,6 +37,7 @@ def falcon_config(size: str = "7B", **overrides) -> TransformerConfig:
     base = dict(
         position_embedding_type=PositionEmbeddingType.rotary,
         normalization="layernorm",
+        gelu_variant="exact",
         parallel_attn=True,
         add_bias_linear=False,
         tie_embed_logits=True,

@@ -305,6 +305,8 @@ def mlp(
     )
     if cfg.glu_activation:
         h = GLU_ACTIVATIONS[cfg.glu_activation](h)
+    elif cfg.gelu_variant == "exact":
+        h = jax.nn.gelu(h, approximate=False)
     else:
         h = gelu(h)
     return row_parallel_linear(

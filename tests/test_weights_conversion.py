@@ -1,0 +1,181 @@
+"""Golden-model conversion tests.
+
+Reference: ``tests/test_llama_weights.py`` — converts Meta/HF weights,
+runs verify_correctness (mean max-abs logit error <= 1e-3 vs HF), reshards,
+converts back.  Here the golden model is a small *random-init* HF model
+(no network / no 7B download in CI), which exercises the identical layout
+transforms.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from megatron_llm_tpu.config import TransformerConfig  # noqa: E402
+from megatron_llm_tpu.models.llama import LlamaModel  # noqa: E402
+from megatron_llm_tpu.models.mistral import MistralModel  # noqa: E402
+from weights_conversion.hf_to_megatron import (  # noqa: E402
+    convert_falcon,
+    convert_llama_family,
+)
+from weights_conversion.megatron_to_hf import (  # noqa: E402
+    hf_config_for,
+    llama_family_state_dict,
+)
+from weights_conversion.util import (  # noqa: E402
+    pack_qkv,
+    rotary_hf_to_interleaved,
+    rotary_interleaved_to_hf,
+    unpack_qkv,
+)
+
+
+def _tiny_llama_cfg(**kw):
+    from transformers import LlamaConfig
+
+    base = dict(vocab_size=128, hidden_size=64, intermediate_size=176,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=64,
+                rms_norm_eps=1e-5, tie_word_embeddings=False)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def test_rotary_permutation_roundtrip():
+    w = np.random.RandomState(0).randn(4 * 8, 16).astype(np.float32)
+    np.testing.assert_array_equal(
+        rotary_interleaved_to_hf(rotary_hf_to_interleaved(w, 8), 8), w
+    )
+
+
+def test_qkv_pack_roundtrip():
+    rng = np.random.RandomState(1)
+    nh, ng, d, hid = 8, 2, 4, 16
+    q = rng.randn(nh * d, hid).astype(np.float32)
+    k = rng.randn(ng * d, hid).astype(np.float32)
+    v = rng.randn(ng * d, hid).astype(np.float32)
+    q2, k2, v2 = unpack_qkv(pack_qkv(q, k, v, nh, ng, d), nh, ng, d)
+    np.testing.assert_array_equal(q, q2)
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+
+
+def test_hf_llama_logit_parity():
+    """The core golden test: converted weights reproduce HF logits
+    (reference tolerance 1e-3; we hold 1e-5 at fp32)."""
+    from transformers import LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(_tiny_llama_cfg()).eval()
+    params, config = convert_llama_family(hf)
+    cfg = TransformerConfig(**config, use_flash_attn=False)
+    model = LlamaModel(cfg)
+
+    toks = np.random.RandomState(0).randint(0, 128, (2, 16))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(toks)).logits.numpy()
+    my_logits = np.asarray(model(params, jnp.asarray(toks), train=False))
+    assert np.abs(hf_logits - my_logits).max() < 1e-5
+
+
+def test_hf_mistral_logit_parity_sliding_window():
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8,
+        tie_word_embeddings=False,
+    )
+    hf = MistralForCausalLM(hf_cfg).eval()
+    params, config = convert_llama_family(hf)
+    config["sliding_window_size"] = 8
+    cfg = TransformerConfig(**config, use_flash_attn=False)
+
+    class _M(MistralModel):
+        def __init__(self, cfg):
+            # bypass the ==4096 assert for the tiny window
+            from megatron_llm_tpu.models.gpt import GPTModel
+
+            GPTModel.__init__(self, cfg)
+
+    model = _M(cfg)
+    # sequence长 enough that the window matters
+    toks = np.random.RandomState(0).randint(0, 128, (1, 32))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(toks)).logits.numpy()
+    my_logits = np.asarray(model(params, jnp.asarray(toks), train=False))
+    assert np.abs(hf_logits - my_logits).max() < 1e-4
+
+
+def test_falcon_logit_parity():
+    from transformers import FalconConfig, FalconForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2, multi_query=True,
+        new_decoder_architecture=True, parallel_attn=True, bias=False,
+        max_position_embeddings=64, tie_word_embeddings=True,
+        alibi=False,
+    )
+    hf = FalconForCausalLM(hf_cfg).eval()
+    params, config = convert_falcon(hf)
+    from megatron_llm_tpu.models.falcon import FalconModel
+
+    cfg = TransformerConfig(**config, use_flash_attn=False,
+                            seq_length=64, max_position_embeddings=64)
+    model = FalconModel(cfg)
+    toks = np.random.RandomState(0).randint(0, 128, (2, 16))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(toks)).logits.numpy()
+    my_logits = np.asarray(model(params, jnp.asarray(toks), train=False))
+    assert np.abs(hf_logits - my_logits).max() < 1e-4
+
+
+def test_megatron_to_hf_roundtrip():
+    """HF -> TPU -> HF round trip preserves every tensor exactly."""
+    from transformers import LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(_tiny_llama_cfg()).eval()
+    params, config = convert_llama_family(hf)
+    sd_back = llama_family_state_dict(params, config)
+    sd_orig = hf.state_dict()
+    for k, v in sd_back.items():
+        np.testing.assert_allclose(
+            v.numpy(), sd_orig[k].numpy(), atol=1e-6, err_msg=k
+        )
+
+    hf_cfg2 = hf_config_for("llama2", config)
+    assert hf_cfg2.num_key_value_heads == 2
+
+
+def test_checkpoint_reshard_roundtrip(tmp_path, utils):
+    """Save under one mesh, load under another (reference: reshard
+    tp=2,pp=2 and back, test_llama_weights.py:181-192)."""
+    from megatron_llm_tpu import checkpointing
+    from megatron_llm_tpu.models.llama import llama_config
+    from megatron_llm_tpu.parallel import sharding as sh
+
+    cfg = llama_config("tiny", num_layers=4, seq_length=32,
+                       max_position_embeddings=32, padded_vocab_size=128)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+
+    utils.initialize_model_parallel(tp=4, pp=1)
+    p_tp4 = sh.shard_params(params, model.param_specs(params))
+    checkpointing.save_checkpoint(str(tmp_path), 5, p_tp4)
+
+    utils.initialize_model_parallel(tp=2, pp=2)
+    loaded, _, meta = checkpointing.load_checkpoint(str(tmp_path))
+    p_tp2 = sh.shard_params(loaded, model.param_specs(loaded))
+    assert meta["iteration"] == 5
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(p_tp2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Checkpoint resharding / dtype conversion.
+
+Reference: ``tools/checkpoint_util.py`` — spawns loader & saver processes
+connected by a queue speaking a named-message protocol to re-split
+``mp_rank_XX_YYY`` shard files for a new (tp, pp) (:6-88).
+
+TPU: checkpoints are *layout independent* — one logical pytree, written
+sharded by orbax/tensorstore.  Re-sharding to a new (tp, pp, dp) happens
+implicitly on load (``jax.device_put`` against the new mesh), so this tool
+reduces to load -> (optional dtype cast / arg rewrite) -> save.  It exists
+for CLI parity and for the cases the reference tool also covers: changing
+dtype, re-recording parallel sizes in args, re-writing a release
+checkpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--load_dir", required=True)
+    p.add_argument("--save_dir", required=True)
+    p.add_argument("--target_tensor_parallel_size", type=int, default=None)
+    p.add_argument("--target_pipeline_parallel_size", type=int, default=None)
+    p.add_argument("--target_data_parallel_size", type=int, default=None)
+    p.add_argument("--dtype", choices=["fp32", "bf16", "fp16"], default=None)
+    p.add_argument("--release", action="store_true",
+                   help="write as a release checkpoint (iteration 0)")
+    args = p.parse_args()
+
+    import jax.numpy as jnp
+    import jax
+
+    from megatron_llm_tpu import checkpointing
+
+    params, opt_state, meta = checkpointing.load_checkpoint(args.load_dir)
+    if params is None:
+        params, opt_state, meta = checkpointing.load_checkpoint(
+            args.load_dir, release=True
+        )
+    if params is None:
+        raise SystemExit(f"no checkpoint found under {args.load_dir}")
+
+    if args.dtype:
+        dt = {"fp32": jnp.float32, "bf16": jnp.bfloat16,
+              "fp16": jnp.float16}[args.dtype]
+        params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dt), params)
+
+    ckpt_args = dict(meta.get("args") or {})
+    for k, v in (
+        ("tensor_model_parallel_size", args.target_tensor_parallel_size),
+        ("pipeline_model_parallel_size", args.target_pipeline_parallel_size),
+        ("data_parallel_size", args.target_data_parallel_size),
+    ):
+        if v is not None:
+            ckpt_args[k] = v
+
+    iteration = 0 if args.release else meta.get("iteration", 0)
+    checkpointing.save_checkpoint(
+        args.save_dir, iteration, params, opt_state,
+        args=ckpt_args,
+        consumed_samples=meta.get("consumed_samples", 0),
+        release=args.release,
+    )
+    print(f" resharded {args.load_dir} -> {args.save_dir} "
+          f"(layout-independent; target sizes recorded in args)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,309 @@
+#!/usr/bin/env python
+"""HuggingFace -> TPU-framework checkpoint conversion.
+
+Reference: ``weights_conversion/hf_to_megatron.py`` — downloads/loads the
+HF model, permutes the rotary QKV interleaving, packs the GQA layout, and
+writes a TP=PP=1 ``release`` checkpoint with args (:259-449).
+
+Here the output is the framework's layout-independent orbax checkpoint
+(any later mesh re-sharding is free), written with
+``checkpointing.save_checkpoint(..., release=True)``.
+
+Usage:
+    python weights_conversion/hf_to_megatron.py llama2 \
+        --model-path /path/or/hub-id --out /ckpts/llama2-7b
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from weights_conversion.util import (
+    pack_glu_ffn,
+    pack_qkv,
+    rotary_hf_to_interleaved,
+)
+
+
+def _np(t):
+    # .copy() is load-bearing: .float() on an fp32 tensor is a no-op view,
+    # so without it the numpy array aliases the live HF parameter and the
+    # in-place rotary permutation would corrupt the source model.
+    return t.detach().to("cpu").float().numpy().copy()
+
+
+def convert_llama_family(hf_model, dtype=np.float32):
+    """LlamaForCausalLM / MistralForCausalLM -> param pytree + config dict.
+
+    reference: hf_to_megatron.py:117-258 (llama), :185-258 (mistral).
+    """
+    hf_cfg = hf_model.config
+    nh = hf_cfg.num_attention_heads
+    ng = getattr(hf_cfg, "num_key_value_heads", nh)
+    d = hf_cfg.hidden_size // nh
+    sd = dict(hf_model.state_dict())
+
+    layers = []
+    for i in range(hf_cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        q = rotary_hf_to_interleaved(_np(sd[p + "self_attn.q_proj.weight"]), d)
+        k = rotary_hf_to_interleaved(_np(sd[p + "self_attn.k_proj.weight"]), d)
+        v = _np(sd[p + "self_attn.v_proj.weight"])
+        layers.append({
+            "input_norm": {
+                "scale": _np(sd[p + "input_layernorm.weight"])
+            },
+            "attention": {
+                "query_key_value": {"kernel": pack_qkv(q, k, v, nh, ng, d)},
+                "dense": {
+                    "kernel": np.ascontiguousarray(
+                        _np(sd[p + "self_attn.o_proj.weight"]).T)
+                },
+            },
+            "post_attention_norm": {
+                "scale": _np(sd[p + "post_attention_layernorm.weight"])
+            },
+            "mlp": {
+                "dense_h_to_4h": {
+                    "kernel": pack_glu_ffn(
+                        _np(sd[p + "mlp.gate_proj.weight"]),
+                        _np(sd[p + "mlp.up_proj.weight"]),
+                    )
+                },
+                "dense_4h_to_h": {
+                    "kernel": np.ascontiguousarray(
+                        _np(sd[p + "mlp.down_proj.weight"]).T)
+                },
+            },
+        })
+
+    import jax.numpy as jnp
+
+    stacked = {}
+    def stack(*path):
+        def get(lp, keys):
+            for kk in keys:
+                lp = lp[kk]
+            return lp
+        return jnp.asarray(np.stack([get(l, path) for l in layers]), dtype)
+
+    layer_tree = {
+        "input_norm": {"scale": stack("input_norm", "scale")},
+        "attention": {
+            "query_key_value": {
+                "kernel": stack("attention", "query_key_value", "kernel")},
+            "dense": {"kernel": stack("attention", "dense", "kernel")},
+        },
+        "post_attention_norm": {
+            "scale": stack("post_attention_norm", "scale")},
+        "mlp": {
+            "dense_h_to_4h": {
+                "kernel": stack("mlp", "dense_h_to_4h", "kernel")},
+            "dense_4h_to_h": {
+                "kernel": stack("mlp", "dense_4h_to_h", "kernel")},
+        },
+    }
+    params = {
+        "embedding": {
+            "word": {"embedding": jnp.asarray(
+                _np(sd["model.embed_tokens.weight"]), dtype)}
+        },
+        "transformer": {
+            "layers": layer_tree,
+            "final_norm": {"scale": jnp.asarray(
+                _np(sd["model.norm.weight"]), dtype)},
+        },
+        "lm_head": {"weight": jnp.asarray(
+            _np(sd["lm_head.weight"]), dtype)},
+    }
+    config = {
+        "num_layers": hf_cfg.num_hidden_layers,
+        "hidden_size": hf_cfg.hidden_size,
+        "num_attention_heads": nh,
+        "num_attention_heads_kv": ng,
+        "ffn_hidden_size": hf_cfg.intermediate_size,
+        "padded_vocab_size": hf_cfg.vocab_size,
+        "seq_length": getattr(hf_cfg, "max_position_embeddings", 4096),
+        "max_position_embeddings": getattr(hf_cfg, "max_position_embeddings",
+                                           4096),
+        "position_embedding_type": "rotary",
+        "glu_activation": "swiglu",
+        "normalization": "rmsnorm",
+        "add_bias_linear": False,
+        "tie_embed_logits": False,
+        "layernorm_epsilon": hf_cfg.rms_norm_eps,
+        "rope_theta": getattr(hf_cfg, "rope_theta", 10000.0),
+        "sliding_window_size": getattr(hf_cfg, "sliding_window", None),
+        "hidden_dropout": 0.0,
+        "attention_dropout": 0.0,
+    }
+    return params, config
+
+
+def convert_falcon(hf_model, dtype=np.float32):
+    """FalconForCausalLM -> param pytree (reference: hf_to_megatron.py:60-116).
+
+    Falcon HF already packs QKV in grouped layout
+    [ng*(qpg+2)*d, hidden]; only the rotary permutation (per (q|k) head
+    inside each group) is needed."""
+    hf_cfg = hf_model.config
+    nh = hf_cfg.num_attention_heads
+    ng = getattr(hf_cfg, "num_kv_heads", None) or (
+        hf_cfg.num_attention_heads if not hf_cfg.multi_query else 1
+    )
+    if getattr(hf_cfg, "new_decoder_architecture", False):
+        ng = hf_cfg.num_kv_heads
+    d = hf_cfg.hidden_size // nh
+    qpg = nh // ng
+    sd = dict(hf_model.state_dict())
+
+    import jax.numpy as jnp
+
+    layers = []
+    for i in range(hf_cfg.num_hidden_layers):
+        p = f"transformer.h.{i}."
+        qkv = _np(sd[p + "self_attention.query_key_value.weight"])
+        # per-(q|k) head rotary permutation, leave v rows alone
+        w = qkv.reshape(ng, qpg + 2, d, -1)
+        hid = w.shape[-1]
+        for g in range(ng):
+            for h in range(qpg + 1):   # q heads + k
+                w[g, h] = rotary_hf_to_interleaved(
+                    w[g, h].reshape(d, hid), d
+                ).reshape(d, hid)
+        qkv = w.reshape(ng * (qpg + 2) * d, hid)
+
+        entry = {
+            "attention": {
+                "query_key_value": {
+                    "kernel": np.ascontiguousarray(qkv.T)},
+                "dense": {"kernel": np.ascontiguousarray(
+                    _np(sd[p + "self_attention.dense.weight"]).T)},
+            },
+            "mlp": {
+                "dense_h_to_4h": {"kernel": np.ascontiguousarray(
+                    _np(sd[p + "mlp.dense_h_to_4h.weight"]).T)},
+                "dense_4h_to_h": {"kernel": np.ascontiguousarray(
+                    _np(sd[p + "mlp.dense_4h_to_h.weight"]).T)},
+            },
+        }
+        if getattr(hf_cfg, "new_decoder_architecture", False):
+            entry["input_norm"] = {
+                "scale": _np(sd[p + "ln_attn.weight"]),
+                "bias": _np(sd[p + "ln_attn.bias"]),
+            }
+            entry["mlp_norm"] = {
+                "scale": _np(sd[p + "ln_mlp.weight"]),
+                "bias": _np(sd[p + "ln_mlp.bias"]),
+            }
+        else:
+            entry["input_norm"] = {
+                "scale": _np(sd[p + "input_layernorm.weight"]),
+                "bias": _np(sd[p + "input_layernorm.bias"]),
+            }
+        layers.append(entry)
+
+    def stack(*path):
+        def get(lp, keys):
+            for kk in keys:
+                lp = lp[kk]
+            return lp
+        return jnp.asarray(np.stack([get(l, path) for l in layers]), dtype)
+
+    layer_tree = {
+        "input_norm": {"scale": stack("input_norm", "scale"),
+                       "bias": stack("input_norm", "bias")},
+        "attention": {
+            "query_key_value": {
+                "kernel": stack("attention", "query_key_value", "kernel")},
+            "dense": {"kernel": stack("attention", "dense", "kernel")},
+        },
+        "mlp": {
+            "dense_h_to_4h": {
+                "kernel": stack("mlp", "dense_h_to_4h", "kernel")},
+            "dense_4h_to_h": {
+                "kernel": stack("mlp", "dense_4h_to_h", "kernel")},
+        },
+    }
+    if "mlp_norm" in layers[0]:
+        layer_tree["mlp_norm"] = {"scale": stack("mlp_norm", "scale"),
+                                  "bias": stack("mlp_norm", "bias")}
+    params = {
+        "embedding": {"word": {"embedding": jnp.asarray(
+            _np(sd["transformer.word_embeddings.weight"]), dtype)}},
+        "transformer": {
+            "layers": layer_tree,
+            "final_norm": {
+                "scale": jnp.asarray(_np(sd["transformer.ln_f.weight"]), dtype),
+                "bias": jnp.asarray(_np(sd["transformer.ln_f.bias"]), dtype),
+            },
+        },
+    }
+    config = {
+        "num_layers": hf_cfg.num_hidden_layers,
+        "hidden_size": hf_cfg.hidden_size,
+        "num_attention_heads": nh,
+        "num_attention_heads_kv": ng,
+        "ffn_hidden_size": 4 * hf_cfg.hidden_size,
+        "padded_vocab_size": hf_cfg.vocab_size,
+        "position_embedding_type": "rotary",
+        "normalization": "layernorm",
+        "parallel_attn": True,
+        "parallel_layernorm": bool(
+            getattr(hf_cfg, "new_decoder_architecture", False)),
+        "gelu_variant": "exact",
+        "add_bias_linear": False,
+        "tie_embed_logits": True,
+        "hidden_dropout": 0.0,
+        "attention_dropout": 0.0,
+    }
+    return params, config
+
+
+CONVERTERS = {
+    "llama": convert_llama_family,
+    "llama2": convert_llama_family,
+    "codellama": convert_llama_family,
+    "mistral": convert_llama_family,
+    "falcon": convert_falcon,
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("model", choices=sorted(CONVERTERS))
+    p.add_argument("--model-path", "--model_path", dest="model_path",
+                   required=True, help="HF hub id or local path")
+    p.add_argument("--out", required=True)
+    p.add_argument("--dtype", default="fp32",
+                   choices=["fp32", "bf16", "fp16"])
+    args = p.parse_args()
+
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    import jax.numpy as jnp
+
+    from megatron_llm_tpu import checkpointing
+
+    hf = AutoModelForCausalLM.from_pretrained(
+        args.model_path, torch_dtype=torch.float32, trust_remote_code=False
+    )
+    dtype = {"fp32": jnp.float32, "bf16": jnp.bfloat16,
+             "fp16": jnp.float16}[args.dtype]
+    params, config = CONVERTERS[args.model](hf, dtype)
+    config["model_name"] = args.model
+    checkpointing.save_checkpoint(
+        args.out, 0, params, args=config, release=True
+    )
+    print(f" converted {args.model_path} -> {args.out} (release checkpoint)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""TPU-framework checkpoint -> HuggingFace conversion.
+
+Reference: ``weights_conversion/megatron_to_hf.py`` — inverse QKV/FFN
+un-packing (:47-79) and per-architecture writers (:80-572).
+
+Usage:
+    python weights_conversion/megatron_to_hf.py \
+        --input_dir /ckpts/llama2-7b --output_dir /out/hf \
+        --model llama2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from weights_conversion.util import (
+    rotary_interleaved_to_hf,
+    unpack_glu_ffn,
+    unpack_qkv,
+)
+
+
+def llama_family_state_dict(params, config):
+    """param pytree -> HF LlamaForCausalLM/MistralForCausalLM state dict."""
+    import torch
+
+    nh = config["num_attention_heads"]
+    ng = config.get("num_attention_heads_kv") or nh
+    d = config["hidden_size"] // nh
+    L = config["num_layers"]
+    t = lambda a: torch.tensor(np.asarray(a, np.float32))
+
+    sd = {
+        "model.embed_tokens.weight": t(
+            params["embedding"]["word"]["embedding"]),
+        "model.norm.weight": t(params["transformer"]["final_norm"]["scale"]),
+        "lm_head.weight": t(params["lm_head"]["weight"]),
+    }
+    layers = params["transformer"]["layers"]
+    for i in range(L):
+        g = lambda *path: np.asarray(_index(layers, path, i), np.float32)
+        q, k, v = unpack_qkv(g("attention", "query_key_value", "kernel"),
+                             nh, ng, d)
+        sd[f"model.layers.{i}.self_attn.q_proj.weight"] = t(
+            rotary_interleaved_to_hf(q, d))
+        sd[f"model.layers.{i}.self_attn.k_proj.weight"] = t(
+            rotary_interleaved_to_hf(k, d))
+        sd[f"model.layers.{i}.self_attn.v_proj.weight"] = t(v)
+        sd[f"model.layers.{i}.self_attn.o_proj.weight"] = t(
+            np.ascontiguousarray(g("attention", "dense", "kernel").T))
+        gate, up = unpack_glu_ffn(g("mlp", "dense_h_to_4h", "kernel"))
+        sd[f"model.layers.{i}.mlp.gate_proj.weight"] = t(gate)
+        sd[f"model.layers.{i}.mlp.up_proj.weight"] = t(up)
+        sd[f"model.layers.{i}.mlp.down_proj.weight"] = t(
+            np.ascontiguousarray(g("mlp", "dense_4h_to_h", "kernel").T))
+        sd[f"model.layers.{i}.input_layernorm.weight"] = t(
+            g("input_norm", "scale"))
+        sd[f"model.layers.{i}.post_attention_layernorm.weight"] = t(
+            g("post_attention_norm", "scale"))
+    return sd
+
+
+def _index(tree, path, i):
+    for k in path:
+        tree = tree[k]
+    return tree[i]
+
+
+def hf_config_for(model_name: str, config: dict):
+    if model_name in ("llama", "llama2", "codellama"):
+        from transformers import LlamaConfig
+
+        return LlamaConfig(
+            vocab_size=config["padded_vocab_size"],
+            hidden_size=config["hidden_size"],
+            intermediate_size=config["ffn_hidden_size"],
+            num_hidden_layers=config["num_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            num_key_value_heads=config.get("num_attention_heads_kv"),
+            max_position_embeddings=config["max_position_embeddings"],
+            rms_norm_eps=config.get("layernorm_epsilon", 1e-5),
+            rope_theta=config.get("rope_theta", 10000.0),
+            tie_word_embeddings=False,
+        )
+    if model_name == "mistral":
+        from transformers import MistralConfig
+
+        return MistralConfig(
+            vocab_size=config["padded_vocab_size"],
+            hidden_size=config["hidden_size"],
+            intermediate_size=config["ffn_hidden_size"],
+            num_hidden_layers=config["num_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            num_key_value_heads=config.get("num_attention_heads_kv"),
+            max_position_embeddings=config["max_position_embeddings"],
+            rms_norm_eps=config.get("layernorm_epsilon", 1e-5),
+            sliding_window=config.get("sliding_window_size", 4096),
+            tie_word_embeddings=False,
+        )
+    raise NotImplementedError(f"HF export for {model_name!r}")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--input_dir", "--input-dir", dest="input_dir",
+                   required=True)
+    p.add_argument("--output_dir", "--output-dir", dest="output_dir",
+                   required=True)
+    p.add_argument("--model", default=None,
+                   help="override model family (else read from ckpt args)")
+    args = p.parse_args()
+
+    from transformers import AutoModelForCausalLM
+
+    from megatron_llm_tpu import checkpointing
+
+    params, _, meta = checkpointing.load_checkpoint(args.input_dir,
+                                                    finetune=True)
+    if params is None:
+        # release checkpoint
+        params, _, meta = checkpointing.load_checkpoint(
+            args.input_dir, release=True, finetune=True
+        )
+    config = meta["args"]
+    model_name = args.model or config.get("model_name", "llama2")
+
+    hf_cfg = hf_config_for(model_name, config)
+    hf = AutoModelForCausalLM.from_config(hf_cfg)
+    sd = llama_family_state_dict(params, config)
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    if missing or unexpected:
+        print(f" note: missing={missing} unexpected={unexpected}")
+    hf.save_pretrained(args.output_dir, safe_serialization=True)
+    print(f" exported {args.input_dir} -> {args.output_dir}")
+
+
+if __name__ == "__main__":
+    main()
