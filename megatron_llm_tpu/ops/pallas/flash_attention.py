@@ -1,15 +1,28 @@
-"""Flash attention (causal + sliding-window + GQA) for TPU.
+"""Flash attention for TPU (Pallas Mosaic kernels).
 
 Replaces the reference's FlashAttention-2 dependency
-(``megatron/model/transformer.py:524-553``, including Mistral's
-``window_size`` kwarg).  Public entry ``flash_attention(q, k, v, ...)``
-with layout [b, s, heads, d].
+(``megatron/model/transformer.py:524-553``), including Mistral's
+sliding-window ``window_size`` and GQA/MQA head grouping.
 
-Dispatch:
-* TPU backend -> Pallas kernel (online-softmax tiling over VMEM blocks),
-  defined in this module.
-* other backends / ineligible shapes -> jnp reference math (exact same
-  numerics up to fp associativity).
+Public entry ``flash_attention(q, k, v, ...)`` with layout
+[b, s, heads, d] (batch-major, matching the rest of the framework).
+
+Kernel structure (standard online-softmax tiling):
+
+* forward: grid (batch, q_head, q_blocks, k_blocks), k innermost —
+  sequential on TPU, so fp32 scratch (m, l, acc) carries across k blocks;
+  fully-masked blocks (beyond causal diagonal / outside sliding window)
+  are skipped with ``pl.when``.  Emits O and the per-row logsumexp L for
+  the backward pass.
+* backward: two kernels — dQ (grid over q blocks, k innermost) and
+  dK/dV (grid over k blocks, q innermost), both using the saved L and the
+  delta = rowsum(dO * O) trick, computing p = exp(s - L) without
+  re-running softmax reductions.  GQA: dK/dV are produced per *query*
+  head and group-summed outside the kernel.
+
+Dispatch: TPU backend -> kernels; otherwise -> jnp reference math
+(identical numerics up to fp associativity).  Interpret-mode tests run the
+kernels on CPU.
 """
 
 from __future__ import annotations
@@ -20,11 +33,24 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from megatron_llm_tpu.ops.softmax import causal_mask, sliding_window_mask
 
-_INTERPRET = False  # set True to force pallas interpret mode (tests)
+_INTERPRET = False
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
 
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu" or _INTERPRET
+
+
+# ---------------------------------------------------------------------------
+# reference math (non-TPU fallback)
+# ---------------------------------------------------------------------------
 
 def _reference_attention(q, k, v, causal, sliding_window, softmax_scale):
     b, sq, nh, d = q.shape
@@ -39,10 +65,376 @@ def _reference_attention(q, k, v, causal, sliding_window, softmax_scale):
             mask = sliding_window_mask(sq, sk, sliding_window)
         else:
             mask = causal_mask(sq, sk)
-        scores = jnp.where(mask[None, None, None].astype(bool), -1e30, scores)
+        scores = jnp.where(mask[None, None, None].astype(bool), NEG_INF,
+                           scores)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     ctx = jnp.einsum("bgpst,btgd->bsgpd", probs, v)
     return ctx.reshape(b, sq, nh, d)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, scale, block_q, block_k, causal, window, kv_len, q_len):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    # block-level skip test (host-static grid; runtime predicate)
+    run = jnp.bool_(True)
+    if causal:
+        run = run & (k_start <= q_start + block_q - 1)
+    if window is not None:
+        run = run & (k_start + block_k - 1 > q_start - window)
+
+    @pl.when(run)
+    def _compute():
+        # sanitize padded rows (pallas block padding is undefined memory;
+        # NaNs there would poison the whole block through the matmuls)
+        k_row_valid = (k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < kv_len
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, d]
+        k = jnp.where(k_row_valid, k_ref[0, 0].astype(jnp.float32), 0.0)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                     # [bq, bk]
+
+        q_ids = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_ids = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = (k_ids < kv_len) & (q_ids < q_len)
+        if causal:
+            mask &= k_ids <= q_ids
+        if window is not None:
+            mask &= k_ids > q_ids - window
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:]                             # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l_new = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = jnp.where(k_row_valid, v_ref[0, 0].astype(jnp.float32), 0.0)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = m_scr[:] + jnp.log(l_safe)
+        lse_ref[0, 0] = jnp.where(l[:, 0] == 0.0, NEG_INF, lse[:, 0])
+
+
+def _fwd_call(q, k, v, *, scale, causal, window, block_q, block_k):
+    """q [b, nh, sq, d]; k, v [b, ng, sk, d] -> (o, lse)."""
+    b, nh, sq, d = q.shape
+    ng, sk = k.shape[1], k.shape[2]
+    qpg = nh // ng
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq = pl.cdiv(sq, bq)
+    nk = pl.cdiv(sk, bk)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=bq, block_k=bk,
+        causal=causal, window=window, kv_len=sk, q_len=sq,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b, nh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bb, h, qi, ki: (bb, h, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, qi, ki: (bb, h // qpg, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, qi, ki: (bb, h // qpg, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bb, h, qi, ki: (bb, h, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bb, h, qi, ki: (bb, h, qi),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, nh, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, scale, block_q, block_k, causal, window, kv_len,
+                   q_len):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = jnp.bool_(True)
+    if causal:
+        run = run & (k_start <= q_start + block_q - 1)
+    if window is not None:
+        run = run & (k_start + block_k - 1 > q_start - window)
+
+    @pl.when(run)
+    def _compute():
+        k_row_valid = (k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < kv_len
+        q_row_valid = (q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)) < q_len
+        q = jnp.where(q_row_valid, q_ref[0, 0].astype(jnp.float32), 0.0)
+        k = jnp.where(k_row_valid, k_ref[0, 0].astype(jnp.float32), 0.0)
+        v = jnp.where(k_row_valid, v_ref[0, 0].astype(jnp.float32), 0.0)
+        do = jnp.where(q_row_valid, do_ref[0, 0].astype(jnp.float32), 0.0)
+        lse = jnp.where(q_row_valid, lse_ref[0, 0][:, None], 0.0)
+        delta = jnp.where(q_row_valid, delta_ref[0, 0][:, None], 0.0)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        q_ids = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_ids = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = (k_ids < kv_len) & (q_ids < q_len)
+        if causal:
+            mask &= k_ids <= q_ids
+        if window is not None:
+            mask &= k_ids > q_ids - window
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[:] += jax.lax.dot(
+            ds, k, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, block_q, block_k, causal, window, kv_len,
+                    q_len):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = jnp.bool_(True)
+    if causal:
+        run = run & (k_start <= q_start + block_q - 1)
+    if window is not None:
+        run = run & (k_start + block_k - 1 > q_start - window)
+
+    @pl.when(run)
+    def _compute():
+        k_row_valid = (k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < kv_len
+        q_row_valid = (q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)) < q_len
+        q = jnp.where(q_row_valid, q_ref[0, 0].astype(jnp.float32), 0.0)
+        k = jnp.where(k_row_valid, k_ref[0, 0].astype(jnp.float32), 0.0)
+        v = jnp.where(k_row_valid, v_ref[0, 0].astype(jnp.float32), 0.0)
+        do = jnp.where(q_row_valid, do_ref[0, 0].astype(jnp.float32), 0.0)
+        lse = jnp.where(q_row_valid, lse_ref[0, 0][:, None], 0.0)
+        delta = jnp.where(q_row_valid, delta_ref[0, 0][:, None], 0.0)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        q_ids = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_ids = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = (k_ids < kv_len) & (q_ids < q_len)
+        if causal:
+            mask &= k_ids <= q_ids
+        if window is not None:
+            mask &= k_ids > q_ids - window
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)        # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - delta)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [bk, d]
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, o, lse, do, *, scale, causal, window,
+              block_q, block_k):
+    b, nh, sq, d = q.shape
+    ng, sk = k.shape[1], k.shape[2]
+    qpg = nh // ng
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq = pl.cdiv(sq, bq)
+    nk = pl.cdiv(sk, bk)
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    kw = dict(scale=scale, block_q=bq, block_k=bk, causal=causal,
+              window=window, kv_len=sk, q_len=sq)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **kw),
+        grid=(b, nh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bb, h, qi, ki: (bb, h, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, qi, ki: (bb, h // qpg, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, qi, ki: (bb, h // qpg, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, d), lambda bb, h, qi, ki: (bb, h, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bb, h, qi, ki: (bb, h, qi),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bb, h, qi, ki: (bb, h, qi),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bb, h, qi, ki: (bb, h, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, nh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_INTERPRET,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv per query head, group-summed afterwards (GQA)
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **kw),
+        grid=(b, nh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bb, h, ki, qi: (bb, h, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, ki, qi: (bb, h // qpg, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, ki, qi: (bb, h // qpg, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, d), lambda bb, h, ki, qi: (bb, h, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bb, h, ki, qi: (bb, h, qi),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bb, h, ki, qi: (bb, h, qi),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, ki, qi: (bb, h, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, ki, qi: (bb, h, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nh, sk, d), q.dtype),
+            jax.ShapeDtypeStruct((b, nh, sk, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(q, k, v, do, lse, delta)
+
+    dk = dk_h.reshape(b, ng, qpg, sk, d).sum(axis=2)
+    dv = dv_h.reshape(b, ng, qpg, sk, d).sum(axis=2)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry (custom VJP over [b, s, h, d] layout)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, window, scale, block_q, block_k):
+    o, _ = _fwd_call(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
+        scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k,
+    )
+    return jnp.swapaxes(o, 1, 2)
+
+
+def _flash_fwd(q, k, v, causal, window, scale, block_q, block_k):
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    o, lse = _fwd_call(qt, kt, vt, scale=scale, causal=causal, window=window,
+                       block_q=block_q, block_k=block_k)
+    return jnp.swapaxes(o, 1, 2), (qt, kt, vt, o, lse)
+
+
+def _flash_bwd(causal, window, scale, block_q, block_k, res, g):
+    qt, kt, vt, o, lse = res
+    do = jnp.swapaxes(g, 1, 2)
+    dq, dk, dv = _bwd_call(qt, kt, vt, o, lse, do, scale=scale,
+                           causal=causal, window=window,
+                           block_q=block_q, block_k=block_k)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(
@@ -53,23 +445,14 @@ def flash_attention(
     causal: bool = True,
     sliding_window: Optional[int] = None,
     softmax_scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
 ) -> jax.Array:
     """q: [b, s, nh, d]; k, v: [b, s, ng, d] (GQA when ng < nh)."""
     if softmax_scale is None:
         softmax_scale = 1.0 / math.sqrt(q.shape[-1])
-    if jax.default_backend() == "tpu" and not _INTERPRET:
-        try:
-            return _pallas_flash_attention(
-                q, k, v, causal=causal, sliding_window=sliding_window,
-                softmax_scale=softmax_scale,
-            )
-        except NotImplementedError:
-            pass
-    return _reference_attention(q, k, v, causal, sliding_window, softmax_scale)
-
-
-def _pallas_flash_attention(q, k, v, *, causal, sliding_window, softmax_scale):
-    # Real Pallas kernel lands with the kernel milestone; until then the
-    # XLA path is used (XLA's own fused attention is already competitive on
-    # short sequences).
-    raise NotImplementedError
+    if not _use_pallas():
+        return _reference_attention(q, k, v, causal, sliding_window,
+                                    softmax_scale)
+    return _flash(q, k, v, causal, sliding_window, softmax_scale,
+                  block_q, block_k)

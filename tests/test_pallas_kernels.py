@@ -1,0 +1,115 @@
+"""Pallas TPU kernel tests (interpret mode on CPU).
+
+The reference tests its CUDA kernels against torch reference math
+(``megatron/fused_kernels/tests/test_fused_kernels.py``); same strategy
+here: each kernel vs the jnp reference implementation, fwd and bwd.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import megatron_llm_tpu.ops.pallas.flash_attention as F
+import megatron_llm_tpu.ops.pallas.rmsnorm as R
+from megatron_llm_tpu.ops.layernorm import rms_norm
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    F._INTERPRET = True
+    R._INTERPRET = True
+    yield
+    F._INTERPRET = False
+    R._INTERPRET = False
+
+
+def _qkv(b=2, s=128, nh=4, ng=2, d=64, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, s, nh, d).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(b, s, ng, d).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(b, s, ng, d).astype(np.float32)) * 0.3
+    return q, k, v
+
+
+@pytest.mark.parametrize("window", [None, 32])
+def test_flash_attention_fwd(window):
+    q, k, v = _qkv()
+    ref = F._reference_attention(q, k, v, True, window, 0.125)
+    out = F.flash_attention(q, k, v, causal=True, sliding_window=window,
+                            softmax_scale=0.125, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [None, 32])
+def test_flash_attention_bwd(window):
+    q, k, v = _qkv()
+
+    def loss(fn):
+        return lambda *a: (fn(*a) ** 2).sum()
+
+    ref_fn = loss(lambda q, k, v: F._reference_attention(
+        q, k, v, True, window, 0.125))
+    fa_fn = loss(lambda q, k, v: F.flash_attention(
+        q, k, v, causal=True, sliding_window=window, softmax_scale=0.125,
+        block_q=64, block_k=64))
+    gr = jax.grad(ref_fn, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(fa_fn, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_flash_attention_non_divisible_seq():
+    q, k, v = _qkv(s=96)
+    ref = F._reference_attention(q, k, v, True, None, 0.125)
+    out = F.flash_attention(q, k, v, causal=True, softmax_scale=0.125,
+                            block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_flash_attention_mqa():
+    q, k, v = _qkv(ng=1)
+    ref = F._reference_attention(q, k, v, True, None, 0.125)
+    out = F.flash_attention(q, k, v, causal=True, softmax_scale=0.125,
+                            block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_flash_attention_bf16():
+    q, k, v = (t.astype(jnp.bfloat16) for t in _qkv())
+    ref = F._reference_attention(q, k, v, True, None, 0.125)
+    out = F.flash_attention(q, k, v, causal=True, softmax_scale=0.125,
+                            block_q=64, block_k=64)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2
+    )
+
+
+def test_fused_rmsnorm_fwd_bwd():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 16, 128).astype(np.float32))
+    s = jnp.asarray(rng.randn(128).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(R.fused_rms_norm(x, s)), np.asarray(rms_norm(x, s)),
+        atol=1e-6,
+    )
+    g_ref = jax.grad(lambda a, b: (rms_norm(a, b) ** 2).sum(),
+                     argnums=(0, 1))(x, s)
+    g = jax.grad(lambda a, b: (R.fused_rms_norm(a, b) ** 2).sum(),
+                 argnums=(0, 1))(x, s)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(g_ref[0]),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g[1]), np.asarray(g_ref[1]),
+                               atol=2e-4)
+
+
+def test_fused_rmsnorm_bf16_io():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 128).astype(np.float32)).astype(jnp.bfloat16)
+    s = jnp.ones((128,), jnp.float32)
+    out = R.fused_rms_norm(x, s)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32),
+        np.asarray(rms_norm(x, s), np.float32), atol=2e-2,
+    )
