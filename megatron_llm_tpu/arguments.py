@@ -191,6 +191,7 @@ def _add_distributed_args(parser):
     g.add_argument("--num_layers_per_virtual_pipeline_stage", type=int,
                    default=None)
     g.add_argument("--sequence_parallel", action="store_true")
+    g.add_argument("--context_parallel_size", type=int, default=1)
     g.add_argument("--use_distributed_optimizer", action="store_true")
     g.add_argument("--expert_model_parallel_size", type=int, default=1)
     g.add_argument("--distributed_backend", default="xla",
@@ -286,11 +287,13 @@ def validate_args(args, world_size: Optional[int] = None):
         world_size = int(os.environ.get("MEGATRON_TPU_WORLD_SIZE", 0)) or \
             len(jax.devices())
 
-    mp = args.tensor_model_parallel_size * args.pipeline_model_parallel_size
+    mp = (args.tensor_model_parallel_size * args.pipeline_model_parallel_size
+          * args.context_parallel_size)
     assert world_size % mp == 0, (
         f"world size ({world_size}) not divisible by tp "
         f"({args.tensor_model_parallel_size}) x pp "
-        f"({args.pipeline_model_parallel_size})"
+        f"({args.pipeline_model_parallel_size}) x cp "
+        f"({args.context_parallel_size})"
     )
     args.world_size = world_size
     args.data_parallel_size = world_size // mp   # reference: arguments.py:76
@@ -425,4 +428,5 @@ def parallel_config_from_args(args) -> ParallelConfig:
         sequence_parallel=args.sequence_parallel,
         use_distributed_optimizer=args.use_distributed_optimizer,
         expert_model_parallel_size=args.expert_model_parallel_size,
+        context_parallel_size=args.context_parallel_size,
     )

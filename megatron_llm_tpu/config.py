@@ -58,6 +58,9 @@ class ParallelConfig:
     # ZeRO-1: shard optimizer state over the dp axis.
     # reference: --use_distributed_optimizer (distrib_optimizer.py)
     use_distributed_optimizer: bool = False
+    # context parallelism (ring attention over the cp mesh axis) — a
+    # TPU-native extension; the reference has none (SURVEY §5.7)
+    context_parallel_size: int = 1
     # Expert parallelism size (MoE). The reference has no MoE; we support it
     # as a TPU-native extension (axis folded into dp during non-MoE ops).
     expert_model_parallel_size: int = 1
@@ -68,6 +71,7 @@ class ParallelConfig:
             self.tensor_model_parallel_size
             * self.pipeline_model_parallel_size
             * self.data_parallel_size
+            * self.context_parallel_size
         )
 
 

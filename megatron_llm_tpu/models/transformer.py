@@ -253,13 +253,36 @@ def attention(
     k = constrain(k, "batch", "seq", "kv_heads", None)
     v = constrain(v, "batch", "seq", "kv_heads", None)
 
+    from megatron_llm_tpu import topology as _topo
+
+    cp_size = (
+        _topo.get_context_parallel_world_size()
+        if _topo.model_parallel_is_initialized() else 1
+    )
+    use_ring = (
+        cp_size > 1
+        and kv_cache is None
+        and attention_mask is None
+        and not (train and cfg.attention_dropout > 0.0)
+    )
     use_flash = (
         cfg.use_flash_attn
         and kv_cache is None
         and attention_mask is None
         and not (train and cfg.attention_dropout > 0.0)
     )
-    if use_flash:
+    if use_ring:
+        from megatron_llm_tpu.parallel.ring_attention import (
+            context_parallel_attention,
+        )
+
+        ctx = context_parallel_attention(
+            q, k, v,
+            causal=True,
+            sliding_window=cfg.sliding_window_size,
+            softmax_scale=1.0 / math.sqrt(cfg.head_dim),
+        )
+    elif use_flash:
         from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
 
         ctx = flash_attention(

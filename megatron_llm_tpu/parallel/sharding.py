@@ -34,8 +34,13 @@ from megatron_llm_tpu import topology
 
 DEFAULT_RULES = {
     "batch": topology.DP_AXIS,
-    "seq": None,
-    "seq_tp": topology.TP_AXIS,   # sequence-parallel regions
+    # 'seq' rides the cp axis: a no-op at cp=1, contiguous context-parallel
+    # sequence sharding when cp>1 (ring attention handles the cross-chunk
+    # attention; everything else is position-wise)
+    "seq": topology.CP_AXIS,
+    # sequence-parallel (Megatron SP) regions; composes with cp
+    "seq_tp": (topology.CP_AXIS, topology.TP_AXIS),
+    "seq_cp": topology.CP_AXIS,
     "hidden": None,
     "vocab": topology.TP_AXIS,
     "ffn": topology.TP_AXIS,

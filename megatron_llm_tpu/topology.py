@@ -27,11 +27,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# Canonical mesh-axis names.
+# Canonical mesh-axis names.  The reference has no context-parallel groups
+# (SURVEY §5.7: no ring attention / Ulysses); cp is this framework's
+# first-class long-context axis — sequence-sharded activations with ring
+# attention over ICI neighbours (parallel/ring_attention.py).
 PP_AXIS = "pp"
 DP_AXIS = "dp"
+CP_AXIS = "cp"
 TP_AXIS = "tp"
-MESH_AXES = (PP_AXIS, DP_AXIS, TP_AXIS)
+MESH_AXES = (PP_AXIS, DP_AXIS, CP_AXIS, TP_AXIS)
 
 _MESH: Optional[Mesh] = None
 _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE: Optional[int] = None
@@ -41,27 +45,32 @@ def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
     virtual_pipeline_model_parallel_size: Optional[int] = None,
+    context_parallel_size: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Build the global device mesh.
 
     Mirrors ``initialize_model_parallel`` (parallel_state.py:51-205) but
-    returns a Mesh; dp size is derived as world // (tp*pp) exactly like the
-    reference derives it in arguments.py:76.
+    returns a Mesh; dp size is derived as world // (tp*pp*cp) exactly like
+    the reference derives dp in arguments.py:76.
     """
     global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE
     if devices is None:
         devices = jax.devices()
     world = len(devices)
     tp, pp = tensor_model_parallel_size, pipeline_model_parallel_size
-    if world % (tp * pp) != 0:
+    cp = context_parallel_size
+    if world % (tp * pp * cp) != 0:
         raise RuntimeError(
             f"world size ({world}) is not divisible by tensor parallel size "
-            f"({tp}) x pipeline parallel size ({pp})"
+            f"({tp}) x pipeline parallel size ({pp}) x context parallel "
+            f"size ({cp})"
         )
-    dp = world // (tp * pp)
-    # Rank order (pp outer, dp middle, tp inner) — parallel_state.py:116-171.
-    dev_array = np.asarray(devices).reshape(pp, dp, tp)
+    dp = world // (tp * pp * cp)
+    # Rank order (pp outer, dp, cp, tp inner) — tp innermost keeps TP
+    # collectives on nearest-neighbour ICI (parallel_state.py:116-171), cp
+    # next so the ring permute is also neighbour-local.
+    dev_array = np.asarray(devices).reshape(pp, dp, cp, tp)
     _MESH = Mesh(dev_array, MESH_AXES)
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE = virtual_pipeline_model_parallel_size
     return _MESH
@@ -105,13 +114,18 @@ def get_data_parallel_world_size() -> int:
     return get_mesh().shape[DP_AXIS]
 
 
+def get_context_parallel_world_size() -> int:
+    return get_mesh().shape[CP_AXIS]
+
+
 def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
     return _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE
 
 
 def get_world_size() -> int:
     m = get_mesh()
-    return m.shape[PP_AXIS] * m.shape[DP_AXIS] * m.shape[TP_AXIS]
+    return (m.shape[PP_AXIS] * m.shape[DP_AXIS] * m.shape[CP_AXIS]
+            * m.shape[TP_AXIS])
 
 
 # ---------------------------------------------------------------------------

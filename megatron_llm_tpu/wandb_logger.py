@@ -1,0 +1,60 @@
+"""Weights & Biases logging as a tensorboard-writer shim.
+
+Reference: ``megatron/wandb_logger.py:13-162`` — ``WandbTBShim`` exposes
+``add_scalar`` so the training loop writes one code path for TB and wandb;
+config (project/entity/name/id, API-key file) comes from args
+(arguments.py:535-549), flushed each step (training.py:724-727).
+
+``wandb`` is not in this image; the shim degrades to a JSONL metrics file
+so runs remain inspectable offline, and uses the real wandb package when
+importable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+class WandbTBShim:
+    def __init__(self, config: dict, project: Optional[str] = None,
+                 entity: Optional[str] = None, name: Optional[str] = None,
+                 run_id: Optional[str] = None,
+                 api_key: Optional[str] = None,
+                 fallback_path: str = "wandb_offline.jsonl"):
+        self._wandb = None
+        self._file = None
+        try:
+            import wandb  # noqa: F401
+
+            if api_key:
+                os.environ.setdefault("WANDB_API_KEY", api_key)
+            self._wandb = wandb
+            self._run = wandb.init(project=project, entity=entity, name=name,
+                                   id=run_id, resume="allow", config=config)
+        except Exception:
+            self._file = open(fallback_path, "a", buffering=1)
+            self._file.write(json.dumps({"event": "init", "config": config,
+                                         "time": time.time()}) + "\n")
+        self._pending = {}
+
+    def add_scalar(self, key: str, value, iteration: int):
+        self._pending.setdefault(iteration, {})[key] = float(value)
+
+    def flush(self):
+        for it in sorted(self._pending):
+            payload = self._pending[it]
+            if self._wandb is not None:
+                self._wandb.log(payload, step=it)
+            else:
+                self._file.write(json.dumps({"step": it, **payload}) + "\n")
+        self._pending.clear()
+
+    def finish(self):
+        self.flush()
+        if self._wandb is not None:
+            self._run.finish()
+        elif self._file is not None:
+            self._file.close()

@@ -31,9 +31,10 @@ class Utils:
     world_size = 8
 
     @staticmethod
-    def initialize_model_parallel(tp=1, pp=1, vpp=None):
+    def initialize_model_parallel(tp=1, pp=1, vpp=None, cp=1):
         topology.destroy_model_parallel()
-        return topology.initialize_model_parallel(tp, pp, vpp)
+        return topology.initialize_model_parallel(
+            tp, pp, vpp, context_parallel_size=cp)
 
     @staticmethod
     def destroy_model_parallel():
