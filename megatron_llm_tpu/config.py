@@ -104,6 +104,9 @@ class TransformerConfig:
     # reference: --no_tie_embed_logits -> untied lm_head
     # (megatron/model/language_model.py:436-457)
     tie_embed_logits: bool = True
+    # tokentype (segment) embeddings for BERT-style models
+    # (reference: Embedding tokentype path, language_model.py:163-262)
+    num_tokentypes: int = 0
 
     # --- norm / activation / structure ---
     # 'layernorm' | 'rmsnorm'  (reference: megatron/model/fused_layer_norm.py)

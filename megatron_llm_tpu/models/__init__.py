@@ -9,6 +9,11 @@ from megatron_llm_tpu.models.llama import LlamaModel, llama_config
 from megatron_llm_tpu.models.falcon import FalconModel, falcon_config
 from megatron_llm_tpu.models.mistral import MistralModel, mistral_config
 from megatron_llm_tpu.models.gpt2 import gpt2_config
+from megatron_llm_tpu.models.bert import BertModel, bert_config
+from megatron_llm_tpu.models.classification import (
+    ClassificationModel,
+    MultipleChoiceModel,
+)
 
 MODEL_REGISTRY = {
     "gpt": GPTModel,
@@ -18,3 +23,5 @@ MODEL_REGISTRY = {
     "falcon": FalconModel,
     "mistral": MistralModel,
 }
+# BERT/T5 train through their own entry points (pretrain_bert.py /
+# pretrain_t5.py), mirroring the reference; they are not finetune.py models.

@@ -1,0 +1,232 @@
+"""BERT model (masked-LM + sentence-order binary head).
+
+Reference: ``megatron/model/bert_model.py`` — ``bert_extended_attention_mask``
+(:18-33), ``bert_position_ids`` (:36-43), ``BertLMHead`` (:46-91),
+``post_language_model_processing`` (:94-125), ``BertModel`` (:128-242);
+pooler in ``megatron/model/language_model.py:100-135``.
+
+TPU design notes: same functional pattern as ``GPTModel`` — the model class
+holds only the hashable config; params are a pytree.  The bidirectional
+(padding) attention mask is built host-side or in-graph from the [b, s]
+pad mask; the MLM head reuses the tied vocab-parallel word embedding plus a
+vocab-sharded output bias, so the logits matmul and the vocab-parallel CE
+keep the exact same collective pattern as the GPT path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import (
+    AttnMaskType,
+    PositionEmbeddingType,
+    TransformerConfig,
+)
+from megatron_llm_tpu.models.language_model import (
+    flops_per_token,
+    init_language_model_params,
+    language_model_forward,
+    language_model_param_specs,
+)
+from megatron_llm_tpu.ops.cross_entropy import vocab_parallel_cross_entropy
+from megatron_llm_tpu.ops.layernorm import apply_norm, init_norm_params
+from megatron_llm_tpu.parallel.layers import (
+    init_linear_params,
+    init_method_normal,
+    parallel_lm_logits,
+)
+
+
+# Architecture flags BERT forces (reference asserts spread through
+# bert_model.py / arguments defaults).  Entry points exclude these keys when
+# forwarding generic CLI args — single source of truth.
+BERT_ARCH_FLAGS = dict(
+    position_embedding_type=PositionEmbeddingType.learned_absolute,
+    attn_mask_type=AttnMaskType.padding,
+    normalization="layernorm",
+    glu_activation=None,
+    add_bias_linear=True,
+    tie_embed_logits=True,
+    num_tokentypes=2,
+    use_flash_attn=False,  # padding mask goes through core attention
+)
+
+
+def bert_config(**overrides) -> TransformerConfig:
+    """BERT architecture flags: learned absolute positions, gelu MLP,
+    biases, padding attention mask, tied embeddings, 2 token types."""
+    defaults = dict(BERT_ARCH_FLAGS)
+    defaults.update(overrides)
+    return TransformerConfig(**defaults)
+
+
+def bert_extended_attention_mask(attention_mask: jax.Array) -> jax.Array:
+    """[b, s] 1=real-token mask -> [b, 1, s, s] bool, True = masked away
+    (reference: bert_model.py:18-33)."""
+    b1s = attention_mask[:, None, :]
+    bs1 = attention_mask[:, :, None]
+    bss = (b1s * bs1)[:, None]  # [b, 1, s, s]
+    return bss < 0.5
+
+
+def bert_position_ids(tokens: jax.Array) -> jax.Array:
+    """Reference: bert_model.py:36-43."""
+    s = tokens.shape[1]
+    return jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], tokens.shape)
+
+
+def init_bert_lm_head_params(key, cfg: TransformerConfig, dtype):
+    """MLM transform head: dense h->h + gelu + LN + vocab-sharded bias
+    (reference: BertLMHead, bert_model.py:46-91)."""
+    return {
+        "dense": init_linear_params(
+            key, cfg.hidden_size, cfg.hidden_size,
+            bias=True, init_method=init_method_normal(cfg.init_method_std),
+            dtype=dtype,
+        ),
+        "layernorm": init_norm_params(cfg.hidden_size, "layernorm", dtype),
+        # logits bias, sharded over the vocab axis like the embedding
+        "bias": jnp.zeros((cfg.padded_vocab_size,), dtype=dtype),
+    }
+
+
+def bert_lm_head(hidden: jax.Array, params, word_embedding, cfg) -> jax.Array:
+    h = jnp.einsum("...h,hk->...k", hidden, params["dense"]["kernel"].astype(hidden.dtype))
+    h = h + params["dense"]["bias"].astype(hidden.dtype)
+    h = jax.nn.gelu(h, approximate=False)
+    h = apply_norm(h, params["layernorm"], "layernorm", eps=cfg.layernorm_epsilon,
+                   fp32_compute=cfg.norm_in_fp32)
+    logits = parallel_lm_logits(h, word_embedding, compute_dtype=cfg.compute_jnp_dtype)
+    return logits + params["bias"].astype(logits.dtype)
+
+
+def init_pooler_params(key, cfg: TransformerConfig, dtype):
+    """Reference: Pooler (language_model.py:100-135) — dense + tanh over the
+    first token."""
+    return init_linear_params(
+        key, cfg.hidden_size, cfg.hidden_size,
+        bias=True, init_method=init_method_normal(cfg.init_method_std),
+        dtype=dtype,
+    )
+
+
+def pooler(hidden: jax.Array, params) -> jax.Array:
+    first = hidden[:, 0, :]
+    out = first @ params["kernel"].astype(first.dtype) + params["bias"].astype(first.dtype)
+    return jnp.tanh(out)
+
+
+class BertModel:
+    """Functional BERT with MLM + (optional) binary sentence-order head.
+
+    Reference: ``BertModel`` (bert_model.py:128-242).
+    """
+
+    def __init__(self, cfg: TransformerConfig, add_binary_head: bool = True):
+        self.cfg = cfg
+        self.add_binary_head = add_binary_head
+
+    # -- params ------------------------------------------------------------
+    def init(self, key) -> dict:
+        k_lm, k_head, k_pool, k_bin = jax.random.split(key, 4)
+        dtype = self.cfg.params_jnp_dtype
+        params = init_language_model_params(k_lm, self.cfg)
+        params["lm_head"] = init_bert_lm_head_params(k_head, self.cfg, dtype)
+        if self.add_binary_head:
+            params["pooler"] = init_pooler_params(k_pool, self.cfg, dtype)
+            params["binary_head"] = init_linear_params(
+                k_bin, self.cfg.hidden_size, 2, bias=True,
+                init_method=init_method_normal(self.cfg.init_method_std),
+                dtype=dtype,
+            )
+        return params
+
+    def param_specs(self, params) -> dict:
+        lm = {k: v for k, v in params.items()
+              if k in ("embedding", "transformer")}
+        specs = language_model_param_specs(lm, self.cfg)
+        specs["lm_head"] = {
+            "dense": {"kernel": (None, None), "bias": (None,)},
+            "layernorm": {k: (None,) for k in params["lm_head"]["layernorm"]},
+            "bias": ("vocab",),
+        }
+        if "pooler" in params:
+            specs["pooler"] = {"kernel": (None, None), "bias": (None,)}
+            specs["binary_head"] = {"kernel": (None, None), "bias": (None,)}
+        return specs
+
+    def num_params(self, params) -> int:
+        return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+    def flops_per_token(self, seq_len=None) -> float:
+        return flops_per_token(self.cfg, seq_len)
+
+    # -- forward -----------------------------------------------------------
+    def __call__(
+        self,
+        params,
+        tokens: jax.Array,
+        position_ids: Optional[jax.Array] = None,
+        attention_mask: Optional[jax.Array] = None,
+        labels: Optional[jax.Array] = None,
+        *,
+        tokentype_ids: Optional[jax.Array] = None,
+        sentence_order: Optional[jax.Array] = None,
+        rng_key=None,
+        train: bool = False,
+        sequence_parallel: bool = False,
+    ):
+        """attention_mask here is the [b, s] pad mask (1 = keep), matching
+        the reference entry-point convention (pretrain_bert.py get_batch).
+
+        Returns (per-token MLM loss [b, s], per-example SOP loss [b]) when
+        ``labels`` is given, else (lm_logits, binary_logits|None).
+        """
+        if attention_mask is None:
+            attention_mask = jnp.ones(tokens.shape, jnp.int32)
+        ext_mask = bert_extended_attention_mask(attention_mask)
+        if position_ids is None:
+            position_ids = bert_position_ids(tokens)
+
+        hidden = language_model_forward(
+            params, tokens, position_ids, ext_mask, self.cfg,
+            tokentype_ids=tokentype_ids, rng_key=rng_key, train=train,
+            sequence_parallel=sequence_parallel, compute_logits=False,
+        )
+
+        word_emb = params["embedding"]["word"]["embedding"]
+        lm_logits = bert_lm_head(hidden, params["lm_head"], word_emb, self.cfg)
+
+        binary_logits = None
+        if self.add_binary_head and "pooler" in params:
+            pooled = pooler(hidden, params["pooler"])
+            bh = params["binary_head"]
+            binary_logits = (
+                pooled @ bh["kernel"].astype(pooled.dtype)
+                + bh["bias"].astype(pooled.dtype)
+            )
+
+        if labels is None:
+            return lm_logits, binary_logits
+
+        lm_loss = vocab_parallel_cross_entropy(
+            lm_logits.astype(jnp.float32), labels
+        )
+        if binary_logits is None:
+            return lm_loss, None
+        # sentence-order CE (reference: pretrain_bert.py loss_func — F.cross_entropy
+        # on the 2-class logits; computed in fp32)
+        if sentence_order is None:
+            raise ValueError(
+                "BertModel with add_binary_head=True needs sentence_order in "
+                "the batch when computing the loss (pass "
+                "add_binary_head=False to train MLM-only)"
+            )
+        logp = jax.nn.log_softmax(binary_logits.astype(jnp.float32), axis=-1)
+        sop_loss = -jnp.take_along_axis(
+            logp, sentence_order[:, None], axis=-1
+        )[:, 0]
+        return lm_loss, sop_loss

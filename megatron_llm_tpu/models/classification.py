@@ -1,0 +1,148 @@
+"""Sequence classification & multiple-choice heads on a BERT trunk.
+
+Reference: ``megatron/model/classification.py`` (107 LoC) and
+``megatron/model/multiple_choice.py`` (120 LoC) — BERT language model +
+pooler + dropout + a dense head; multiple-choice flattens the
+[b, num_choices, s] inputs into the batch axis and scores each choice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import TransformerConfig
+from megatron_llm_tpu.models.bert import (
+    bert_extended_attention_mask,
+    bert_position_ids,
+    init_pooler_params,
+    pooler,
+)
+from megatron_llm_tpu.models.language_model import (
+    init_language_model_params,
+    language_model_forward,
+    language_model_param_specs,
+)
+from megatron_llm_tpu.parallel.layers import (
+    init_linear_params,
+    init_method_normal,
+)
+
+
+class ClassificationModel:
+    """BERT trunk + pooler + ``num_classes`` head
+    (reference: classification.py:24-107)."""
+
+    def __init__(self, cfg: TransformerConfig, num_classes: int):
+        self.cfg = cfg
+        self.num_classes = num_classes
+
+    def init(self, key) -> dict:
+        k_lm, k_pool, k_head = jax.random.split(key, 3)
+        dtype = self.cfg.params_jnp_dtype
+        params = init_language_model_params(k_lm, self.cfg)
+        params["pooler"] = init_pooler_params(k_pool, self.cfg, dtype)
+        params["classification_head"] = init_linear_params(
+            k_head, self.cfg.hidden_size, self.num_classes, bias=True,
+            init_method=init_method_normal(self.cfg.init_method_std),
+            dtype=dtype,
+        )
+        return params
+
+    def param_specs(self, params) -> dict:
+        lm = {k: v for k, v in params.items() if k in ("embedding", "transformer")}
+        specs = language_model_param_specs(lm, self.cfg)
+        specs["pooler"] = {"kernel": (None, None), "bias": (None,)}
+        specs["classification_head"] = {"kernel": (None, None), "bias": (None,)}
+        return specs
+
+    def num_params(self, params) -> int:
+        return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+    def _trunk(self, params, tokens, attention_mask, tokentype_ids,
+               rng_key, train, sequence_parallel):
+        if attention_mask is None:
+            attention_mask = jnp.ones(tokens.shape, jnp.int32)
+        ext_mask = bert_extended_attention_mask(attention_mask)
+        position_ids = bert_position_ids(tokens)
+        hidden = language_model_forward(
+            params, tokens, position_ids, ext_mask, self.cfg,
+            tokentype_ids=tokentype_ids, rng_key=rng_key, train=train,
+            sequence_parallel=sequence_parallel, compute_logits=False,
+        )
+        return pooler(hidden, params["pooler"])
+
+    def __call__(
+        self,
+        params,
+        tokens: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        labels: Optional[jax.Array] = None,
+        *,
+        tokentype_ids: Optional[jax.Array] = None,
+        rng_key=None,
+        train: bool = False,
+        sequence_parallel: bool = False,
+        **_unused,
+    ):
+        """Returns per-example CE loss [b] when labels given, else logits
+        [b, num_classes]."""
+        if rng_key is not None:
+            rng_key, k_drop = jax.random.split(rng_key)
+        else:
+            k_drop = None
+        pooled = self._trunk(
+            params, tokens, attention_mask, tokentype_ids,
+            rng_key, train, sequence_parallel,
+        )
+        # head dropout (reference: classification.py:55-60)
+        if train and self.cfg.hidden_dropout > 0.0 and k_drop is not None:
+            keep = jax.random.bernoulli(
+                k_drop, 1.0 - self.cfg.hidden_dropout, pooled.shape
+            )
+            pooled = pooled * keep.astype(pooled.dtype) / (1.0 - self.cfg.hidden_dropout)
+        head = params["classification_head"]
+        logits = (
+            pooled @ head["kernel"].astype(pooled.dtype)
+            + head["bias"].astype(pooled.dtype)
+        )
+        if labels is None:
+            return logits
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+
+
+class MultipleChoiceModel(ClassificationModel):
+    """[b, num_choices, s] inputs scored per choice with a 1-logit head
+    (reference: multiple_choice.py:24-120)."""
+
+    def __init__(self, cfg: TransformerConfig):
+        super().__init__(cfg, num_classes=1)
+
+    def __call__(
+        self,
+        params,
+        tokens: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        labels: Optional[jax.Array] = None,
+        *,
+        tokentype_ids: Optional[jax.Array] = None,
+        rng_key=None,
+        train: bool = False,
+        sequence_parallel: bool = False,
+        **_unused,
+    ):
+        b, nc, s = tokens.shape
+        flat = lambda x: None if x is None else x.reshape(b * nc, s)
+        logits = super().__call__(
+            params, flat(tokens), flat(attention_mask), None,
+            tokentype_ids=flat(tokentype_ids), rng_key=rng_key, train=train,
+            sequence_parallel=sequence_parallel,
+        )
+        logits = logits.reshape(b, nc)  # [b*nc, 1] -> [b, nc]
+        if labels is None:
+            return logits
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]

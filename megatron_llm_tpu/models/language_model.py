@@ -58,6 +58,13 @@ def init_language_model_params(key, cfg: TransformerConfig, dtype=None):
             k_pos, cfg.max_position_embeddings, cfg.hidden_size,
             init_method=init, dtype=dtype,
         )
+    if cfg.num_tokentypes > 0:
+        # segment embeddings (reference: language_model.py:188-199)
+        k_tok = jax.random.fold_in(k_pos, 1)
+        params["embedding"]["tokentype"] = init_embedding_params(
+            k_tok, cfg.num_tokentypes, cfg.hidden_size,
+            init_method=init, dtype=dtype,
+        )
     if not cfg.tie_embed_logits:
         # untied lm_head parameter (reference: language_model.py:436-457)
         params["lm_head"] = {
@@ -115,6 +122,8 @@ def language_model_param_specs(params, cfg: TransformerConfig):
     }
     if "position" in params["embedding"]:
         specs["embedding"]["position"] = {"embedding": (None, None)}
+    if "tokentype" in params["embedding"]:
+        specs["embedding"]["tokentype"] = {"embedding": (None, None)}
     if "lm_head" in params:
         specs["lm_head"] = {"weight": ("vocab", None)}
     return specs
@@ -126,11 +135,12 @@ def embedding_forward(
     params,
     cfg: TransformerConfig,
     *,
+    tokentype_ids: Optional[jax.Array] = None,
     rng_key=None,
     train: bool = False,
 ) -> jax.Array:
-    """Word (+position) embedding with dropout; under sequence parallelism
-    the output is scattered along the sequence axis
+    """Word (+position, +tokentype) embedding with dropout; under sequence
+    parallelism the output is scattered along the sequence axis
     (reference: language_model.py:230-262)."""
     h = vocab_parallel_embedding(
         tokens, params["word"], compute_dtype=cfg.compute_jnp_dtype
@@ -143,6 +153,11 @@ def embedding_forward(
             position_ids, axis=0,
         )
         h = h + pos
+    if "tokentype" in params and tokentype_ids is not None:
+        h = h + jnp.take(
+            params["tokentype"]["embedding"].astype(cfg.compute_jnp_dtype),
+            tokentype_ids, axis=0,
+        )
     if train and cfg.hidden_dropout > 0.0 and rng_key is not None:
         keep = jax.random.bernoulli(rng_key, 1.0 - cfg.hidden_dropout, h.shape)
         h = h * keep.astype(h.dtype) / (1.0 - cfg.hidden_dropout)
@@ -156,6 +171,7 @@ def language_model_forward(
     attention_mask: Optional[jax.Array],
     cfg: TransformerConfig,
     *,
+    tokentype_ids: Optional[jax.Array] = None,
     rng_key=None,
     train: bool = False,
     sequence_parallel: bool = False,
@@ -174,7 +190,8 @@ def language_model_forward(
     else:
         k_embed = k_stack = None
     h = embedding_forward(
-        tokens, position_ids, params["embedding"], cfg, rng_key=k_embed, train=train
+        tokens, position_ids, params["embedding"], cfg,
+        tokentype_ids=tokentype_ids, rng_key=k_embed, train=train,
     )
     if sequence_parallel:
         h = constrain(h, "batch", "seq_tp", None)

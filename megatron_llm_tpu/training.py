@@ -63,26 +63,36 @@ def build_train_step(
     sp = parallel_cfg.sequence_parallel
 
     def microbatch_loss(params, micro, rng_key, scale):
+        # every batch key beyond the canonical trio is forwarded as a model
+        # kwarg (tokentype_ids / sentence_order for BERT, encoder inputs for
+        # T5 — mirroring the per-arch get_batch of the reference entry points)
+        extra = {
+            k: v for k, v in micro.items()
+            if k not in ("tokens", "labels", "loss_mask")
+        }
         loss_tok = model(
             params,
             micro["tokens"],
-            position_ids=micro.get("position_ids"),
-            attention_mask=micro.get("attention_mask"),
             labels=micro["labels"],
             rng_key=rng_key,
             train=not forward_only,
             sequence_parallel=sp,
+            **extra,
         )
-        loss = loss_func(loss_tok, micro["loss_mask"])
+        out = loss_func(loss_tok, micro["loss_mask"])
+        # loss_func may return (total, {metric: scalar}) to log components
+        # separately (reference logs a loss dict per arch, e.g. BERT's
+        # {'lm loss', 'sop loss'} — pretrain_bert.py loss_func)
+        loss, aux = out if isinstance(out, tuple) else (out, {})
         # scaled loss for fp16 (reference: optimizer.scale_loss,
         # schedules.py:142-202); scale==1 for bf16/fp32
-        return loss * scale / num_microbatches, loss
+        return loss * scale / num_microbatches, (loss, aux)
 
     if forward_only:
 
         def eval_step(params, batch, rng_key):
             def body(carry, micro):
-                _, loss = microbatch_loss(params, micro, None, 1.0)
+                _, (loss, _aux) = microbatch_loss(params, micro, None, 1.0)
                 return carry, loss
 
             _, losses = jax.lax.scan(body, 0, batch)
@@ -101,13 +111,13 @@ def build_train_step(
             micro, idx = scanned
             mkey = jax.random.fold_in(rng_key, idx)
             grad_fn = jax.value_and_grad(microbatch_loss, has_aux=True)
-            (_, loss), g = grad_fn(params, micro, mkey, scale)
+            (_, (loss, aux)), g = grad_fn(params, micro, mkey, scale)
             grads_acc = jax.tree_util.tree_map(
                 lambda a, b: a + b.astype(jnp.float32), grads_acc, g
             )
-            return grads_acc, loss
+            return grads_acc, (loss, aux)
 
-        grads, losses = jax.lax.scan(
+        grads, (losses, auxes) = jax.lax.scan(
             body, zeros, (batch, jnp.arange(num_microbatches))
         )
         new_params, new_opt_state, stats = optimizer.step(
@@ -119,6 +129,9 @@ def build_train_step(
             "loss_scale": stats["loss_scale"],
             "skipped_iter": stats["found_inf"].astype(jnp.int32),
         }
+        # component losses reported by the loss_func override the total
+        # under their own names ("lm loss" stays the true MLM loss for BERT)
+        metrics.update({k: jnp.mean(v) for k, v in auxes.items()})
         return new_params, new_opt_state, metrics
 
     return jax.jit(train_step, donate_argnums=(0, 1))
@@ -151,6 +164,11 @@ def training_log(
         f" grad norm: {float(metrics.get('grad_norm', 0.0)):.3f} |"
         f" skipped iterations: {int(metrics.get('skipped_iter', 0))} |"
     )
+    # extra loss components (e.g. BERT's 'sop loss') appear after the
+    # standard fields, like the reference's per-key loss dict logging
+    known = {"lm loss", "loss_scale", "grad_norm", "skipped_iter"}
+    for k in sorted(set(metrics) - known):
+        line += f" {k}: {float(metrics[k]):.6E} |"
     printer(line)
     if writer is not None:
         for k, v in metrics.items():

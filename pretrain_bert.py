@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""BERT pretraining entry point (masked-LM + sentence-order prediction).
+
+Reference: ``/root/reference/pretrain_bert.py`` — builds BertModel, batches
+with (tokens, loss_mask, lm_labels, padding_mask, tokentype_ids,
+sentence_order), and a loss_func summing the masked LM loss with the binary
+SOP cross entropy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu import checkpointing, topology
+from megatron_llm_tpu.arguments import (
+    parallel_config_from_args,
+    train_config_from_args,
+    transformer_config_from_args,
+)
+from megatron_llm_tpu.initialize import initialize_megatron
+from megatron_llm_tpu.models.bert import (
+    BERT_ARCH_FLAGS,
+    BertModel,
+    bert_config,
+)
+from megatron_llm_tpu.parallel import sharding as sh
+from megatron_llm_tpu.training import pretrain
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def extra_args(parser):
+    g = parser.add_argument_group("bert")
+    g.add_argument("--bert_no_binary_head", action="store_true",
+                   help="disable the sentence-order binary head")
+    g.add_argument("--masked_lm_prob", type=float, default=0.15)
+    g.add_argument("--short_seq_prob", type=float, default=0.1)
+    return parser
+
+
+def bert_loss_func(model_out, loss_mask):
+    """lm + sop loss, logged separately (reference: pretrain_bert.py
+    loss_func returns {'lm loss', 'sop loss'})."""
+    lm_loss_tok, sop_loss = model_out
+    loss_mask = loss_mask.astype(jnp.float32)
+    lm = jnp.sum(lm_loss_tok * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+    if sop_loss is None:
+        return lm
+    sop = jnp.mean(sop_loss)
+    return lm + sop, {"lm loss": lm, "sop loss": sop}
+
+
+def build_data_iterator(args, mesh, num_micro):
+    dsh = NamedSharding(mesh, P(None, "dp", None))
+    mb = args.micro_batch_size * args.data_parallel_size
+
+    if args.data_path is None:
+        rng = np.random.RandomState(args.seed)
+
+        def synth():
+            while True:
+                toks = rng.randint(
+                    0, args.padded_vocab_size, (num_micro, mb, args.seq_length)
+                ).astype(np.int32)
+                yield {
+                    "tokens": toks,
+                    "labels": toks,
+                    "loss_mask": (rng.rand(*toks.shape) < args.masked_lm_prob
+                                  ).astype(np.float32),
+                    "attention_mask": np.ones_like(toks),
+                    "tokentype_ids": np.zeros_like(toks),
+                    "sentence_order": rng.randint(
+                        0, 2, (num_micro, mb)).astype(np.int32),
+                }
+        host_iter = synth()
+    else:
+        try:
+            from megatron_llm_tpu.data.bert_dataset import (
+                build_train_valid_test_datasets,
+            )
+        except ImportError:
+            raise SystemExit(
+                "--data_path needs megatron_llm_tpu.data.bert_dataset"
+            )
+        from megatron_llm_tpu.data.data_samplers import (
+            build_pretraining_data_loader,
+        )
+
+        n_train = args.train_iters * args.global_batch_size
+        train_ds, _, _ = build_train_valid_test_datasets(
+            args.data_path, args.split, [n_train, 0, 0],
+            max_seq_length=args.seq_length,
+            masked_lm_prob=args.masked_lm_prob,
+            short_seq_prob=args.short_seq_prob,
+            seed=args.seed,
+            binary_head=not args.bert_no_binary_head,
+        )
+        host_iter = iter(build_pretraining_data_loader(
+            train_ds, 0, args.micro_batch_size, args.data_parallel_size,
+            num_micro, args.dataloader_type, args.seed,
+        ))
+
+    def gen():
+        for b in host_iter:
+            out = {}
+            for k, v in b.items():
+                arr = jnp.asarray(v)
+                s = (P(None, "dp") if arr.ndim == 2
+                     else P(None, "dp", None))
+                out[k] = jax.device_put(arr, NamedSharding(mesh, s))
+            yield out
+
+    return gen()
+
+
+def main():
+    args = initialize_megatron(extra_args_provider=extra_args)
+    if args.padded_vocab_size is None:
+        raise SystemExit("need --vocab_size/--padded_vocab_size or a tokenizer")
+
+    mesh = topology.get_mesh()
+    base = transformer_config_from_args(args, "gpt")
+    cfg = bert_config(**{
+        f.name: getattr(base, f.name)
+        for f in base.__dataclass_fields__.values()
+        if f.name not in BERT_ARCH_FLAGS
+    })
+    model = BertModel(cfg, add_binary_head=not args.bert_no_binary_head)
+    tc = train_config_from_args(args)
+    pc = parallel_config_from_args(args)
+    num_micro = args.global_batch_size // (
+        args.micro_batch_size * args.data_parallel_size
+    )
+
+    params = None
+    start_iteration = 0
+    opt_state = None
+    if args.load:
+        params, opt_state, meta = checkpointing.load_checkpoint(
+            args.load, finetune=args.finetune
+        )
+        if params is not None:
+            start_iteration = meta["iteration"]
+    if params is None:
+        params = model.init(jax.random.PRNGKey(args.seed))
+    params = sh.shard_params(params, model.param_specs(params))
+    if args.fp16 or args.bf16:
+        dt = jnp.float16 if args.fp16 else jnp.bfloat16
+        params = jax.tree_util.tree_map(lambda p: p.astype(dt), params)
+
+    train_iter = build_data_iterator(args, mesh, num_micro)
+    params, opt_state, it = pretrain(
+        model, params, tc, pc, train_iter,
+        loss_func=bert_loss_func,
+        log_interval=args.log_interval,
+        save_interval=args.save_interval,
+        save_dir=args.save,
+        start_iteration=start_iteration,
+        opt_state=opt_state,
+    )
+    if args.save:
+        checkpointing.save_checkpoint(args.save, it, params, opt_state)
+
+
+if __name__ == "__main__":
+    main()
