@@ -75,6 +75,9 @@ def _add_network_size_args(parser):
     g.add_argument("--num_attention_heads_kv", type=int, default=None)
     g.add_argument("--kv_channels", type=int, default=None)
     g.add_argument("--seq_length", type=int, default=None)
+    # T5 decoder sequence length (reference: --decoder_seq_length,
+    # megatron/arguments.py encoder/decoder seq args)
+    g.add_argument("--decoder_seq_length", type=int, default=None)
     g.add_argument("--max_position_embeddings", type=int, default=None)
     g.add_argument("--make_vocab_size_divisible_by", type=int, default=128)
     g.add_argument("--padded_vocab_size", type=int, default=None)

@@ -10,6 +10,7 @@ from megatron_llm_tpu.models.falcon import FalconModel, falcon_config
 from megatron_llm_tpu.models.mistral import MistralModel, mistral_config
 from megatron_llm_tpu.models.gpt2 import gpt2_config
 from megatron_llm_tpu.models.bert import BertModel, bert_config
+from megatron_llm_tpu.models.t5 import T5Model, t5_config
 from megatron_llm_tpu.models.classification import (
     ClassificationModel,
     MultipleChoiceModel,

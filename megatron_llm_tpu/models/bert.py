@@ -31,7 +31,10 @@ from megatron_llm_tpu.models.language_model import (
     language_model_forward,
     language_model_param_specs,
 )
-from megatron_llm_tpu.ops.cross_entropy import vocab_parallel_cross_entropy
+from megatron_llm_tpu.ops.cross_entropy import (
+    dense_cross_entropy,
+    vocab_parallel_cross_entropy,
+)
 from megatron_llm_tpu.ops.layernorm import apply_norm, init_norm_params
 from megatron_llm_tpu.parallel.layers import (
     init_linear_params,
@@ -225,8 +228,5 @@ class BertModel:
                 "the batch when computing the loss (pass "
                 "add_binary_head=False to train MLM-only)"
             )
-        logp = jax.nn.log_softmax(binary_logits.astype(jnp.float32), axis=-1)
-        sop_loss = -jnp.take_along_axis(
-            logp, sentence_order[:, None], axis=-1
-        )[:, 0]
+        sop_loss = dense_cross_entropy(binary_logits, sentence_order)
         return lm_loss, sop_loss

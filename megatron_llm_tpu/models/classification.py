@@ -25,6 +25,8 @@ from megatron_llm_tpu.models.language_model import (
     language_model_forward,
     language_model_param_specs,
 )
+from megatron_llm_tpu.models.transformer import _dropout
+from megatron_llm_tpu.ops.cross_entropy import dense_cross_entropy
 from megatron_llm_tpu.parallel.layers import (
     init_linear_params,
     init_method_normal,
@@ -98,11 +100,7 @@ class ClassificationModel:
             rng_key, train, sequence_parallel,
         )
         # head dropout (reference: classification.py:55-60)
-        if train and self.cfg.hidden_dropout > 0.0 and k_drop is not None:
-            keep = jax.random.bernoulli(
-                k_drop, 1.0 - self.cfg.hidden_dropout, pooled.shape
-            )
-            pooled = pooled * keep.astype(pooled.dtype) / (1.0 - self.cfg.hidden_dropout)
+        pooled = _dropout(pooled, self.cfg.hidden_dropout, k_drop, train)
         head = params["classification_head"]
         logits = (
             pooled @ head["kernel"].astype(pooled.dtype)
@@ -110,8 +108,7 @@ class ClassificationModel:
         )
         if labels is None:
             return logits
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        return -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        return dense_cross_entropy(logits, labels)
 
 
 class MultipleChoiceModel(ClassificationModel):
@@ -144,5 +141,4 @@ class MultipleChoiceModel(ClassificationModel):
         logits = logits.reshape(b, nc)  # [b*nc, 1] -> [b, nc]
         if labels is None:
             return logits
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        return -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        return dense_cross_entropy(logits, labels)

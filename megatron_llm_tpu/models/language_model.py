@@ -73,45 +73,72 @@ def init_language_model_params(key, cfg: TransformerConfig, dtype=None):
     return params
 
 
-def language_model_param_specs(params, cfg: TransformerConfig):
-    """Logical-axis spec pytree matching ``init_language_model_params``
-    (consumed by ``parallel.sharding.shard_params``)."""
+def _linear_spec(p, in_ax, out_ax, stacked):
+    lead = ("stage",) if stacked else ()
+    spec = {"kernel": lead + (in_ax, out_ax)}
+    if "bias" in p:
+        spec["bias"] = lead + (out_ax,)
+    return spec
 
-    def linear_spec(p, in_ax, out_ax, stacked):
-        lead = ("stage",) if stacked else ()
-        spec = {"kernel": lead + (in_ax, out_ax)}
-        if "bias" in p:
-            spec["bias"] = lead + (out_ax,)
-        return spec
 
-    def norm_spec(p, stacked):
-        lead = ("stage",) if stacked else ()
-        return {k: lead + (None,) for k in p}
+def _norm_spec(p, stacked):
+    lead = ("stage",) if stacked else ()
+    return {k: lead + (None,) for k in p}
 
-    layers = params["transformer"]["layers"]
+
+def transformer_layer_specs(layers, stacked: bool = True) -> dict:
+    """Logical-axis specs for one (layer-stacked) transformer layer pytree,
+    including the decoder ``inter_attention`` block when present."""
     layer_specs = {
-        "input_norm": norm_spec(layers["input_norm"], True),
+        "input_norm": _norm_spec(layers["input_norm"], stacked),
         "attention": {
-            "query_key_value": linear_spec(
-                layers["attention"]["query_key_value"], None, "heads", True
+            "query_key_value": _linear_spec(
+                layers["attention"]["query_key_value"], None, "heads", stacked
             ),
-            "dense": linear_spec(layers["attention"]["dense"], "heads", None, True),
+            "dense": _linear_spec(
+                layers["attention"]["dense"], "heads", None, stacked
+            ),
         },
         "mlp": {
-            "dense_h_to_4h": linear_spec(
-                layers["mlp"]["dense_h_to_4h"], None, "ffn", True
+            "dense_h_to_4h": _linear_spec(
+                layers["mlp"]["dense_h_to_4h"], None, "ffn", stacked
             ),
-            "dense_4h_to_h": linear_spec(
-                layers["mlp"]["dense_4h_to_h"], "ffn", None, True
+            "dense_4h_to_h": _linear_spec(
+                layers["mlp"]["dense_4h_to_h"], "ffn", None, stacked
             ),
         },
     }
     if "post_attention_norm" in layers:
-        layer_specs["post_attention_norm"] = norm_spec(
-            layers["post_attention_norm"], True
+        layer_specs["post_attention_norm"] = _norm_spec(
+            layers["post_attention_norm"], stacked
         )
     if "mlp_norm" in layers:
-        layer_specs["mlp_norm"] = norm_spec(layers["mlp_norm"], True)
+        layer_specs["mlp_norm"] = _norm_spec(layers["mlp_norm"], stacked)
+    if "inter_attention" in layers:
+        ia = layers["inter_attention"]
+        layer_specs["inter_attention"] = {
+            "query": _linear_spec(ia["query"], None, "heads", stacked),
+            "key_value": _linear_spec(ia["key_value"], None, "heads", stacked),
+            "dense": _linear_spec(ia["dense"], "heads", None, stacked),
+        }
+        layer_specs["post_inter_attention_norm"] = _norm_spec(
+            layers["post_inter_attention_norm"], stacked
+        )
+    return layer_specs
+
+
+def transformer_stack_specs(stack_params) -> dict:
+    return {
+        "layers": transformer_layer_specs(stack_params["layers"]),
+        "final_norm": _norm_spec(stack_params["final_norm"], False),
+    }
+
+
+def language_model_param_specs(params, cfg: TransformerConfig):
+    """Logical-axis spec pytree matching ``init_language_model_params``
+    (consumed by ``parallel.sharding.shard_params``)."""
+    norm_spec = _norm_spec
+    layer_specs = transformer_layer_specs(params["transformer"]["layers"])
 
     specs = {
         "embedding": {"word": {"embedding": ("vocab", None)}},

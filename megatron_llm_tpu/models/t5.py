@@ -1,0 +1,222 @@
+"""T5 encoder-decoder model.
+
+Reference: ``megatron/model/t5_model.py`` — ``t5_extended_attention_mask``
+(:20-27), ``t5_position_ids`` (:30-37), ``T5LMHead`` (:40-67, vocab-sharded
+logits bias over the tied word embedding), ``T5Model`` (:70-166); decoder
+cross-attention in ``megatron/model/transformer.py:695-714,813-825``.
+
+TPU design: same functional pattern as GPT/BERT — the class holds the
+hashable config, params are a pytree.  Encoder and decoder are two
+independent scanned transformer stacks sharing one vocab-parallel word
+embedding and one learned-absolute position table (matching the reference,
+which routes both streams through a single ``TransformerLanguageModel``).
+The encoder runs bidirectionally over a padding mask; the decoder runs
+causal+padding self-attention plus cross-attention over the encoder output.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.config import (
+    AttnMaskType,
+    PositionEmbeddingType,
+    TransformerConfig,
+)
+from megatron_llm_tpu.models.language_model import (
+    embedding_forward,
+    flops_per_token,
+    transformer_stack_specs,
+)
+from megatron_llm_tpu.models.transformer import init_stack_params, transformer_stack
+from megatron_llm_tpu.ops.cross_entropy import vocab_parallel_cross_entropy
+from megatron_llm_tpu.parallel.layers import (
+    init_embedding_params,
+    init_method_normal,
+    parallel_lm_logits,
+)
+
+
+# Architecture flags T5 forces (reference: pretrain_t5.py defaults +
+# t5_model asserts; encoder is bidirectional => padding mask).
+T5_ARCH_FLAGS = dict(
+    position_embedding_type=PositionEmbeddingType.learned_absolute,
+    attn_mask_type=AttnMaskType.padding,
+    normalization="layernorm",
+    glu_activation=None,
+    add_bias_linear=True,
+    tie_embed_logits=True,
+    parallel_attn=False,
+    use_flash_attn=False,  # explicit [b,1,sq,sk] masks go through core attention
+)
+
+
+def t5_config(**overrides) -> TransformerConfig:
+    defaults = dict(T5_ARCH_FLAGS)
+    defaults.update(overrides)
+    return TransformerConfig(**defaults)
+
+
+def t5_extended_attention_mask(masks):
+    """List of [b, sq, sk] 1=attend masks -> [b, 1, sq, sk] bool
+    True=masked-away (reference: t5_model.py:20-27 + get_batch's ``< 0.5``).
+    Already-extended [b, 1, sq, sk] inputs are accepted too (bool passes
+    through; numeric is inverted with the same ``< 0.5`` rule)."""
+    out = []
+    for m in masks:
+        if m is None:
+            out.append(None)
+        elif m.ndim == 3:
+            out.append((m < 0.5)[:, None])
+        elif m.ndim == 4:
+            out.append(m if m.dtype == jnp.bool_ else (m < 0.5))
+        else:
+            raise ValueError(
+                f"T5 attention masks must be [b, sq, sk] (1=attend) or "
+                f"[b, 1, sq, sk]; got ndim={m.ndim}"
+            )
+    return out
+
+
+def t5_position_ids(token_ids: jax.Array) -> jax.Array:
+    """Reference: t5_model.py:30-37."""
+    s = token_ids.shape[1]
+    return jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.int32)[None, :], token_ids.shape
+    )
+
+
+class T5Model:
+    """Functional T5 (reference ``T5Model``, t5_model.py:70-166).
+
+    Param pytree::
+
+      {'embedding': {'word', 'position'},
+       'encoder': {'layers': [L,...], 'final_norm'},
+       'decoder': {'layers': [L,...] (+inter_attention), 'final_norm'},
+       'lm_head': {'bias': [V]}}
+    """
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+
+    # -- params ------------------------------------------------------------
+    def init(self, key) -> dict:
+        cfg = self.cfg
+        dtype = cfg.params_jnp_dtype
+        k_emb, k_pos, k_enc, k_dec = jax.random.split(key, 4)
+        init = init_method_normal(cfg.init_method_std)
+        return {
+            "embedding": {
+                "word": init_embedding_params(
+                    k_emb, cfg.padded_vocab_size, cfg.hidden_size,
+                    init_method=init, dtype=dtype,
+                ),
+                "position": init_embedding_params(
+                    k_pos, cfg.max_position_embeddings, cfg.hidden_size,
+                    init_method=init, dtype=dtype,
+                ),
+            },
+            "encoder": init_stack_params(k_enc, cfg, dtype, "encoder"),
+            "decoder": init_stack_params(k_dec, cfg, dtype, "decoder"),
+            # vocab-sharded logits bias (reference T5LMHead, t5_model.py:51-67)
+            "lm_head": {"bias": jnp.zeros((cfg.padded_vocab_size,), dtype)},
+        }
+
+    def param_specs(self, params) -> dict:
+        specs = {
+            "embedding": {
+                "word": {"embedding": ("vocab", None)},
+                "position": {"embedding": (None, None)},
+            },
+            "encoder": transformer_stack_specs(params["encoder"]),
+            "decoder": transformer_stack_specs(params["decoder"]),
+            "lm_head": {"bias": ("vocab",)},
+        }
+        return specs
+
+    def num_params(self, params) -> int:
+        return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+    def flops_per_token(self, seq_len=None) -> float:
+        # encoder + decoder stacks ~ 2x a single stack of the same depth
+        return 2.0 * flops_per_token(self.cfg, seq_len)
+
+    # -- forward -----------------------------------------------------------
+    def __call__(
+        self,
+        params,
+        encoder_input_ids: jax.Array,
+        decoder_input_ids: Optional[jax.Array] = None,
+        encoder_attn_mask: Optional[jax.Array] = None,
+        decoder_attn_mask: Optional[jax.Array] = None,
+        encoder_decoder_attn_mask: Optional[jax.Array] = None,
+        *,
+        tokentype_ids: Optional[jax.Array] = None,
+        lm_labels: Optional[jax.Array] = None,
+        labels: Optional[jax.Array] = None,  # alias used by the train step
+        rng_key=None,
+        train: bool = False,
+        sequence_parallel: bool = False,
+    ):
+        """Masks follow the reference convention: [b, sq, sk] with 1=attend
+        (``make_attention_mask``/``make_history_mask`` from the T5 dataset).
+        Returns the per-token loss [b, s_dec] when ``lm_labels`` is given,
+        else logits [b, s_dec, V] (reference: t5_model.py:119-166)."""
+        cfg = self.cfg
+        if lm_labels is None:
+            lm_labels = labels
+        if decoder_input_ids is None:
+            raise ValueError("T5Model needs decoder_input_ids in the batch")
+        enc_mask, dec_mask, enc_dec_mask = t5_extended_attention_mask(
+            [encoder_attn_mask, decoder_attn_mask, encoder_decoder_attn_mask]
+        )
+        if rng_key is not None:
+            k_enc_emb, k_enc, k_dec_emb, k_dec = jax.random.split(rng_key, 4)
+        else:
+            k_enc_emb = k_enc = k_dec_emb = k_dec = None
+
+        # encoder
+        enc_h = embedding_forward(
+            encoder_input_ids, t5_position_ids(encoder_input_ids),
+            params["embedding"], cfg,
+            tokentype_ids=tokentype_ids, rng_key=k_enc_emb, train=train,
+        )
+        if enc_mask is None:
+            s = encoder_input_ids.shape[1]
+            enc_mask = jnp.zeros((1, 1, s, s), jnp.bool_)
+        enc_out = transformer_stack(
+            enc_h, params["encoder"], cfg,
+            attention_mask=enc_mask, rng_key=k_enc, train=train,
+            sequence_parallel=sequence_parallel,
+        )
+
+        # decoder (causal self-attn + cross-attn over encoder output)
+        dec_h = embedding_forward(
+            decoder_input_ids, t5_position_ids(decoder_input_ids),
+            params["embedding"], cfg,
+            rng_key=k_dec_emb, train=train,
+        )
+        dec_out = transformer_stack(
+            dec_h, params["decoder"], cfg,
+            attention_mask=dec_mask, rng_key=k_dec, train=train,
+            sequence_parallel=sequence_parallel,
+            encoder_output=enc_out, enc_dec_mask=enc_dec_mask,
+        )
+
+        word_emb = params["embedding"]["word"]["embedding"]
+        logits = parallel_lm_logits(
+            dec_out, word_emb,
+            sequence_parallel=sequence_parallel,
+            compute_dtype=cfg.compute_jnp_dtype,
+        )
+        logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
+
+        if lm_labels is None:
+            return logits
+        return vocab_parallel_cross_entropy(
+            logits.astype(jnp.float32), lm_labels
+        )

@@ -82,6 +82,36 @@ def init_attention_params(key, cfg: TransformerConfig, dtype):
     }
 
 
+def init_cross_attention_params(key, cfg: TransformerConfig, dtype):
+    """Decoder cross-attention projections (reference ``ParallelAttention``
+    with ``AttnType.cross_attn``, transformer.py:344-365): separate
+    column-parallel Q (from decoder states) and packed KV (from encoder
+    output), row-parallel dense.  Cross-attention always uses the full head
+    count (no GQA)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    init = init_method_normal(cfg.init_method_std)
+    out_init = (
+        scaled_init_method_normal(cfg.init_method_std, cfg.num_layers)
+        if cfg.use_scaled_init_method
+        else init
+    )
+    nh_d = cfg.num_attention_heads * cfg.head_dim
+    return {
+        "query": init_linear_params(
+            k1, cfg.hidden_size, nh_d,
+            bias=cfg.add_bias_linear, init_method=init, dtype=dtype,
+        ),
+        "key_value": init_linear_params(
+            k2, cfg.hidden_size, 2 * nh_d,
+            bias=cfg.add_bias_linear, init_method=init, dtype=dtype,
+        ),
+        "dense": init_linear_params(
+            k3, nh_d, cfg.hidden_size,
+            bias=cfg.add_bias_linear, init_method=out_init, dtype=dtype,
+        ),
+    }
+
+
 def init_mlp_params(key, cfg: TransformerConfig, dtype):
     k1, k2 = jax.random.split(key)
     init = init_method_normal(cfg.init_method_std)
@@ -104,7 +134,7 @@ def init_mlp_params(key, cfg: TransformerConfig, dtype):
     }
 
 
-def init_layer_params(key, cfg: TransformerConfig, dtype):
+def init_layer_params(key, cfg: TransformerConfig, dtype, layer_type: str = "encoder"):
     ka, km, kn = jax.random.split(key, 3)
     params = {
         "input_norm": init_norm_params(cfg.hidden_size, cfg.normalization, dtype),
@@ -121,16 +151,22 @@ def init_layer_params(key, cfg: TransformerConfig, dtype):
         params["mlp_norm"] = init_norm_params(
             cfg.hidden_size, cfg.normalization, dtype
         )
-    del kn
+    if layer_type == "decoder":
+        # T5 decoder: cross-attention over encoder output + its own norm
+        # (reference: LayerType.decoder, transformer.py:695-714)
+        params["inter_attention"] = init_cross_attention_params(kn, cfg, dtype)
+        params["post_inter_attention_norm"] = init_norm_params(
+            cfg.hidden_size, cfg.normalization, dtype
+        )
     return params
 
 
-def init_stack_params(key, cfg: TransformerConfig, dtype):
+def init_stack_params(key, cfg: TransformerConfig, dtype, layer_type: str = "encoder"):
     """Layer-stacked params: every leaf gets a leading [num_layers] axis
     (scanned).  Reference builds a Python list of modules
     (transformer.py:983-1014)."""
     keys = jax.random.split(key, cfg.num_layers)
-    layers = [init_layer_params(k, cfg, dtype) for k in keys]
+    layers = [init_layer_params(k, cfg, dtype, layer_type) for k in keys]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
     return {
         "layers": stacked,
@@ -307,6 +343,57 @@ def attention(
     return out
 
 
+def cross_attention(
+    x: jax.Array,
+    encoder_output: jax.Array,
+    params,
+    cfg: TransformerConfig,
+    *,
+    enc_dec_mask: Optional[jax.Array],
+    dropout_key: Optional[jax.Array],
+    train: bool,
+    sequence_parallel: bool = False,
+) -> jax.Array:
+    """Encoder-decoder attention (reference ``ParallelAttention`` with
+    ``AttnType.cross_attn``, transformer.py:344-365,466-476): Q from the
+    decoder stream, packed KV from the encoder output, full head count.
+
+    ``enc_dec_mask``: [b, 1, sq, sk] bool, True = masked away; None attends
+    everywhere."""
+    nh, d = cfg.num_attention_heads, cfg.head_dim
+    q = column_parallel_linear(
+        x, params["query"],
+        out_logical="heads",
+        sequence_parallel=sequence_parallel,
+        compute_dtype=cfg.compute_jnp_dtype,
+    )
+    kv = column_parallel_linear(
+        encoder_output, params["key_value"],
+        out_logical="heads",
+        sequence_parallel=sequence_parallel,
+        compute_dtype=cfg.compute_jnp_dtype,
+    )
+    b, sq = x.shape[:2]
+    sk = encoder_output.shape[1]
+    q = q.reshape(b, sq, nh, d)
+    # packed [nh, 2*d] layout, first d = K (reference splits 2*hn in half,
+    # transformer.py:471-476)
+    kv = kv.reshape(b, sk, nh, 2, d)
+    k = kv[:, :, :, 0, :]
+    v = kv[:, :, :, 1, :]
+
+    if enc_dec_mask is None:
+        enc_dec_mask = jnp.zeros((1, 1, sq, sk), jnp.bool_)
+    ctx = core_attention(q, k, v, cfg, enc_dec_mask, dropout_key, train)
+    ctx = ctx.reshape(b, sq, nh * d)
+    return row_parallel_linear(
+        ctx, params["dense"],
+        in_logical="heads",
+        sequence_parallel=sequence_parallel,
+        compute_dtype=cfg.compute_jnp_dtype,
+    )
+
+
 # ---------------------------------------------------------------------------
 # MLP
 # ---------------------------------------------------------------------------
@@ -371,6 +458,8 @@ def transformer_layer(
     sequence_parallel: bool = False,
     hidden_dropout: Optional[float] = None,
     kv_cache=None,
+    encoder_output: Optional[jax.Array] = None,
+    enc_dec_mask: Optional[jax.Array] = None,
 ):
     """One decoder layer (reference ``ParallelTransformerLayer``,
     transformer.py:612-846), supporting:
@@ -379,11 +468,24 @@ def transformer_layer(
     * Falcon parallel attention+MLP (``parallel_attn``, :635-664,804-845)
       with optional separate MLP layernorm (``parallel_layernorm``)
     * per-layer hidden dropout override (lima dropout, :765-777)
+    * T5-style cross-attention when the layer has ``inter_attention`` params
+      and ``encoder_output`` is given (``LayerType.decoder``, :695-714,813-825)
     """
+    is_decoder = "inter_attention" in params and encoder_output is not None
+    if is_decoder and cfg.parallel_attn:
+        raise NotImplementedError(
+            "cross-attention (T5 decoder) is not supported with parallel_attn"
+        )
     if hidden_dropout is None:
         hidden_dropout = cfg.hidden_dropout
+    # NB: the split count depends only on static pytree structure, so
+    # decoder-only models keep their pre-existing dropout streams
+    k_x_drop = k_hx = None
     if rng_key is not None:
-        k_attn_drop, k_h1, k_h2 = jax.random.split(rng_key, 3)
+        if is_decoder:
+            k_attn_drop, k_h1, k_h2, k_x_drop, k_hx = jax.random.split(rng_key, 5)
+        else:
+            k_attn_drop, k_h1, k_h2 = jax.random.split(rng_key, 3)
     else:
         k_attn_drop = k_h1 = k_h2 = None
 
@@ -424,16 +526,36 @@ def transformer_layer(
             return out, new_cache
         return out
 
-    # sequential: attn -> residual -> ln -> mlp -> residual
+    # sequential: attn -> residual -> ln [-> cross-attn -> residual -> ln]
+    # -> mlp -> residual
     h = residual + _dropout(attn_out, hidden_dropout, k_h1, train)
     if cfg.use_post_ln:
         h = norm(h, params["input_norm"])
     residual = h
     ln2 = norm(h, params["post_attention_norm"]) if not cfg.use_post_ln else h
+    if is_decoder:
+        # reference: transformer.py:813-825
+        inter_out = cross_attention(
+            ln2, encoder_output, params["inter_attention"], cfg,
+            enc_dec_mask=enc_dec_mask, dropout_key=k_x_drop, train=train,
+            sequence_parallel=sequence_parallel,
+        )
+        h = residual + _dropout(inter_out, hidden_dropout, k_hx, train)
+        if cfg.use_post_ln:
+            h = norm(h, params["post_attention_norm"])
+        residual = h
+        ln2 = (
+            norm(h, params["post_inter_attention_norm"])
+            if not cfg.use_post_ln else h
+        )
     mlp_out = mlp(ln2, params["mlp"], cfg, sequence_parallel=sequence_parallel)
     out = residual + _dropout(mlp_out, hidden_dropout, k_h2, train)
     if cfg.use_post_ln:
-        out = norm(out, params["post_attention_norm"])
+        out = norm(
+            out,
+            params["post_inter_attention_norm" if is_decoder
+                   else "post_attention_norm"],
+        )
     if kv_cache is not None:
         return out, new_cache
     return out
@@ -464,6 +586,8 @@ def transformer_stack(
     train: bool = False,
     sequence_parallel: bool = False,
     kv_caches=None,
+    encoder_output: Optional[jax.Array] = None,
+    enc_dec_mask: Optional[jax.Array] = None,
 ):
     """Scan the layer body over layer-stacked params (reference
     ``ParallelTransformer.forward``, transformer.py:1188-1282) and apply the
@@ -492,6 +616,7 @@ def transformer_stack(
             rng_key=key if rng_key is not None else None,
             train=train, sequence_parallel=sequence_parallel,
             hidden_dropout=rate,
+            encoder_output=encoder_output, enc_dec_mask=enc_dec_mask,
         )
         return out, None
 

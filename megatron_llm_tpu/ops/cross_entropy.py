@@ -65,6 +65,17 @@ def vocab_parallel_cross_entropy(
     return loss
 
 
+def dense_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-example CE over a small unsharded class axis (fp32 compute) —
+    the classification/SOP-head counterpart of
+    ``vocab_parallel_cross_entropy`` (reference: plain F.cross_entropy in
+    pretrain_bert.py / tasks finetune_utils)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(
+        logp, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+
+
 def vocab_parallel_max_indices(logits: jax.Array) -> jax.Array:
     """Global argmax over the (possibly tp-sharded) vocab axis
     (reference: cross_entropy.py:146-175)."""

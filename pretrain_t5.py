@@ -1,10 +1,9 @@
 #!/usr/bin/env python
-"""BERT pretraining entry point (masked-LM + sentence-order prediction).
+"""T5 pretraining entry point (span-corruption denoising).
 
-Reference: ``/root/reference/pretrain_bert.py`` — builds BertModel, batches
-with (tokens, loss_mask, lm_labels, padding_mask, tokentype_ids,
-sentence_order), and a loss_func summing the masked LM loss with the binary
-SOP cross entropy.
+Reference: ``/root/reference/pretrain_t5.py`` — batches with (text_enc,
+text_dec, labels, loss_mask, enc_mask, dec_mask, enc_dec_mask) and a
+masked-mean lm loss (:76-135).
 """
 
 from __future__ import annotations
@@ -20,69 +19,51 @@ from megatron_llm_tpu.arguments import (
     transformer_config_from_args,
 )
 from megatron_llm_tpu.initialize import initialize_megatron
-from megatron_llm_tpu.models.bert import (
-    BERT_ARCH_FLAGS,
-    BertModel,
-    bert_config,
-)
+from megatron_llm_tpu.models.t5 import T5_ARCH_FLAGS, T5Model, t5_config
 from megatron_llm_tpu.parallel import sharding as sh
 from megatron_llm_tpu.training import pretrain
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def extra_args(parser):
-    g = parser.add_argument_group("bert")
-    g.add_argument("--bert_no_binary_head", action="store_true",
-                   help="disable the sentence-order binary head")
+    g = parser.add_argument_group("t5")
     g.add_argument("--masked_lm_prob", type=float, default=0.15)
     g.add_argument("--short_seq_prob", type=float, default=0.1)
     return parser
 
 
-def bert_loss_func(model_out, loss_mask):
-    """lm + sop loss, logged separately (reference: pretrain_bert.py
-    loss_func returns {'lm loss', 'sop loss'})."""
-    lm_loss_tok, sop_loss = model_out
-    loss_mask = loss_mask.astype(jnp.float32)
-    lm = jnp.sum(lm_loss_tok * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
-    if sop_loss is None:
-        return lm
-    sop = jnp.mean(sop_loss)
-    return lm + sop, {"lm loss": lm, "sop loss": sop}
-
-
 def build_data_iterator(args, mesh, num_micro):
-    dsh = NamedSharding(mesh, P(None, "dp", None))
     mb = args.micro_batch_size * args.data_parallel_size
+    s_enc = args.seq_length
+    s_dec = args.decoder_seq_length or args.seq_length
 
     if args.data_path is None:
         rng = np.random.RandomState(args.seed)
 
         def synth():
+            ones_ee = np.ones((num_micro, mb, s_enc, s_enc), np.int32)
+            ones_dd = np.tril(np.ones((s_dec, s_dec), np.int32))[None, None]
+            ones_dd = np.broadcast_to(ones_dd, (num_micro, mb, s_dec, s_dec)).copy()
+            ones_de = np.ones((num_micro, mb, s_dec, s_enc), np.int32)
             while True:
-                toks = rng.randint(
-                    0, args.padded_vocab_size, (num_micro, mb, args.seq_length)
-                ).astype(np.int32)
+                enc = rng.randint(0, args.padded_vocab_size,
+                                  (num_micro, mb, s_enc)).astype(np.int32)
+                dec = rng.randint(0, args.padded_vocab_size,
+                                  (num_micro, mb, s_dec)).astype(np.int32)
                 yield {
-                    "tokens": toks,
-                    "labels": toks,
-                    "loss_mask": (rng.rand(*toks.shape) < args.masked_lm_prob
-                                  ).astype(np.float32),
-                    "attention_mask": np.ones_like(toks),
-                    "tokentype_ids": np.zeros_like(toks),
-                    "sentence_order": rng.randint(
-                        0, 2, (num_micro, mb)).astype(np.int32),
+                    "tokens": enc,
+                    "decoder_input_ids": dec,
+                    "labels": dec,
+                    "loss_mask": np.ones((num_micro, mb, s_dec), np.float32),
+                    "encoder_attn_mask": ones_ee,
+                    "decoder_attn_mask": ones_dd,
+                    "encoder_decoder_attn_mask": ones_de,
                 }
         host_iter = synth()
     else:
-        try:
-            from megatron_llm_tpu.data.bert_dataset import (
-                build_train_valid_test_datasets,
-            )
-        except ImportError:
-            raise SystemExit(
-                "--data_path needs megatron_llm_tpu.data.bert_dataset"
-            )
+        from megatron_llm_tpu.data.t5_dataset import (
+            build_train_valid_test_datasets,
+        )
         from megatron_llm_tpu.data.data_samplers import (
             build_pretraining_data_loader,
         )
@@ -90,11 +71,12 @@ def build_data_iterator(args, mesh, num_micro):
         n_train = args.train_iters * args.global_batch_size
         train_ds, _, _ = build_train_valid_test_datasets(
             args.data_path, args.split, [n_train, 0, 0],
-            max_seq_length=args.seq_length,
+            max_seq_length=s_enc,
+            max_seq_length_dec=s_dec,
             masked_lm_prob=args.masked_lm_prob,
             short_seq_prob=args.short_seq_prob,
             seed=args.seed,
-            binary_head=not args.bert_no_binary_head,
+            vocab_extra_ids=args.vocab_extra_ids,
         )
         host_iter = iter(build_pretraining_data_loader(
             train_ds, 0, args.micro_batch_size, args.data_parallel_size,
@@ -106,9 +88,8 @@ def build_data_iterator(args, mesh, num_micro):
             out = {}
             for k, v in b.items():
                 arr = jnp.asarray(v)
-                s = (P(None, "dp") if arr.ndim == 2
-                     else P(None, "dp", None))
-                out[k] = jax.device_put(arr, NamedSharding(mesh, s))
+                spec = [None, "dp"] + [None] * (arr.ndim - 2)
+                out[k] = jax.device_put(arr, NamedSharding(mesh, P(*spec)))
             yield out
 
     return gen()
@@ -119,21 +100,21 @@ def main():
     if args.padded_vocab_size is None:
         raise SystemExit("need --vocab_size/--padded_vocab_size or a tokenizer")
     if args.pipeline_model_parallel_size > 1:
-        # the BERT path runs through the generic (non-pipelined) train step;
+        # the T5 path runs through the generic (non-pipelined) train step;
         # use finetune.py / pretrain_gpt.py for pp > 1
         raise SystemExit(
-            "pretrain_bert.py does not support "
+            "pretrain_t5.py does not support "
             "--pipeline_model_parallel_size > 1 (tp/dp only)"
         )
 
     mesh = topology.get_mesh()
     base = transformer_config_from_args(args, "gpt")
-    cfg = bert_config(**{
+    cfg = t5_config(**{
         f.name: getattr(base, f.name)
         for f in base.__dataclass_fields__.values()
-        if f.name not in BERT_ARCH_FLAGS
+        if f.name not in T5_ARCH_FLAGS
     })
-    model = BertModel(cfg, add_binary_head=not args.bert_no_binary_head)
+    model = T5Model(cfg)
     tc = train_config_from_args(args)
     pc = parallel_config_from_args(args)
     num_micro = args.global_batch_size // (
@@ -159,7 +140,6 @@ def main():
     train_iter = build_data_iterator(args, mesh, num_micro)
     params, opt_state, it = pretrain(
         model, params, tc, pc, train_iter,
-        loss_func=bert_loss_func,
         log_interval=args.log_interval,
         save_interval=args.save_interval,
         save_dir=args.save,
