@@ -1,0 +1,145 @@
+"""BERT-style masked-LM + sentence-order dataset.
+
+Capability parity with the reference's ``megatron/data/bert_dataset.py``
+(BertDataset :23-77, build_training_sample :81-149).  Sample keys are named
+for the TPU model's batch contract (``tokens/labels/loss_mask/
+attention_mask/tokentype_ids/sentence_order``) instead of the reference's
+``text/.../is_random`` — same content.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from megatron_llm_tpu.data.dataset_utils import (
+    DSET_TYPE_BERT,
+    build_train_valid_test_datasets_core,
+    create_masked_lm_predictions,
+    create_tokens_and_tokentypes,
+    get_a_and_b_segments,
+    get_samples_mapping,
+    pad_and_convert_to_numpy,
+    truncate_segments,
+)
+
+
+class BertDataset:
+    def __init__(self, name, indexed_dataset, data_prefix, num_epochs,
+                 max_num_samples, masked_lm_prob, max_seq_length,
+                 short_seq_prob, seed, binary_head, tokenizer=None):
+        self.name = name
+        self.seed = seed
+        self.masked_lm_prob = masked_lm_prob
+        self.max_seq_length = max_seq_length
+        self.binary_head = binary_head
+        self.indexed_dataset = indexed_dataset
+
+        # -3: [CLS] + 2x[SEP] are added on top of the sampled sentences
+        self.samples_mapping = get_samples_mapping(
+            indexed_dataset, data_prefix, num_epochs, max_num_samples,
+            self.max_seq_length - 3, short_seq_prob, self.seed, self.name,
+            self.binary_head)
+
+        if tokenizer is None:
+            from megatron_llm_tpu.global_vars import get_tokenizer
+            tokenizer = get_tokenizer()
+        self.vocab_id_list = list(tokenizer.inv_vocab.keys())
+        self.vocab_id_to_token_dict = tokenizer.inv_vocab
+        self.cls_id = tokenizer.cls
+        self.sep_id = tokenizer.sep
+        self.mask_id = tokenizer.mask
+        self.pad_id = tokenizer.pad
+
+    def __len__(self):
+        return self.samples_mapping.shape[0]
+
+    def __getitem__(self, idx):
+        start, end, seq_length = (int(v) for v in self.samples_mapping[idx])
+        sample = [self.indexed_dataset[i] for i in range(start, end)]
+        # numpy RNG: randint is exclusive on the upper bound (the reference
+        # warns python's random.randint is not)
+        np_rng = np.random.RandomState(seed=(self.seed + idx) % 2**32)
+        return build_training_sample(
+            sample, seq_length, self.max_seq_length, self.vocab_id_list,
+            self.vocab_id_to_token_dict, self.cls_id, self.sep_id,
+            self.mask_id, self.pad_id, self.masked_lm_prob, np_rng,
+            self.binary_head)
+
+
+def build_training_sample(sample, target_seq_length, max_seq_length,
+                          vocab_id_list, vocab_id_to_token_dict,
+                          cls_id, sep_id, mask_id, pad_id,
+                          masked_lm_prob, np_rng, binary_head):
+    """One [CLS] A [SEP] B [SEP] masked-LM sample (reference:
+    bert_dataset.py:81-149)."""
+    if binary_head:
+        assert len(sample) > 1
+    assert target_seq_length <= max_seq_length
+
+    if binary_head:
+        tokens_a, tokens_b, is_next_random = get_a_and_b_segments(sample,
+                                                                  np_rng)
+    else:
+        tokens_a = [t for sent in sample for t in sent]
+        tokens_b, is_next_random = [], False
+
+    truncated = truncate_segments(tokens_a, tokens_b, len(tokens_a),
+                                  len(tokens_b), target_seq_length, np_rng)
+    tokens, tokentypes = create_tokens_and_tokentypes(tokens_a, tokens_b,
+                                                      cls_id, sep_id)
+
+    max_predictions = masked_lm_prob * target_seq_length
+    (tokens, masked_positions, masked_labels, _, _) = \
+        create_masked_lm_predictions(
+            tokens, vocab_id_list, vocab_id_to_token_dict, masked_lm_prob,
+            cls_id, sep_id, mask_id, max_predictions, np_rng)
+
+    tokens_np, tokentypes_np, labels_np, padding_mask_np, loss_mask_np = \
+        pad_and_convert_to_numpy(tokens, tokentypes, masked_positions,
+                                 masked_labels, pad_id, max_seq_length)
+
+    return {
+        "tokens": tokens_np,
+        "tokentype_ids": tokentypes_np,
+        "labels": labels_np,
+        "sentence_order": np.int64(is_next_random),
+        "loss_mask": loss_mask_np,
+        "attention_mask": padding_mask_np,
+        "truncated": np.int64(truncated),
+    }
+
+
+def build_train_valid_test_datasets(data_prefix, splits_string,
+                                    train_valid_test_num_samples,
+                                    max_seq_length: int,
+                                    masked_lm_prob: float,
+                                    short_seq_prob: float,
+                                    seed: int,
+                                    binary_head: bool = True,
+                                    tokenizer=None,
+                                    data_impl: str = "mmap"):
+    """Entry used by pretrain_bert.py (reference: dataset_utils.py:421)."""
+    return build_train_valid_test_datasets_core(
+        data_prefix, splits_string, train_valid_test_num_samples,
+        max_seq_length, masked_lm_prob, short_seq_prob, seed,
+        DSET_TYPE_BERT, tokenizer, binary_head=binary_head,
+        data_impl=data_impl)
+
+
+def bert_collate(micros):
+    """[[sample,...] per microbatch] -> batch dict of [M, B, ...] arrays
+    (labels: -1 padding swapped to 0, the loss_mask already excludes it)."""
+    out = {}
+    for key in ("tokens", "tokentype_ids", "labels", "loss_mask",
+                "attention_mask", "sentence_order"):
+        arr = np.stack([np.stack([s[key] for s in m]) for m in micros])
+        if key == "labels":
+            arr = np.where(arr < 0, 0, arr)
+        if key == "loss_mask":
+            arr = arr.astype(np.float32)
+        elif key in ("tokens", "labels", "sentence_order"):
+            arr = arr.astype(np.int32)
+        out[key] = arr
+    return out

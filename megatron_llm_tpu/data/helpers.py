@@ -26,12 +26,16 @@ def _load() -> Optional[ctypes.CDLL]:
     if _LIB is not None or _TRIED:
         return _LIB
     _TRIED = True
-    if not os.path.exists(_SO):
+    src = os.path.join(_HERE, "helpers.cpp")
+    stale = (os.path.exists(_SO) and os.path.exists(src)
+             and os.path.getmtime(src) > os.path.getmtime(_SO))
+    if not os.path.exists(_SO) or stale:
         try:
-            subprocess.run(["make", "-C", _HERE], check=True,
+            subprocess.run(["make", "-C", _HERE, "-B"], check=True,
                            capture_output=True, timeout=120)
         except Exception:
-            return None
+            if not os.path.exists(_SO):
+                return None
     try:
         lib = ctypes.CDLL(_SO)
         lib.build_sample_idx.restype = ctypes.c_int64
@@ -52,8 +56,36 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_int32,
         ]
+        lib.build_mapping.restype = ctypes.c_int64
+        lib.build_mapping.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),   # docs
+            ctypes.c_int64,                   # num_docs + 1
+            ctypes.POINTER(ctypes.c_int32),   # sizes
+            ctypes.c_int32,                   # num_epochs
+            ctypes.c_int64,                   # max_num_samples
+            ctypes.c_int32,                   # max_seq_length
+            ctypes.c_double,                  # short_seq_prob
+            ctypes.c_int32,                   # seed
+            ctypes.c_int32,                   # min_num_sent
+            ctypes.POINTER(ctypes.c_int64),   # out (NULL => count only)
+        ]
+        lib.build_blocks_mapping.restype = ctypes.c_int64
+        lib.build_blocks_mapping.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),   # title_sizes
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int32,                   # seed
+            ctypes.c_int32,                   # use_one_sent_blocks
+            ctypes.POINTER(ctypes.c_int64),
+        ]
         _LIB = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: a stale .so missing newly added symbols — fall
+        # back to the numpy implementations rather than crash
         _LIB = None
     return _LIB
 
@@ -146,6 +178,165 @@ def build_blending_indices(
         ds_sample[i] = current[d]
         current[d] += 1
     return ds_index, ds_sample
+
+
+_LONG_SENTENCE_LEN = 512  # matches kLongSentenceLen in helpers.cpp
+
+
+def build_mapping(
+    doc_idx: np.ndarray,
+    sizes: np.ndarray,
+    num_epochs: int,
+    max_num_samples: int,
+    max_seq_length: int,
+    short_seq_prob: float,
+    seed: int,
+    min_num_sent: int = 2,
+) -> np.ndarray:
+    """[n, 3] rows of (start-sentence, end-sentence, target-seq-length) for
+    BERT/T5 span sampling (reference: helpers.cpp build_mapping :424)."""
+    doc_idx = np.ascontiguousarray(doc_idx, np.int64)
+    sizes = np.ascontiguousarray(sizes, np.int32)
+    lib = _load()
+    if lib is not None:
+        null = ctypes.POINTER(ctypes.c_int64)()
+        n = lib.build_mapping(
+            _ptr(doc_idx, ctypes.c_int64), len(doc_idx),
+            _ptr(sizes, ctypes.c_int32),
+            num_epochs, max_num_samples, max_seq_length,
+            short_seq_prob, seed, min_num_sent, null,
+        )
+        out = np.empty((n, 3), np.int64)
+        lib.build_mapping(
+            _ptr(doc_idx, ctypes.c_int64), len(doc_idx),
+            _ptr(sizes, ctypes.c_int32),
+            num_epochs, max_num_samples, max_seq_length,
+            short_seq_prob, seed, min_num_sent,
+            _ptr(out, ctypes.c_int64),
+        )
+        return out
+    return _build_mapping_py(doc_idx, sizes, num_epochs, max_num_samples,
+                             max_seq_length, short_seq_prob, seed,
+                             min_num_sent)
+
+
+def _build_mapping_py(doc_idx, sizes, num_epochs, max_num_samples,
+                      max_seq_length, short_seq_prob, seed, min_num_sent):
+    """numpy fallback; same structure as the native loop but with numpy RNG
+    (native/py maps differ in shuffle order, both are valid samplings)."""
+    rng = np.random.RandomState(seed)
+    rows = []
+    num_docs = len(doc_idx) - 1
+    for epoch in range(num_epochs):
+        if len(rows) >= max_num_samples:
+            break
+        if epoch == 1 and not rows:
+            break  # no eligible document; don't spin 2^31 epochs
+        for doc in range(num_docs):
+            first, last = int(doc_idx[doc]), int(doc_idx[doc + 1])
+            remain = last - first
+            if remain < min_num_sent:
+                continue
+            if np.any(sizes[first:last] > _LONG_SENTENCE_LEN):
+                continue
+
+            def draw_target():
+                if short_seq_prob > 0 and rng.rand() < short_seq_prob:
+                    return int(rng.randint(2, max_seq_length + 1))
+                return max_seq_length
+
+            start, seq_len, num_sent = first, 0, 0
+            target = draw_target()
+            for s in range(first, last):
+                seq_len += int(sizes[s])
+                num_sent += 1
+                remain -= 1
+                if ((seq_len >= target and remain > 1
+                     and num_sent >= min_num_sent) or remain == 0):
+                    rows.append((start, s + 1, target))
+                    start = s + 1
+                    target = draw_target()
+                    seq_len, num_sent = 0, 0
+    out = np.asarray(rows[: int(max_num_samples) if max_num_samples else None],
+                     np.int64).reshape(-1, 3)
+    np.random.RandomState(seed + 1).shuffle(out)
+    return out
+
+
+def build_blocks_mapping(
+    doc_idx: np.ndarray,
+    sizes: np.ndarray,
+    title_sizes: np.ndarray,
+    num_epochs: int,
+    max_num_samples: int,
+    max_seq_length: int,
+    seed: int,
+    use_one_sent_blocks: bool = False,
+) -> np.ndarray:
+    """[n, 4] rows of (start-sentence, end-sentence, doc-index, block-id) for
+    ICT/REALM block sampling (reference: helpers.cpp build_blocks_mapping)."""
+    doc_idx = np.ascontiguousarray(doc_idx, np.int64)
+    sizes = np.ascontiguousarray(sizes, np.int32)
+    title_sizes = np.ascontiguousarray(title_sizes, np.int32)
+    lib = _load()
+    if lib is not None:
+        null = ctypes.POINTER(ctypes.c_int64)()
+        n = lib.build_blocks_mapping(
+            _ptr(doc_idx, ctypes.c_int64), len(doc_idx),
+            _ptr(sizes, ctypes.c_int32), _ptr(title_sizes, ctypes.c_int32),
+            num_epochs, max_num_samples, max_seq_length, seed,
+            int(use_one_sent_blocks), null,
+        )
+        out = np.empty((n, 4), np.int64)
+        lib.build_blocks_mapping(
+            _ptr(doc_idx, ctypes.c_int64), len(doc_idx),
+            _ptr(sizes, ctypes.c_int32), _ptr(title_sizes, ctypes.c_int32),
+            num_epochs, max_num_samples, max_seq_length, seed,
+            int(use_one_sent_blocks), _ptr(out, ctypes.c_int64),
+        )
+        return out
+    return _build_blocks_mapping_py(
+        doc_idx, sizes, title_sizes, num_epochs, max_num_samples,
+        max_seq_length, seed, use_one_sent_blocks)
+
+
+def _build_blocks_mapping_py(doc_idx, sizes, title_sizes, num_epochs,
+                             max_num_samples, max_seq_length, seed,
+                             use_one_sent_blocks):
+    min_num_sent = 1 if use_one_sent_blocks else 2
+    rows = []
+    num_docs = len(doc_idx) - 1
+    for epoch in range(num_epochs):
+        if len(rows) >= max_num_samples:
+            break
+        if epoch == 1 and not rows:
+            break
+        block_id = 0
+        for doc in range(num_docs):
+            first, last = int(doc_idx[doc]), int(doc_idx[doc + 1])
+            remain = last - first
+            if remain < min_num_sent:
+                continue
+            budget = max_seq_length - int(title_sizes[doc])
+            if np.any(sizes[first:last] > budget):
+                continue
+            start, seq_len, num_sent = first, 0, 0
+            for s in range(first, last):
+                seq_len += int(sizes[s])
+                num_sent += 1
+                remain -= 1
+                nxt = int(sizes[s + 1]) if remain > 0 else 0
+                if ((seq_len + nxt > budget and num_sent >= min_num_sent
+                     and remain >= min_num_sent)
+                        or remain == 0):
+                    rows.append((start, s + 1, doc, block_id))
+                    block_id += 1
+                    start = s + 1
+                    seq_len, num_sent = 0, 0
+    out = np.asarray(rows[: int(max_num_samples) if max_num_samples else None],
+                     np.int64).reshape(-1, 4)
+    np.random.RandomState(seed + 1).shuffle(out)
+    return out
 
 
 def using_native() -> bool:

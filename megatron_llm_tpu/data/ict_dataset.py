@@ -1,0 +1,170 @@
+"""Inverse-cloze-task (ICT) dataset for retrieval pretraining.
+
+Capability parity with the reference's ``megatron/data/ict_dataset.py``
+(ICTDataset :51-157) and ``realm_dataset_utils.get_block_samples_mapping``:
+a pseudo-query sentence is pulled from a block of consecutive sentences and
+the model learns to match query <-> block.  Block spans come from the native
+``helpers.build_blocks_mapping``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import random
+import time
+from typing import Optional
+
+import numpy as np
+
+from megatron_llm_tpu.data import helpers
+
+
+def get_block_samples_mapping(block_dataset, title_dataset, data_prefix,
+                              num_epochs, max_num_samples, max_seq_length,
+                              seed, name, use_one_sent_docs=False):
+    """Cached [n, 4] map of (start-sentence, end-sentence, doc, block-id)
+    (reference: realm_dataset_utils.py:113-185)."""
+    if not num_epochs:
+        if not max_num_samples:
+            raise ValueError("need max_num_samples or num_epochs")
+        num_epochs = np.iinfo(np.int32).max - 1
+    if not max_num_samples:
+        max_num_samples = np.iinfo(np.int64).max - 1
+
+    # block_dataset may be a _DocSlice view of a split: its documents start
+    # at global index doc_lo, and title_dataset is indexed globally
+    doc_lo = getattr(block_dataset, "doc_lo", 0)
+    num_docs = len(block_dataset.doc_idx) - 1
+
+    fname = (f"{data_prefix}_{name}_blocksmap"
+             f"_{num_epochs}ep_{max_num_samples}mns_{max_seq_length}msl"
+             f"_{seed}s_d{doc_lo}-{doc_lo + num_docs}"
+             f"{'_1sent' if use_one_sent_docs else ''}.npy")
+
+    def build():
+        start = time.time()
+        title_sizes = np.asarray(
+            [len(title_dataset[doc_lo + d]) for d in range(num_docs)],
+            np.int32)
+        mapping = helpers.build_blocks_mapping(
+            block_dataset.doc_idx, block_dataset.sizes, title_sizes,
+            num_epochs, max_num_samples, max_seq_length - 3, seed,
+            use_one_sent_docs)
+        if mapping.shape[0] == 0:
+            raise RuntimeError(
+                f"block samples mapping for {data_prefix!r} ({name}) is "
+                f"empty: no eligible document")
+        # rebase the doc column to global document indices
+        mapping[:, 2] += doc_lo
+        print(f" > built block samples mapping in {time.time() - start:.2f}s",
+              flush=True)
+        return mapping
+
+    from megatron_llm_tpu.data.dataset_utils import _cached_mapping
+    return _cached_mapping(fname, build)
+
+
+def make_attention_mask(source_block, target_block):
+    """2-D [src, tgt] mask of valid (non-pad) positions."""
+    return ((target_block[None, :] >= 1)
+            * (source_block[:, None] >= 1)).astype(np.int64)
+
+
+class ICTDataset:
+    """Pseudo-query + evidence-block pairs (reference: ict_dataset.py:51)."""
+
+    def __init__(self, name, block_dataset, title_dataset, data_prefix,
+                 num_epochs, max_num_samples, max_seq_length,
+                 query_in_block_prob, seed, use_titles=True,
+                 use_one_sent_docs=False, binary_head=False, tokenizer=None):
+        self.name = name
+        self.seed = seed
+        self.max_seq_length = max_seq_length
+        self.query_in_block_prob = query_in_block_prob
+        self.block_dataset = block_dataset
+        self.title_dataset = title_dataset
+        self.use_titles = use_titles
+        self.use_one_sent_docs = use_one_sent_docs
+
+        self.samples_mapping = get_block_samples_mapping(
+            block_dataset, title_dataset, data_prefix, num_epochs,
+            max_num_samples, max_seq_length, seed, name, use_one_sent_docs)
+
+        if tokenizer is None:
+            from megatron_llm_tpu.global_vars import get_tokenizer
+            tokenizer = get_tokenizer()
+        self.cls_id = tokenizer.cls
+        self.sep_id = tokenizer.sep
+        self.mask_id = tokenizer.mask
+        self.pad_id = tokenizer.pad
+
+    def __len__(self):
+        return len(self.samples_mapping)
+
+    def __getitem__(self, idx):
+        start, end, doc, block_id = (int(v) for v in self.samples_mapping[idx])
+        # per-index RNG: sample content is independent of access order
+        # (resume-deterministic, prefetch-thread safe)
+        rng = random.Random(self.seed + idx)
+
+        if self.use_titles:
+            title = self.title_dataset[doc]
+            title_pad_offset = 3 + len(title)
+        else:
+            title = None
+            title_pad_offset = 2
+        block = [self.block_dataset[i] for i in range(start, end)]
+        assert (len(block) > 1 or self.use_one_sent_docs
+                or self.query_in_block_prob == 1)
+
+        sent = rng.randint(0, len(block) - 1)
+        if rng.random() < self.query_in_block_prob:
+            query = np.array(block[sent]).copy()
+        else:
+            query = block.pop(sent)
+
+        query = query[: self.max_seq_length - 2]
+        block = list(itertools.chain(*block))[
+            : self.max_seq_length - title_pad_offset]
+
+        query_tokens, query_pad_mask = self.concat_and_pad_tokens(query)
+        context_tokens, context_pad_mask = self.concat_and_pad_tokens(
+            block, title)
+
+        return {
+            "query_tokens": query_tokens,
+            "query_mask": make_attention_mask(query_tokens, query_tokens),
+            "query_pad_mask": query_pad_mask,
+            "context_tokens": context_tokens,
+            "context_mask": make_attention_mask(context_tokens,
+                                                context_tokens),
+            "context_pad_mask": context_pad_mask,
+            "block_data": np.array([start, end, doc, block_id], np.int64),
+        }
+
+    def get_block(self, start, end, doc):
+        """Evidence block + title tokens, for indexing (reference:
+        ict_dataset.py:129-137)."""
+        block = [self.block_dataset[i] for i in range(start, end)]
+        title = self.title_dataset[int(doc)]
+        block = list(itertools.chain(*block))[
+            : self.max_seq_length - (3 + len(title))]
+        return self.concat_and_pad_tokens(block, title)
+
+    def get_null_block(self):
+        return self.concat_and_pad_tokens([], [])
+
+    def concat_and_pad_tokens(self, tokens, title=None):
+        tokens = list(tokens)
+        if title is None:
+            tokens = [self.cls_id] + tokens + [self.sep_id]
+        else:
+            tokens = ([self.cls_id] + list(title) + [self.sep_id]
+                      + tokens + [self.sep_id])
+        assert len(tokens) <= self.max_seq_length, (len(tokens),
+                                                    self.max_seq_length)
+        num_pad = self.max_seq_length - len(tokens)
+        pad_mask = np.array([1] * len(tokens) + [0] * num_pad, np.int64)
+        tokens = np.array(tokens + [self.pad_id] * num_pad, np.int64)
+        return tokens, pad_mask

@@ -28,7 +28,8 @@ def build_tokenizer(args):
         tokenizer = _GPT2BPETokenizer(args.vocab_file, args.merge_file)
     elif t in ("BertWordPieceLowerCase", "BertWordPieceCase"):
         tokenizer = _BertWordPieceTokenizer(
-            args.vocab_file, lower_case=(t == "BertWordPieceLowerCase")
+            args.vocab_file, lower_case=(t == "BertWordPieceLowerCase"),
+            vocab_extra_ids=getattr(args, "vocab_extra_ids", 0),
         )
     elif t == "SentencePieceTokenizer":
         tokenizer = _SentencePieceTokenizer(
@@ -93,6 +94,31 @@ class AbstractTokenizer(ABC):
     def mask(self) -> int:
         raise NotImplementedError
 
+    @property
+    def vocab(self):
+        raise NotImplementedError
+
+    @property
+    def inv_vocab(self):
+        """id -> token dict, cached (used by whole-word masking)."""
+        cached = getattr(self, "_inv_vocab_cache", None)
+        if cached is None:
+            cached = {i: t for t, i in self.vocab.items()}
+            self._inv_vocab_cache = cached
+        return cached
+
+    @property
+    def bos_token_id(self) -> int:
+        return self.cls
+
+    @property
+    def eos_token_id(self) -> int:
+        return self.eod
+
+    @property
+    def additional_special_tokens_ids(self) -> List[int]:
+        return []
+
 
 class _GPT2BPETokenizer(AbstractTokenizer):
     """GPT-2 byte-level BPE from local vocab.json + merges.txt."""
@@ -128,11 +154,24 @@ class _GPT2BPETokenizer(AbstractTokenizer):
 
 
 class _BertWordPieceTokenizer(AbstractTokenizer):
-    def __init__(self, vocab_file: str, lower_case: bool = True):
+    def __init__(self, vocab_file: str, lower_case: bool = True,
+                 vocab_extra_ids: int = 0):
         from transformers import BertTokenizerFast
 
         self._tok = BertTokenizerFast(vocab_file=vocab_file,
                                       do_lower_case=lower_case)
+        if vocab_extra_ids > 0:
+            # T5-style span sentinels (reference: tokenizer.py:123+ adds
+            # <extra_id_N> when --vocab_extra_ids is set)
+            self._tok.add_special_tokens({
+                "additional_special_tokens": [
+                    f"<extra_id_{i}>" for i in range(vocab_extra_ids)
+                ]
+            })
+
+    @property
+    def additional_special_tokens_ids(self):
+        return self._tok.additional_special_tokens_ids
 
     @property
     def vocab_size(self):

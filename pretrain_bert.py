@@ -75,14 +75,10 @@ def build_data_iterator(args, mesh, num_micro):
                 }
         host_iter = synth()
     else:
-        try:
-            from megatron_llm_tpu.data.bert_dataset import (
-                build_train_valid_test_datasets,
-            )
-        except ImportError:
-            raise SystemExit(
-                "--data_path needs megatron_llm_tpu.data.bert_dataset"
-            )
+        from megatron_llm_tpu.data.bert_dataset import (
+            bert_collate,
+            build_train_valid_test_datasets,
+        )
         from megatron_llm_tpu.data.data_samplers import (
             build_pretraining_data_loader,
         )
@@ -99,6 +95,7 @@ def build_data_iterator(args, mesh, num_micro):
         host_iter = iter(build_pretraining_data_loader(
             train_ds, 0, args.micro_batch_size, args.data_parallel_size,
             num_micro, args.dataloader_type, args.seed,
+            collate_fn=bert_collate,
         ))
 
     def gen():

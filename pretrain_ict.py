@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""BiEncoder ICT (inverse cloze task) pretraining entry point.
+
+Reference: ``/root/reference/pretrain_ict.py`` — twin-tower BERT, in-batch
+softmax over query x context inner products, top-k retrieval accuracies.
+The reference all-gathers tower outputs over the DP group with a custom
+autograd function (:47-73); here the batch is dp-sharded under one jit and
+XLA inserts the gather for the [B, B] score matmul.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu import checkpointing, topology
+from megatron_llm_tpu.arguments import (
+    parallel_config_from_args,
+    train_config_from_args,
+    transformer_config_from_args,
+)
+from megatron_llm_tpu.initialize import initialize_megatron
+from megatron_llm_tpu.models.bert import BERT_ARCH_FLAGS, bert_config
+from megatron_llm_tpu.models.biencoder import (
+    BiEncoderModel,
+    ict_retrieval_loss,
+)
+from megatron_llm_tpu.parallel import sharding as sh
+from megatron_llm_tpu.training import pretrain
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def extra_args(parser):
+    g = parser.add_argument_group("ict")
+    g.add_argument("--titles_data_path", default=None,
+                   help="indexed dataset of one title per document")
+    g.add_argument("--query_in_block_prob", type=float, default=0.1)
+    g.add_argument("--use_one_sent_docs", action="store_true")
+    g.add_argument("--biencoder_projection_dim", type=int, default=0)
+    g.add_argument("--biencoder_shared_query_context_model",
+                   action="store_true")
+    g.add_argument("--retriever_score_scaling", action="store_true")
+    g.add_argument("--retriever_report_topk_accuracies", nargs="*",
+                   type=int, default=[1, 5])
+    return parser
+
+
+class ICTTrainModel:
+    """Adapter matching the generic train-step contract (training.py:48):
+    batch key 'tokens' carries the query tokens; the other tower inputs ride
+    in the extra batch keys."""
+
+    def __init__(self, bi: BiEncoderModel, score_scaling: bool, topk):
+        self.bi = bi
+        self.score_scaling = score_scaling
+        self.topk = tuple(topk)
+
+    def init(self, key):
+        return self.bi.init(key)
+
+    def param_specs(self, params):
+        return self.bi.param_specs(params)
+
+    def num_params(self, params):
+        return self.bi.num_params(params)
+
+    def flops_per_token(self, seq_len=None):
+        from megatron_llm_tpu.models.language_model import flops_per_token
+        return 2 * flops_per_token(self.bi.cfg, seq_len)
+
+    def __call__(self, params, tokens, labels=None, *, query_pad_mask,
+                 context_tokens, context_pad_mask, rng_key=None,
+                 train=False, sequence_parallel=False, **_unused):
+        q, c = self.bi(params, tokens, query_pad_mask,
+                       context_tokens, context_pad_mask,
+                       rng_key=rng_key, train=train)
+        return ict_retrieval_loss(
+            q, c, score_scaling=self.score_scaling,
+            hidden_size=self.bi.cfg.hidden_size, topk=self.topk)
+
+
+def ict_loss_func(model_out, _loss_mask):
+    loss, stats = model_out
+    return loss, stats
+
+
+def ict_collate(micros):
+    keys = ("query_tokens", "query_pad_mask", "context_tokens",
+            "context_pad_mask")
+    out = {}
+    for key in keys:
+        arr = np.stack([np.stack([s[key] for s in m]) for m in micros])
+        name = "tokens" if key == "query_tokens" else key
+        out[name] = arr.astype(np.int32)
+    b = out["tokens"].shape[:2]
+    # dummies for the generic step contract
+    out["labels"] = np.zeros(b + (1,), np.int32)
+    out["loss_mask"] = np.ones(b + (1,), np.float32)
+    return out
+
+
+def build_data_iterator(args, mesh, num_micro):
+    mb = args.micro_batch_size * args.data_parallel_size
+
+    if args.data_path is None:
+        rng = np.random.RandomState(args.seed)
+
+        def synth():
+            while True:
+                yield {
+                    "tokens": rng.randint(
+                        0, args.padded_vocab_size,
+                        (num_micro, mb, args.seq_length)).astype(np.int32),
+                    "query_pad_mask": np.ones(
+                        (num_micro, mb, args.seq_length), np.int32),
+                    "context_tokens": rng.randint(
+                        0, args.padded_vocab_size,
+                        (num_micro, mb, args.seq_length)).astype(np.int32),
+                    "context_pad_mask": np.ones(
+                        (num_micro, mb, args.seq_length), np.int32),
+                    "labels": np.zeros((num_micro, mb, 1), np.int32),
+                    "loss_mask": np.ones((num_micro, mb, 1), np.float32),
+                }
+        host_iter = synth()
+    else:
+        if args.titles_data_path is None:
+            raise SystemExit("ICT needs --titles_data_path")
+        from megatron_llm_tpu.data.data_samplers import (
+            build_pretraining_data_loader,
+        )
+        from megatron_llm_tpu.data.dataset_utils import (
+            DSET_TYPE_ICT,
+            build_train_valid_test_datasets_core,
+            get_indexed_dataset_,
+        )
+        from megatron_llm_tpu.global_vars import get_tokenizer
+
+        titles = get_indexed_dataset_(args.titles_data_path)
+        n_train = args.train_iters * args.global_batch_size
+        train_ds, _, _ = build_train_valid_test_datasets_core(
+            args.data_path, args.split, [n_train, 0, 0],
+            max_seq_length=args.seq_length,
+            masked_lm_prob=0.0, short_seq_prob=0.0, seed=args.seed,
+            dataset_type=DSET_TYPE_ICT, tokenizer=get_tokenizer(),
+            title_dataset=titles,
+            query_in_block_prob=args.query_in_block_prob,
+            use_one_sent_docs=args.use_one_sent_docs,
+        )
+        host_iter = iter(build_pretraining_data_loader(
+            train_ds, 0, args.micro_batch_size, args.data_parallel_size,
+            num_micro, args.dataloader_type, args.seed,
+            collate_fn=ict_collate,
+        ))
+
+    def gen():
+        for b in host_iter:
+            out = {}
+            for k, v in b.items():
+                arr = jnp.asarray(v)
+                spec = [None, "dp"] + [None] * (arr.ndim - 2)
+                out[k] = jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+            yield out
+
+    return gen()
+
+
+def main():
+    args = initialize_megatron(extra_args_provider=extra_args)
+    if args.padded_vocab_size is None:
+        raise SystemExit("need --vocab_size/--padded_vocab_size or a tokenizer")
+    if (args.tensor_model_parallel_size > 1
+            or args.pipeline_model_parallel_size > 1):
+        # the reference asserts the same (pretrain_ict.py loss_func)
+        raise SystemExit("ICT supports dp only (tp=pp=1)")
+
+    mesh = topology.get_mesh()
+    base = transformer_config_from_args(args, "gpt")
+    cfg = bert_config(**{
+        f.name: getattr(base, f.name)
+        for f in base.__dataclass_fields__.values()
+        if f.name not in BERT_ARCH_FLAGS
+    })
+    bi = BiEncoderModel(
+        cfg,
+        projection_dim=args.biencoder_projection_dim,
+        shared_query_context=args.biencoder_shared_query_context_model,
+    )
+    model = ICTTrainModel(bi, args.retriever_score_scaling,
+                          args.retriever_report_topk_accuracies)
+    tc = train_config_from_args(args)
+    pc = parallel_config_from_args(args)
+    num_micro = args.global_batch_size // (
+        args.micro_batch_size * args.data_parallel_size
+    )
+
+    params = None
+    start_iteration = 0
+    opt_state = None
+    if args.load:
+        params, opt_state, meta = checkpointing.load_checkpoint(
+            args.load, finetune=args.finetune
+        )
+        if params is not None:
+            start_iteration = meta["iteration"]
+    if params is None:
+        params = model.init(jax.random.PRNGKey(args.seed))
+    params = sh.shard_params(params, model.param_specs(params))
+    if args.fp16 or args.bf16:
+        dt = jnp.float16 if args.fp16 else jnp.bfloat16
+        params = jax.tree_util.tree_map(lambda p: p.astype(dt), params)
+
+    train_iter = build_data_iterator(args, mesh, num_micro)
+    params, opt_state, it = pretrain(
+        model, params, tc, pc, train_iter,
+        loss_func=ict_loss_func,
+        log_interval=args.log_interval,
+        save_interval=args.save_interval,
+        save_dir=args.save,
+        start_iteration=start_iteration,
+        opt_state=opt_state,
+    )
+    if args.save:
+        checkpointing.save_checkpoint(args.save, it, params, opt_state)
+
+
+if __name__ == "__main__":
+    main()

@@ -63,6 +63,7 @@ def build_data_iterator(args, mesh, num_micro):
     else:
         from megatron_llm_tpu.data.t5_dataset import (
             build_train_valid_test_datasets,
+            t5_collate,
         )
         from megatron_llm_tpu.data.data_samplers import (
             build_pretraining_data_loader,
@@ -81,6 +82,7 @@ def build_data_iterator(args, mesh, num_micro):
         host_iter = iter(build_pretraining_data_loader(
             train_ds, 0, args.micro_batch_size, args.data_parallel_size,
             num_micro, args.dataloader_type, args.seed,
+            collate_fn=t5_collate,
         ))
 
     def gen():

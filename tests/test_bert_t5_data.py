@@ -1,0 +1,318 @@
+"""Tests for the masked-LM data family: build_mapping / build_blocks_mapping
+native helpers, BertDataset, T5Dataset, ICTDataset.
+
+Mirrors the reference's coverage gap (it has none for these!) per SURVEY.md
+§4's "do better" note: everything runs on CPU with synthetic corpora.
+"""
+
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.data import helpers
+from megatron_llm_tpu.data.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    data_file_path,
+    index_file_path,
+)
+
+
+class ToyTok:
+    """Minimal tokenizer: ids 0..9 special, 10..vocab_size-1 words."""
+
+    def __init__(self, vocab_size=100, n_sentinels=20):
+        self.vocab_size_ = vocab_size
+        self.cls = 1
+        self.sep = 2
+        self.pad = 0
+        self.mask = 3
+        self._sentinels = list(range(vocab_size, vocab_size + n_sentinels))
+
+    @property
+    def vocab_size(self):
+        return self.vocab_size_
+
+    @property
+    def inv_vocab(self):
+        d = {i: f"w{i}" for i in range(self.vocab_size_)}
+        for s in self._sentinels:
+            d[s] = f"<extra_id_{s}>"
+        return d
+
+    @property
+    def bos_token_id(self):
+        return self.cls
+
+    @property
+    def eos_token_id(self):
+        return self.sep
+
+    @property
+    def additional_special_tokens_ids(self):
+        return self._sentinels
+
+
+def _write_corpus(tmp_path, n_docs=20, sent_per_doc=6, sent_len=12, seed=0):
+    """Sentence-level mmap dataset: each "document" is sent_per_doc sentences."""
+    rng = np.random.RandomState(seed)
+    prefix = str(tmp_path / "corpus")
+    builder = MMapIndexedDatasetBuilder(data_file_path(prefix), np.int32)
+    for _ in range(n_docs):
+        for _ in range(sent_per_doc):
+            n = int(rng.randint(max(2, sent_len - 4), sent_len + 5))
+            builder.add_item(rng.randint(10, 90, n).astype(np.int32))
+        builder.end_document()
+    builder.finalize(index_file_path(prefix))
+    return prefix, MMapIndexedDataset(prefix)
+
+
+def _write_titles(tmp_path, n_docs=20, seed=1):
+    rng = np.random.RandomState(seed)
+    prefix = str(tmp_path / "titles")
+    builder = MMapIndexedDatasetBuilder(data_file_path(prefix), np.int32)
+    for _ in range(n_docs):
+        builder.add_item(rng.randint(10, 90, 3).astype(np.int32))
+        builder.end_document()
+    builder.finalize(index_file_path(prefix))
+    return prefix, MMapIndexedDataset(prefix)
+
+
+def test_build_mapping_native_matches_python(tmp_path):
+    _, ds = _write_corpus(tmp_path)
+    kw = dict(num_epochs=3, max_num_samples=10**6, max_seq_length=64,
+              short_seq_prob=0.0, seed=3, min_num_sent=2)
+    native = helpers.build_mapping(ds.doc_idx, ds.sizes, **kw)
+    py = helpers._build_mapping_py(ds.doc_idx, ds.sizes, *kw.values())
+    # with short_seq_prob=0 the RNG never affects content -> same rows
+    # (shuffle order differs between mt19937 and numpy RandomState)
+    assert native.shape == py.shape
+    assert np.array_equal(np.sort(native, axis=0), np.sort(py, axis=0))
+    # spans are within bounds, end > start, targets == max_seq_length
+    assert (native[:, 1] > native[:, 0]).all()
+    assert (native[:, 2] == 64).all()
+    assert native[:, 1].max() <= len(ds.sizes)
+
+
+def test_build_mapping_short_seqs():
+    docs = np.array([0, 4, 8], np.int64)
+    sizes = np.full(8, 10, np.int32)
+    m = helpers.build_mapping(docs, sizes, num_epochs=10,
+                              max_num_samples=10**6, max_seq_length=25,
+                              short_seq_prob=0.5, seed=7, min_num_sent=2)
+    assert len(m) > 0
+    assert (m[:, 2] >= 2).all() and (m[:, 2] <= 25).all()
+    # with p=0.5 some draws must be short
+    assert (m[:, 2] < 25).any()
+
+
+def test_build_blocks_mapping(tmp_path):
+    _, ds = _write_corpus(tmp_path)
+    title_sizes = np.full(len(ds.doc_idx) - 1, 3, np.int32)
+    m = helpers.build_blocks_mapping(ds.doc_idx, ds.sizes, title_sizes,
+                                     num_epochs=1, max_num_samples=10**6,
+                                     max_seq_length=61, seed=5)
+    assert m.shape[1] == 4
+    assert (m[:, 1] > m[:, 0]).all()
+    ndocs = len(ds.doc_idx) - 1
+    assert (m[:, 2] < ndocs).all()
+    # every block's sentences stay within its document
+    for start, end, doc, _bid in m[:50]:
+        assert ds.doc_idx[doc] <= start and end <= ds.doc_idx[doc + 1]
+
+
+def test_bert_dataset(tmp_path):
+    from megatron_llm_tpu.data.bert_dataset import BertDataset, bert_collate
+
+    prefix, ds = _write_corpus(tmp_path)
+    tok = ToyTok()
+    bert = BertDataset(name="train", indexed_dataset=ds, data_prefix=prefix,
+                       num_epochs=2, max_num_samples=None,
+                       masked_lm_prob=0.15, max_seq_length=128,
+                       short_seq_prob=0.1, seed=11, binary_head=True,
+                       tokenizer=tok)
+    assert len(bert) > 0
+    s = bert[0]
+    assert s["tokens"].shape == (128,)
+    assert s["tokens"][0] == tok.cls
+    # determinism
+    s2 = bert[0]
+    for k in s:
+        assert np.array_equal(s[k], s2[k]), k
+    # masked positions carry the original token in labels
+    n_masked = int(s["loss_mask"].sum())
+    assert n_masked >= 1
+    assert (s["labels"][s["loss_mask"] == 1] >= 0).all()
+    assert (s["labels"][s["loss_mask"] == 0] == -1).all()
+    # mask token appears where loss_mask is set (80% of positions)
+    masked_toks = s["tokens"][s["loss_mask"] == 1]
+    assert (masked_toks == tok.mask).sum() >= max(1, int(0.4 * n_masked))
+    # padding mask consistent with pad tokens
+    assert (s["tokens"][s["attention_mask"] == 0] == tok.pad).all()
+    # collate
+    batch = bert_collate([[bert[0], bert[1]], [bert[2], bert[3]]])
+    assert batch["tokens"].shape == (2, 2, 128)
+    assert batch["labels"].min() >= 0
+    assert batch["sentence_order"].shape == (2, 2)
+
+
+def test_bert_dataset_entrypoint(tmp_path):
+    from megatron_llm_tpu.data.bert_dataset import (
+        build_train_valid_test_datasets,
+    )
+
+    prefix, _ = _write_corpus(tmp_path, n_docs=30)
+    tr, va, te = build_train_valid_test_datasets(
+        [prefix], "8,1,1", [200, 20, 20], max_seq_length=96,
+        masked_lm_prob=0.15, short_seq_prob=0.1, seed=3, binary_head=True,
+        tokenizer=ToyTok())
+    assert tr is not None and len(tr) > 0
+    assert va is not None and te is not None
+    _ = tr[0]
+
+
+def test_t5_dataset(tmp_path):
+    from megatron_llm_tpu.data.t5_dataset import T5Dataset, t5_collate
+
+    prefix, ds = _write_corpus(tmp_path)
+    tok = ToyTok()
+    t5 = T5Dataset(name="train", indexed_dataset=ds, data_prefix=prefix,
+                   num_epochs=2, max_num_samples=None, masked_lm_prob=0.15,
+                   max_seq_length=128, max_seq_length_dec=64,
+                   short_seq_prob=0.1, seed=19, tokenizer=tok)
+    assert len(t5) > 0
+    s = t5[1]
+    assert s["text_enc"].shape == (128,)
+    assert s["text_dec"].shape == (64,)
+    assert s["labels"].shape == (64,)
+    # decoder teacher forcing: labels are decoder input shifted left
+    n_dec = int(s["loss_mask"].sum())
+    assert n_dec >= 2
+    assert s["text_dec"][0] == tok.bos_token_id
+    assert np.array_equal(s["text_dec"][1:n_dec], s["labels"][: n_dec - 1])
+    assert s["labels"][n_dec - 1] == tok.eos_token_id
+    # sentinels appear in encoder input and decoder stream in order
+    sent_set = set(tok.additional_special_tokens_ids)
+    enc_sent = [t for t in s["text_enc"] if int(t) in sent_set]
+    dec_sent = [t for t in s["text_dec"] if int(t) in sent_set]
+    assert enc_sent == dec_sent
+    assert len(enc_sent) >= 1
+    # lengths consistent with padding
+    assert int(s["enc_len"]) == int((s["text_enc"] != tok.pad).sum())
+    assert int(s["dec_len"]) == n_dec
+    # determinism
+    s2 = t5[1]
+    assert np.array_equal(s["text_enc"], s2["text_enc"])
+    batch = t5_collate([[t5[0], t5[1]]])
+    assert batch["tokens"].shape == (1, 2, 128)
+    assert batch["decoder_input_ids"].shape == (1, 2, 64)
+    assert batch["encoder_decoder_attn_mask"].shape == (1, 2, 64, 128)
+    dm = batch["decoder_attn_mask"][0, 1]
+    assert np.array_equal(dm, np.tril(dm))  # causal
+    assert dm.dtype == np.int8
+    # masks match the per-sample lengths
+    nd = int(t5[1]["dec_len"])
+    assert dm[nd - 1, nd - 1] == 1 and (dm[nd:, :] == 0).all()
+
+
+def test_ict_dataset(tmp_path):
+    from megatron_llm_tpu.data.ict_dataset import ICTDataset
+
+    prefix, blocks = _write_corpus(tmp_path)
+    _, titles = _write_titles(tmp_path)
+    tok = ToyTok()
+    ict = ICTDataset(name="train", block_dataset=blocks,
+                     title_dataset=titles, data_prefix=prefix,
+                     num_epochs=1, max_num_samples=None, max_seq_length=128,
+                     query_in_block_prob=0.5, seed=13, tokenizer=tok)
+    assert len(ict) > 0
+    s = ict[0]
+    assert s["query_tokens"].shape == (128,)
+    assert s["context_tokens"].shape == (128,)
+    assert s["query_tokens"][0] == tok.cls
+    assert s["context_tokens"][0] == tok.cls
+    assert s["query_mask"].shape == (128, 128)
+    assert s["block_data"].shape == (4,)
+    # query is real content (some non-special tokens)
+    n_q = int(s["query_pad_mask"].sum())
+    assert n_q >= 3
+    # evidence block accessor
+    start, end, doc, _ = (int(v) for v in s["block_data"])
+    btok, bmask = ict.get_block(start, end, doc)
+    assert btok.shape == (128,)
+    nulltok, nullmask = ict.get_null_block()
+    assert int(nullmask.sum()) == 3  # [CLS] [SEP] [SEP]
+
+
+def test_bert_blended_prefixes(tmp_path):
+    """Two weighted corpora through the blend path (reference:
+    dataset_utils.py:444-479)."""
+    from megatron_llm_tpu.data.bert_dataset import (
+        build_train_valid_test_datasets,
+    )
+
+    p1, _ = _write_corpus(tmp_path, n_docs=20, seed=0)
+    (tmp_path / "b").mkdir()
+    p2, _ = _write_corpus(tmp_path / "b", n_docs=20, seed=4)
+    tr, va, _ = build_train_valid_test_datasets(
+        ["0.7", p1, "0.3", p2], "8,2,0", [100, 10, 0], max_seq_length=96,
+        masked_lm_prob=0.15, short_seq_prob=0.1, seed=3, binary_head=True,
+        tokenizer=ToyTok())
+    assert tr is not None and len(tr) == 100
+    assert va is not None and len(va) == 10
+    s = tr[0]
+    assert s["tokens"].shape == (96,)
+    # roughly 70/30 split across the blend
+    counts = np.bincount(tr.dataset_index, minlength=2)
+    assert counts[0] > counts[1] > 0
+
+
+def test_ict_split_title_alignment(tmp_path):
+    """A valid-split ICT dataset must index titles with GLOBAL doc ids
+    (regression: the blocks map doc column is slice-relative)."""
+    from megatron_llm_tpu.data.dataset_utils import _DocSlice
+    from megatron_llm_tpu.data.ict_dataset import ICTDataset
+
+    prefix, blocks = _write_corpus(tmp_path, n_docs=20)
+    _, titles = _write_titles(tmp_path, n_docs=20)
+    n_docs = len(blocks.doc_idx) - 1
+    lo = n_docs // 2
+    view = _DocSlice(blocks, lo, n_docs)
+    ict = ICTDataset(name="valid", block_dataset=view, title_dataset=titles,
+                     data_prefix=prefix, num_epochs=1, max_num_samples=None,
+                     max_seq_length=128, query_in_block_prob=0.5, seed=13,
+                     tokenizer=ToyTok())
+    s = ict[0]
+    start, end, doc, _ = (int(v) for v in s["block_data"])
+    # doc is global: the block's sentences lie inside that global document
+    assert lo <= doc < n_docs
+    assert blocks.doc_idx[doc] <= start and end <= blocks.doc_idx[doc + 1]
+    # context begins with [CLS] title(3 tokens) [SEP]
+    title = titles[doc]
+    assert np.array_equal(s["context_tokens"][1:4], title)
+    # per-index RNG: same sample regardless of access order
+    _ = ict[1]
+    s2 = ict[0]
+    assert np.array_equal(s["query_tokens"], s2["query_tokens"])
+
+
+def test_empty_mapping_fails_fast(tmp_path):
+    """All-ineligible corpus must raise, not spin 2^31 epochs."""
+    prefix = str(tmp_path / "single")
+    builder = MMapIndexedDatasetBuilder(data_file_path(prefix), np.int32)
+    for _ in range(5):  # single-sentence docs: ineligible with min_num_sent=2
+        builder.add_item(np.arange(10, 20, dtype=np.int32))
+        builder.end_document()
+    builder.finalize(index_file_path(prefix))
+    ds = MMapIndexedDataset(prefix)
+    m = helpers.build_mapping(ds.doc_idx, ds.sizes, num_epochs=2**31 - 2,
+                              max_num_samples=10**6, max_seq_length=64,
+                              short_seq_prob=0.1, seed=3, min_num_sent=2)
+    assert m.shape[0] == 0
+
+    from megatron_llm_tpu.data.dataset_utils import get_samples_mapping
+    with pytest.raises(RuntimeError, match="empty"):
+        get_samples_mapping(ds, prefix, None, 100, 64, 0.1, 3, "train", True)
+
+
+def test_using_native():
+    assert helpers.using_native()
