@@ -23,9 +23,11 @@ constexpr int32_t kLongSentenceLen = 512;
 // probability 1/short_seq_ratio) a short length in [2, max_length].
 inline int32_t target_len(int32_t short_seq_ratio, int32_t max_length,
                           std::mt19937& gen) {
-  const uint32_t r = gen();
-  if (short_seq_ratio != 0 && (r % short_seq_ratio) == 0) {
-    return 2 + static_cast<int32_t>(r % (max_length - 1));
+  // separate draws: reusing one draw for decision AND length restricts
+  // short lengths to multiples of gcd(ratio, max_length - 1)
+  const uint32_t decide = gen();
+  if (short_seq_ratio != 0 && (decide % short_seq_ratio) == 0) {
+    return 2 + static_cast<int32_t>(gen() % (max_length - 1));
   }
   return max_length;
 }
@@ -211,10 +213,10 @@ int64_t build_blocks_mapping(const int64_t* docs, int64_t num_docs_plus_one,
   const int64_t num_docs = num_docs_plus_one - 1;
   const int32_t min_num_sent = use_one_sent_blocks ? 1 : 2;
   int64_t n = 0;
+  int64_t block_id = 0;  // unique across epochs (REALM retrieval key)
   for (int32_t epoch = 0; epoch < num_epochs && n < max_num_samples;
        ++epoch) {
     if (epoch == 1 && n == 0) break;
-    int64_t block_id = 0;
     for (int64_t doc = 0; doc < num_docs; ++doc) {
       const int64_t first = docs[doc];
       const int64_t last = docs[doc + 1];

@@ -306,12 +306,12 @@ def _build_blocks_mapping_py(doc_idx, sizes, title_sizes, num_epochs,
     min_num_sent = 1 if use_one_sent_blocks else 2
     rows = []
     num_docs = len(doc_idx) - 1
+    block_id = 0  # unique across epochs (REALM retrieval key)
     for epoch in range(num_epochs):
         if len(rows) >= max_num_samples:
             break
         if epoch == 1 and not rows:
             break
-        block_id = 0
         for doc in range(num_docs):
             first, last = int(doc_idx[doc]), int(doc_idx[doc + 1])
             remain = last - first

@@ -44,9 +44,12 @@ def get_block_samples_mapping(block_dataset, title_dataset, data_prefix,
 
     def build():
         start = time.time()
-        title_sizes = np.asarray(
-            [len(title_dataset[doc_lo + d]) for d in range(num_docs)],
-            np.int32)
+        # title lengths come straight from the index (sizes of each doc's
+        # first sequence) — no need to decode millions of titles
+        title_doc_idx = np.asarray(
+            title_dataset.doc_idx[doc_lo:doc_lo + num_docs], np.int64)
+        title_sizes = np.asarray(title_dataset.sizes, np.int32)[
+            title_doc_idx]
         mapping = helpers.build_blocks_mapping(
             block_dataset.doc_idx, block_dataset.sizes, title_sizes,
             num_epochs, max_num_samples, max_seq_length - 3, seed,
@@ -132,13 +135,13 @@ class ICTDataset:
         context_tokens, context_pad_mask = self.concat_and_pad_tokens(
             block, title)
 
+        # 2-D attention masks are derivable from the pad masks
+        # (make_attention_mask) — not materialized per sample, the model
+        # builds them in-graph from query_pad_mask/context_pad_mask
         return {
             "query_tokens": query_tokens,
-            "query_mask": make_attention_mask(query_tokens, query_tokens),
             "query_pad_mask": query_pad_mask,
             "context_tokens": context_tokens,
-            "context_mask": make_attention_mask(context_tokens,
-                                                context_tokens),
             "context_pad_mask": context_pad_mask,
             "block_data": np.array([start, end, doc, block_id], np.int64),
         }

@@ -88,6 +88,20 @@ def build_training_sample(sample, target_seq_length, max_seq_length,
             cls_id, sep_id, mask_id, max_predictions, np_rng,
             max_ngrams=10, geometric_dist=True, masking_style="t5")
 
+    # a long sample can draw more spans than there are sentinel ids; unmask
+    # the excess spans (restore their original tokens) instead of crashing
+    if len(masked_spans) > len(sentinel_tokens):
+        for span in masked_spans[len(sentinel_tokens):]:
+            for pos, orig in zip(span.index, span.label):
+                tokens[pos] = orig
+        dropped = {pos for span in masked_spans[len(sentinel_tokens):]
+                   for pos in span.index}
+        kept = [(p, l) for p, l in zip(masked_positions, masked_labels)
+                if p not in dropped]
+        masked_positions = [p for p, _ in kept]
+        masked_labels = [l for _, l in kept]
+        masked_spans = masked_spans[: len(sentinel_tokens)]
+
     # sentinel substitution: encoder keeps unmasked runs + one sentinel per
     # span; decoder in/out stream the sentinels + original span tokens
     sentinels = collections.deque(sentinel_tokens)

@@ -42,7 +42,7 @@ def build_tokenizer(args):
     elif t == "HFAutoTokenizer":
         tokenizer = _HFAutoTokenizer(args.tokenizer_path)
     elif t == "NullTokenizer":
-        return _NullTokenizer(args.vocab_size)
+        tokenizer = _NullTokenizer(args.vocab_size)
     else:
         raise NotImplementedError(f"tokenizer type {t!r}")
 

@@ -1,0 +1,182 @@
+"""Generic downstream-task finetuning loop.
+
+Reference: ``tasks/finetune_utils.py`` — epoch-based training over an
+in-memory dataset with per-epoch shuffling, periodic checkpointing, and an
+accuracy evaluation at each epoch end.
+
+TPU design: one jitted train step (reusing ``build_train_step`` — the
+classification models satisfy the generic model contract), host-side numpy
+batching.  Pretrained BERT weights are grafted onto the classification
+trunk by matching the ``embedding``/``transformer``/``pooler`` subtrees;
+the task head keeps its fresh init (reference loads the LM checkpoint with
+``--pretrained_checkpoint`` the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu import checkpointing
+from megatron_llm_tpu.optimizer import MegatronOptimizer
+from megatron_llm_tpu.parallel import sharding as sh
+from megatron_llm_tpu.training import build_train_step
+
+
+def classification_collate(samples):
+    """List of task samples -> one micro-batch dict (M=1 microbatch axis is
+    added by the caller)."""
+    return {
+        "tokens": np.stack([s["text"] for s in samples]).astype(np.int32),
+        "tokentype_ids": np.stack([s["types"] for s in samples]
+                                  ).astype(np.int32),
+        "attention_mask": np.stack([s["padding_mask"] for s in samples]
+                                   ).astype(np.int32),
+        "labels": np.asarray([s["label"] for s in samples], np.int32),
+        "loss_mask": np.ones(len(samples), np.float32),
+    }
+
+
+def _epoch_batches(dataset, batch_size, rng, keep_last=False,
+                   collate=classification_collate):
+    order = rng.permutation(len(dataset))
+    stop = len(order) if keep_last else (len(order) // batch_size) * batch_size
+    for lo in range(0, stop, batch_size):
+        idx = order[lo:lo + batch_size]
+        if not keep_last and len(idx) < batch_size:
+            return
+        yield collate([dataset[int(i)] for i in idx])
+
+
+def load_pretrained_trunk(params, pretrained_checkpoint: str):
+    """Graft matching subtrees (embedding/transformer/pooler) from a
+    pretrained LM checkpoint onto freshly initialized task params."""
+    loaded, _, _ = checkpointing.load_checkpoint(pretrained_checkpoint,
+                                                 finetune=True)
+    if loaded is None:
+        raise FileNotFoundError(
+            f"no checkpoint found at {pretrained_checkpoint!r}")
+    grafted = dict(params)
+    for key in ("embedding", "transformer", "pooler"):
+        if key in loaded and key in params:
+            tgt_struct = jax.tree_util.tree_structure(params[key])
+            src_struct = jax.tree_util.tree_structure(loaded[key])
+            if tgt_struct == src_struct:
+                grafted[key] = jax.tree_util.tree_map(
+                    lambda t, s: jnp.asarray(s, t.dtype), params[key],
+                    loaded[key])
+                print(f" > loaded pretrained {key!r}", flush=True)
+            else:
+                print(f" > skipped {key!r}: structure mismatch", flush=True)
+    return grafted
+
+
+def accuracy_func_provider(model, params_getter, dataset, batch_size,
+                           collate=classification_collate):
+    """Returns a callable computing top-1 accuracy over ``dataset``
+    (reference: tasks/eval_utils.py accuracy_func_provider)."""
+
+    @jax.jit
+    def logits_fn(params, tokens, attention_mask, tokentype_ids):
+        return model(params, tokens, attention_mask,
+                     tokentype_ids=tokentype_ids)
+
+    def evaluate():
+        params = params_getter()
+        correct = total = 0
+        for lo in range(0, len(dataset), batch_size):
+            samples = [dataset[i]
+                       for i in range(lo, min(lo + batch_size, len(dataset)))]
+            b = collate(samples)
+            n = len(samples)
+            # pad the tail batch to the compiled shape
+            if n < batch_size:
+                pad = batch_size - n
+                b = {k: np.concatenate(
+                    [v, np.repeat(v[-1:], pad, axis=0)]) for k, v in b.items()}
+            logits = logits_fn(params,
+                               jnp.asarray(b["tokens"]),
+                               jnp.asarray(b["attention_mask"]),
+                               jnp.asarray(b["tokentype_ids"]))
+            pred = np.asarray(jnp.argmax(logits, axis=-1))[:n]
+            correct += int((pred == b["labels"][:n]).sum())
+            total += n
+        return correct / max(total, 1)
+
+    return evaluate
+
+
+def finetune(args, model, train_dataset, valid_dataset,
+             collate=classification_collate,
+             end_of_epoch_callback: Optional[Callable] = None):
+    """Epoch-driven finetune (reference: tasks/finetune_utils.py:finetune).
+
+    Uses the generic compiled train step with one microbatch per step; the
+    global batch is ``args.micro_batch_size x dp``.
+    """
+    from megatron_llm_tpu.arguments import (
+        parallel_config_from_args,
+        train_config_from_args,
+    )
+
+    tc = train_config_from_args(args)
+    pc = parallel_config_from_args(args)
+    optimizer = MegatronOptimizer(tc)
+
+    params = model.init(jax.random.PRNGKey(args.seed))
+    if getattr(args, "pretrained_checkpoint", None):
+        params = load_pretrained_trunk(params, args.pretrained_checkpoint)
+    params = sh.shard_params(params, model.param_specs(params))
+    if args.fp16 or args.bf16:
+        dt = jnp.float16 if args.fp16 else jnp.bfloat16
+        params = jax.tree_util.tree_map(lambda p: p.astype(dt), params)
+    opt_state = optimizer.init(params)
+
+    step_fn = build_train_step(model, optimizer, pc, num_microbatches=1)
+    batch_size = args.micro_batch_size * args.data_parallel_size
+    rng = np.random.RandomState(args.seed)
+    key = jax.random.PRNGKey(args.seed + 1)
+
+    epochs = args.epochs or 0
+    lr = args.lr
+    it = 0
+    best = None
+    state = {"params": params}
+    eval_fn = None
+    if valid_dataset is not None:
+        eval_fn = accuracy_func_provider(
+            model, lambda: state["params"], valid_dataset,
+            batch_size, collate)
+
+    for epoch in range(epochs):
+        for batch in _epoch_batches(train_dataset, batch_size, rng,
+                                    keep_last=getattr(args, "keep_last",
+                                                      False), collate=collate):
+            global_batch = {k: v[None] for k, v in batch.items()}  # M=1
+            key, sub = jax.random.split(key)
+            params, opt_state, metrics = step_fn(
+                params, opt_state, global_batch, sub,
+                jnp.float32(lr), jnp.float32(tc.weight_decay))
+            state["params"] = params
+            it += 1
+            if it % args.log_interval == 0:
+                print(f"epoch {epoch} iter {it} | "
+                      f"loss {float(metrics['lm loss']):.4f}", flush=True)
+        if eval_fn is not None:
+            acc = eval_fn()
+            print(f"epoch {epoch} | validation accuracy {acc * 100:.2f}%",
+                  flush=True)
+            best = acc if best is None else max(best, acc)
+        if end_of_epoch_callback is not None:
+            end_of_epoch_callback(epoch, params)
+        if args.save:
+            checkpointing.save_checkpoint(args.save, it, params, opt_state)
+
+    if epochs == 0 and eval_fn is not None:  # evaluation only
+        acc = eval_fn()
+        print(f"validation accuracy {acc * 100:.2f}%", flush=True)
+        best = acc
+    return params, best

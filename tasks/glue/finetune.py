@@ -1,0 +1,46 @@
+"""GLUE finetune driver (reference: tasks/glue/finetune.py): builds the
+3-class (MNLI) or 2-class (QQP) classification model over the BERT trunk
+and runs the generic epoch loop."""
+
+from __future__ import annotations
+
+import jax
+
+from megatron_llm_tpu.arguments import transformer_config_from_args
+from megatron_llm_tpu.global_vars import get_args, get_tokenizer
+from megatron_llm_tpu.models.bert import BERT_ARCH_FLAGS, bert_config
+from megatron_llm_tpu.models.classification import ClassificationModel
+from tasks.finetune_utils import finetune
+
+
+def _cfg_from_args(args):
+    base = transformer_config_from_args(args, "gpt")
+    return bert_config(**{
+        f.name: getattr(base, f.name)
+        for f in base.__dataclass_fields__.values()
+        if f.name not in BERT_ARCH_FLAGS
+    })
+
+
+def main():
+    args = get_args()
+    tokenizer = get_tokenizer()
+
+    if args.task == "MNLI":
+        from tasks.glue.mnli import MNLIDataset as Dataset
+        num_classes = 3
+    elif args.task == "QQP":
+        from tasks.glue.qqp import QQPDataset as Dataset
+        num_classes = 2
+    else:
+        raise ValueError(f"unknown GLUE task {args.task!r}")
+
+    train_ds = Dataset("training", args.train_data, tokenizer,
+                       args.seq_length)
+    valid_ds = Dataset("validation", args.valid_data, tokenizer,
+                       args.seq_length) if args.valid_data else None
+
+    model = ClassificationModel(_cfg_from_args(args), num_classes)
+    _, best = finetune(args, model, train_ds, valid_ds)
+    if best is not None:
+        print(f"best validation accuracy: {best * 100:.2f}%", flush=True)

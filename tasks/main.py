@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Downstream-task driver (reference: tasks/main.py).
+
+Usage:
+  python tasks/main.py --task MNLI --train_data .../train.tsv
+      --valid_data .../dev.tsv --pretrained_checkpoint ckpt --epochs 3 ...
+  python tasks/main.py --task WIKITEXT103 --valid_data wiki.valid.tokens ...
+  python tasks/main.py --task LAMBADA --valid_data lambada.jsonl ...
+  python tasks/main.py --task RACE --train_data RACE/train/middle ...
+  python tasks/main.py --task ICT-ZEROSHOT-NQ --embedding_path ... --qa_data_dev ...
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.append(os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                             os.pardir)))
+
+from megatron_llm_tpu.initialize import initialize_megatron  # noqa: E402
+
+
+def get_tasks_args(parser):
+    """Extra flags shared by all tasks (reference: tasks/main.py:14-73)."""
+    g = parser.add_argument_group("tasks")
+    g.add_argument("--task", required=True)
+    g.add_argument("--epochs", type=int, default=None,
+                   help="finetuning epochs; 0 = evaluate only")
+    g.add_argument("--pretrained_checkpoint", default=None)
+    g.add_argument("--keep_last", action="store_true")
+    g.add_argument("--train_data", nargs="+", default=None)
+    g.add_argument("--valid_data", nargs="*", default=None)
+    g.add_argument("--overlapping_eval", type=int, default=32)
+    g.add_argument("--strict_lambada", action="store_true")
+    g.add_argument("--qa_data_dev", default=None)
+    g.add_argument("--qa_data_test", default=None)
+    g.add_argument("--embedding_path", default=None)
+    g.add_argument("--faiss_match", default="string",
+                   choices=["regex", "string"])
+    g.add_argument("--faiss_topk_retrievals", type=int, default=100)
+    g.add_argument("--eval_micro_batch_size", type=int, default=None)
+    g.add_argument("--titles_data_path", default=None)
+    g.add_argument("--use_one_sent_docs", action="store_true")
+    g.add_argument("--biencoder_projection_dim", type=int, default=0)
+    g.add_argument("--biencoder_shared_query_context_model",
+                   action="store_true")
+    g.add_argument("--retriever_report_topk_accuracies", nargs="*",
+                   type=int, default=None)
+    return parser
+
+
+def main():
+    args = initialize_megatron(extra_args_provider=get_tasks_args)
+
+    if args.task == "RACE":
+        from tasks.race.finetune import main as task_main
+    elif args.task in ("MNLI", "QQP"):
+        from tasks.glue.finetune import main as task_main
+    elif args.task in ("LAMBADA", "WIKITEXT103"):
+        from tasks.zeroshot_gpt.evaluate import main as task_main
+    elif args.task in ("ICT-ZEROSHOT-NQ", "RETRIEVER-EVAL"):
+        from tasks.orqa.evaluate_orqa import main as task_main
+    else:
+        raise NotImplementedError(f"task {args.task!r} is not implemented")
+
+    task_main()
+
+
+if __name__ == "__main__":
+    main()

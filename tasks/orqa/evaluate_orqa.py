@@ -1,0 +1,179 @@
+"""Open-retrieval QA zero-shot evaluation (ICT-ZEROSHOT-NQ /
+RETRIEVER-EVAL).
+
+Reference: ``tasks/orqa/evaluate_orqa.py`` + ``evaluate_utils.py`` — embed
+the questions with the query tower, retrieve top-k evidence blocks from the
+precomputed index, and report answer recall@k (an answer string appearing
+in a retrieved block counts).
+
+Input file: jsonl or TSV with fields question / answers (list).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu import checkpointing
+from megatron_llm_tpu.arguments import transformer_config_from_args
+from megatron_llm_tpu.data.realm_index import (
+    BruteForceMIPSIndex,
+    OpenRetrievalDataStore,
+)
+from megatron_llm_tpu.global_vars import get_args, get_tokenizer
+from megatron_llm_tpu.models.bert import BERT_ARCH_FLAGS, bert_config
+from megatron_llm_tpu.models.biencoder import BiEncoderModel
+
+
+def load_qa_pairs(path):
+    """[(question, [answers])] from jsonl ({question, answers}) or TSV."""
+    pairs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("{"):
+                rec = json.loads(line)
+                q, answers = rec["question"], rec["answers"]
+            else:
+                q, ans = line.split("\t", 1)
+                try:
+                    answers = eval(ans, {"__builtins__": {}})  # DPR-style
+                except Exception:
+                    answers = [ans]
+            pairs.append((q, list(answers)))
+    return pairs
+
+
+def _regex_match(answer, text):
+    try:
+        return re.search(re.compile(answer, flags=re.IGNORECASE),
+                         text) is not None
+    except re.error:
+        return False
+
+
+def answer_in_block(answers, block_text, match="string"):
+    lowered = block_text.lower()
+    for a in answers:
+        if match == "regex":
+            if _regex_match(a, block_text):
+                return True
+        elif a.lower() in lowered:
+            return True
+    return False
+
+
+def evaluate_retriever(model, params, ict_dataset, index, qa_pairs,
+                       tokenizer, topk_list=(1, 5, 20, 100), match="string",
+                       batch_size=32):
+    """Recall@k over the qa pairs; blocks detokenized for answer match."""
+    max_k = max(topk_list)
+
+    @jax.jit
+    def embed(params, toks, mask):
+        return model.embed_query(params, toks, mask)
+
+    # block id -> row for text reconstruction
+    mapping = np.asarray(ict_dataset.samples_mapping)
+    by_block = {int(r[3]): (int(r[0]), int(r[1]), int(r[2]))
+                for r in mapping}
+
+    hits = {k: 0 for k in topk_list}
+    n = 0
+    for lo in range(0, len(qa_pairs), batch_size):
+        chunk = qa_pairs[lo:lo + batch_size]
+        toks, masks = [], []
+        for q, _ in chunk:
+            ids = tokenizer.tokenize(q)[: ict_dataset.max_seq_length - 2]
+            t, m = ict_dataset.concat_and_pad_tokens(ids)
+            toks.append(t)
+            masks.append(m)
+        emb = np.asarray(embed(params,
+                               jnp.asarray(np.stack(toks), jnp.int32),
+                               jnp.asarray(np.stack(masks), jnp.int32)))
+        _, ids_topk = index.search_mips_index(emb, top_k=max_k)
+        for (q, answers), row_ids in zip(chunk, ids_topk):
+            found_rank = None
+            for rank, bid in enumerate(row_ids):
+                if int(bid) not in by_block:
+                    continue
+                start, end, doc = by_block[int(bid)]
+                block_tokens, _ = ict_dataset.get_block(start, end, doc)
+                text = tokenizer.detokenize(
+                    [int(t) for t in block_tokens
+                     if int(t) != ict_dataset.pad_id])
+                if answer_in_block(answers, text, match):
+                    found_rank = rank
+                    break
+            n += 1
+            for k in topk_list:
+                if found_rank is not None and found_rank < k:
+                    hits[k] += 1
+    return {f"recall@{k}": hits[k] / max(n, 1) for k in topk_list}, n
+
+
+def main():
+    args = get_args()
+    tokenizer = get_tokenizer()
+
+    base = transformer_config_from_args(args, "gpt")
+    cfg = bert_config(**{
+        f.name: getattr(base, f.name)
+        for f in base.__dataclass_fields__.values()
+        if f.name not in BERT_ARCH_FLAGS
+    })
+    model = BiEncoderModel(
+        cfg,
+        projection_dim=getattr(args, "biencoder_projection_dim", 0),
+        shared_query_context=getattr(
+            args, "biencoder_shared_query_context_model", False),
+    )
+    params = None
+    if args.load:
+        params, _, _ = checkpointing.load_checkpoint(args.load,
+                                                     finetune=True)
+    if params is None:
+        print(" > WARNING: evaluating a randomly initialized retriever",
+              flush=True)
+        params = model.init(jax.random.PRNGKey(args.seed))
+
+    # evidence: the ICT dataset over the full corpus + the embedding store
+    from megatron_llm_tpu.data.dataset_utils import get_indexed_dataset_
+    from megatron_llm_tpu.data.ict_dataset import ICTDataset
+
+    blocks = get_indexed_dataset_(args.data_path[0]
+                                  if isinstance(args.data_path, list)
+                                  else args.data_path)
+    titles = get_indexed_dataset_(args.titles_data_path)
+    ict = ICTDataset(
+        name="full", block_dataset=blocks, title_dataset=titles,
+        data_prefix=(args.data_path[0] if isinstance(args.data_path, list)
+                     else args.data_path),
+        num_epochs=1, max_num_samples=None,
+        max_seq_length=args.seq_length, query_in_block_prob=1.0,
+        seed=1, tokenizer=tokenizer,
+        use_one_sent_docs=getattr(args, "use_one_sent_docs", False))
+
+    embed_dim = (getattr(args, "biencoder_projection_dim", 0)
+                 or args.hidden_size)
+    store = OpenRetrievalDataStore(args.embedding_path)
+    index = BruteForceMIPSIndex(embed_dim, store)
+
+    qa_path = args.qa_data_dev or args.qa_data_test
+    if qa_path is None:
+        raise SystemExit("need --qa_data_dev or --qa_data_test")
+    qa_pairs = load_qa_pairs(qa_path)
+    topk = tuple(getattr(args, "retriever_report_topk_accuracies", None)
+                 or (1, 5, 20, 100))
+    results, n = evaluate_retriever(
+        model, params, ict, index, qa_pairs, tokenizer,
+        topk_list=topk, match=getattr(args, "faiss_match", "string"))
+    print(f" > evaluated {n} questions")
+    for k, v in results.items():
+        print(f"   {k}: {v * 100:.2f}%")

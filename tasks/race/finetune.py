@@ -1,0 +1,41 @@
+"""RACE finetune driver (reference: tasks/race/finetune.py): multiple-choice
+model — samples are [C, s] stacks, scored with a shared 1-logit head."""
+
+from __future__ import annotations
+
+from megatron_llm_tpu.global_vars import get_args, get_tokenizer
+from megatron_llm_tpu.models.classification import MultipleChoiceModel
+from tasks.finetune_utils import finetune
+from tasks.glue.finetune import _cfg_from_args
+from tasks.race.data import RaceDataset
+
+import numpy as np
+
+
+def race_collate(samples):
+    """[C, s] per sample -> batch dict with choice axis kept."""
+    return {
+        "tokens": np.stack([s["text"] for s in samples]).astype(np.int32),
+        "tokentype_ids": np.stack([s["types"] for s in samples]
+                                  ).astype(np.int32),
+        "attention_mask": np.stack([s["padding_mask"] for s in samples]
+                                   ).astype(np.int32),
+        "labels": np.asarray([s["label"] for s in samples], np.int32),
+        "loss_mask": np.ones(len(samples), np.float32),
+    }
+
+
+def main():
+    args = get_args()
+    tokenizer = get_tokenizer()
+
+    train_ds = RaceDataset("training", args.train_data, tokenizer,
+                           args.seq_length)
+    valid_ds = RaceDataset("validation", args.valid_data, tokenizer,
+                           args.seq_length) if args.valid_data else None
+
+    model = MultipleChoiceModel(_cfg_from_args(args))
+    _, best = finetune(args, model, train_ds, valid_ds,
+                       collate=race_collate)
+    if best is not None:
+        print(f"best validation accuracy: {best * 100:.2f}%", flush=True)

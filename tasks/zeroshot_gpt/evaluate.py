@@ -1,0 +1,114 @@
+"""GPT zero-shot evaluation: WIKITEXT103 perplexity, LAMBADA accuracy.
+
+Reference: ``tasks/zeroshot_gpt/evaluate.py`` — loss is summed over pad-
+masked tokens and turned into (adjusted) perplexity; accuracy requires
+every label token of the cloze word to be the argmax prediction.
+
+TPU design: one jitted forward per fixed [b, s+1] batch; tail batches are
+padded and their contribution masked host-side.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu import checkpointing
+from megatron_llm_tpu.arguments import transformer_config_from_args
+from megatron_llm_tpu.global_vars import get_args, get_tokenizer
+from megatron_llm_tpu.models.gpt import GPTModel
+from megatron_llm_tpu.parallel import sharding as sh
+from tasks.zeroshot_gpt.datasets import build_dataset
+
+
+def _build_eval_fns(model):
+    @jax.jit
+    def loss_sum(params, tokens, pad_mask):
+        inp, labels = tokens[:, :-1], tokens[:, 1:]
+        loss_tok = model(params, inp, labels=labels)  # [b, s]
+        return jnp.sum(loss_tok * pad_mask.astype(loss_tok.dtype))
+
+    @jax.jit
+    def num_correct(params, tokens, pad_mask):
+        inp, labels = tokens[:, :-1], tokens[:, 1:]
+        logits = model(params, inp)
+        pred = jnp.argmax(logits, axis=-1)
+        ok = jnp.where(pad_mask > 0, (pred == labels), True)
+        return jnp.sum(jnp.prod(ok.astype(jnp.int32), axis=-1)
+                       * (pad_mask.sum(-1) > 0).astype(jnp.int32))
+
+    return loss_sum, num_correct
+
+
+def evaluate(dataset, model, params, eval_metric, micro_batch_size,
+             log_interval=20):
+    loss_sum, num_correct = _build_eval_fns(model)
+    total = 0.0
+    n = len(dataset)
+    bs = micro_batch_size
+    for lo in range(0, n, bs):
+        idx = range(lo, min(lo + bs, n))
+        batch = [dataset[i] for i in idx]
+        k = len(batch)
+        toks = np.stack([b["text"] for b in batch])
+        mask = np.stack([b["pad_mask"] for b in batch])
+        if k < bs:  # pad the compiled shape; padded rows carry zero mask
+            toks = np.concatenate([toks, np.repeat(toks[-1:], bs - k, 0)])
+            mask = np.concatenate(
+                [mask, np.zeros((bs - k,) + mask.shape[1:], mask.dtype)])
+        toks_j = jnp.asarray(toks, jnp.int32)
+        mask_j = jnp.asarray(mask, jnp.int32)
+        if eval_metric == "loss":
+            total += float(loss_sum(params, toks_j, mask_j))
+        else:
+            total += float(num_correct(params, toks_j, mask_j))
+        if (lo // bs) % log_interval == 0:
+            print(f" > batch {lo // bs}/{(n + bs - 1) // bs}", flush=True)
+    return total
+
+
+def print_results(task, dataset, eval_metric, output):
+    line = f" validation results on {task} | "
+    if eval_metric == "loss":
+        num_tok = dataset.num_tokenized_tokens
+        num_orig = dataset.num_original_tokens
+        val_loss = output / (num_tok - 1)
+        ppl = math.exp(min(20, val_loss))
+        ratio = (num_tok - 1) / (num_orig - 1)
+        adjusted = math.exp(min(20, val_loss * ratio))
+        line += (f"avg loss: {val_loss:.4E} | ppl: {ppl:.4E} | "
+                 f"adjusted ppl: {adjusted:.4E} | token ratio: {ratio} |")
+    else:
+        acc = output / len(dataset)
+        line += (f"number correct: {output:.4E} | total examples: "
+                 f"{len(dataset):.4E} | avg accuracy: {acc:.4E}")
+    print("-" * (len(line) + 1))
+    print(line)
+    print("-" * (len(line) + 1), flush=True)
+
+
+def main():
+    args = get_args()
+    tokenizer = get_tokenizer()
+
+    eval_metric = {"LAMBADA": "accuracy", "WIKITEXT103": "loss"}[args.task]
+    cfg = transformer_config_from_args(args, "gpt")
+    model = GPTModel(cfg)
+
+    params = None
+    if args.load:
+        params, _, _ = checkpointing.load_checkpoint(args.load,
+                                                     finetune=True)
+    if params is None:
+        print(" > WARNING: no checkpoint loaded; evaluating random init",
+              flush=True)
+        params = model.init(jax.random.PRNGKey(args.seed))
+    params = sh.shard_params(params, model.param_specs(params))
+
+    dataset = build_dataset(args.task, args, tokenizer)
+    output = evaluate(dataset, model, params, eval_metric,
+                      args.micro_batch_size, args.log_interval)
+    print_results(args.task, dataset, eval_metric, output)

@@ -96,13 +96,17 @@ def test_build_mapping_native_matches_python(tmp_path):
 def test_build_mapping_short_seqs():
     docs = np.array([0, 4, 8], np.int64)
     sizes = np.full(8, 10, np.int32)
-    m = helpers.build_mapping(docs, sizes, num_epochs=10,
+    m = helpers.build_mapping(docs, sizes, num_epochs=200,
                               max_num_samples=10**6, max_seq_length=25,
                               short_seq_prob=0.5, seed=7, min_num_sent=2)
     assert len(m) > 0
     assert (m[:, 2] >= 2).all() and (m[:, 2] <= 25).all()
     # with p=0.5 some draws must be short
-    assert (m[:, 2] < 25).any()
+    shorts = m[m[:, 2] < 25][:, 2]
+    assert len(shorts) > 0
+    # short lengths must cover both parities (regression: a single RNG draw
+    # reused for decision+length restricted lengths to one residue class)
+    assert {int(x) % 2 for x in shorts} == {0, 1}
 
 
 def test_build_blocks_mapping(tmp_path):
@@ -115,6 +119,11 @@ def test_build_blocks_mapping(tmp_path):
     assert (m[:, 1] > m[:, 0]).all()
     ndocs = len(ds.doc_idx) - 1
     assert (m[:, 2] < ndocs).all()
+    # block ids are unique even across epochs (REALM retrieval key)
+    m3 = helpers.build_blocks_mapping(ds.doc_idx, ds.sizes, title_sizes,
+                                      num_epochs=3, max_num_samples=10**6,
+                                      max_seq_length=61, seed=5)
+    assert len(np.unique(m3[:, 3])) == len(m3)
     # every block's sentences stay within its document
     for start, end, doc, _bid in m[:50]:
         assert ds.doc_idx[doc] <= start and end <= ds.doc_idx[doc + 1]
@@ -230,7 +239,6 @@ def test_ict_dataset(tmp_path):
     assert s["context_tokens"].shape == (128,)
     assert s["query_tokens"][0] == tok.cls
     assert s["context_tokens"][0] == tok.cls
-    assert s["query_mask"].shape == (128, 128)
     assert s["block_data"].shape == (4,)
     # query is real content (some non-special tokens)
     n_q = int(s["query_pad_mask"].sum())
