@@ -1,0 +1,22 @@
+#!/bin/bash
+# Zero-shot retriever evaluation on Natural Questions
+# (reference: examples/evaluate_retriever_nq.sh): embed questions with the
+# trained query tower, retrieve from the precomputed block index, report
+# answer recall@k.
+set -euo pipefail
+CHECKPOINT=${1:?ICT checkpoint}
+EVIDENCE=${2:?evidence data prefix}
+TITLES=${3:?titles data prefix}
+EMBEDDINGS=${4:?block embeddings .pkl (from the IndexBuilder)}
+QA_FILE=${5:?nq dev jsonl/tsv}
+VOCAB=${6:-bert-vocab.txt}
+
+exec python tasks/main.py --task ICT-ZEROSHOT-NQ \
+  --load "$CHECKPOINT" --use_checkpoint_args \
+  --data_path "$EVIDENCE" --titles_data_path "$TITLES" \
+  --embedding_path "$EMBEDDINGS" --qa_data_dev "$QA_FILE" \
+  --micro_batch_size 32 --global_batch_size 32 --train_iters 0 --lr 0.0 \
+  --seq_length 256 --max_position_embeddings 512 \
+  --biencoder_projection_dim 128 \
+  --retriever_report_topk_accuracies 1 5 20 100 \
+  --tokenizer_type BertWordPieceLowerCase --vocab_file "$VOCAB"

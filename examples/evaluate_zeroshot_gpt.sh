@@ -1,0 +1,14 @@
+#!/bin/bash
+# Zero-shot LM evaluation: WIKITEXT103 ppl or LAMBADA accuracy
+# (reference: examples/evaluate_zeroshot_gpt.sh).
+set -euo pipefail
+TASK=${1:?WIKITEXT103 or LAMBADA}
+VALID_DATA=${2:?validation file}
+CHECKPOINT=${3:?checkpoint dir}
+
+exec python tasks/main.py --task "$TASK" \
+  --valid_data "$VALID_DATA" --load "$CHECKPOINT" --use_checkpoint_args \
+  --micro_batch_size 8 --global_batch_size 8 --train_iters 0 --lr 0.0 \
+  --overlapping_eval 32 --log_interval 10 \
+  --tokenizer_type GPT2BPETokenizer \
+  --vocab_file gpt2-vocab.json --merge_file gpt2-merges.txt

@@ -1,0 +1,84 @@
+#!/bin/bash
+# Finetune a llama/mistral/falcon/gpt model on TPU.
+# Mirrors the reference recipe (examples/finetune.sh) with TPU-native
+# launch: no torchrun — one process per host; multi-host runs set
+# RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT (jax.distributed bootstrap).
+#
+# Usage: examples/finetune.sh <gpt/llama/llama2/codellama/falcon/mistral>
+#        [--tp=8] [--pp=1] [--micro-batch=1] [--global-batch=12]
+#        [--iters=1000] [--checkpoint=...] [--data=...] [--out=...]
+#        [--seq-len=...] [--instruct] [--wandb]
+
+set -euo pipefail
+
+MODEL=${1:?model name required}; shift || true
+TP=8; PP=1; MICRO=1; GLOBAL=12; ITERS=1000
+CKPT=none; DATA=none; OUT=checkpoints; SEQ=none
+INSTRUCT=0; WANDB=0; LR="3e-4"; MIN_LR="3e-5"; LOSS_MASK=0.0
+
+for arg in "$@"; do
+  case $arg in
+    --tp=*) TP=${arg#*=};;
+    --pp=*) PP=${arg#*=};;
+    --micro-batch=*) MICRO=${arg#*=};;
+    --global-batch=*) GLOBAL=${arg#*=};;
+    --iters=*) ITERS=${arg#*=};;
+    --checkpoint=*) CKPT=${arg#*=};;
+    --data=*) DATA=${arg#*=};;
+    --out=*) OUT=${arg#*=};;
+    --seq-len=*) SEQ=${arg#*=};;
+    --lr=*) LR=${arg#*=};;
+    --min-lr=*) MIN_LR=${arg#*=};;
+    --loss-mask=*) LOSS_MASK=${arg#*=};;
+    --instruct) INSTRUCT=1;;
+    --wandb) WANDB=1;;
+    *) echo "unknown arg $arg"; exit 1;;
+  esac
+done
+
+# per-model defaults (reference: examples/finetune.sh model cases)
+case $MODEL in
+  llama|llama2|codellama)
+    SEQ_DEFAULT=4096
+    EXTRA=(--use_rms_norm --glu_activation swiglu --no_tie_embed_logits
+           --position_embedding_type rotary --no_bias_gelu_fusion)
+    TOKENIZER=SentencePieceTokenizer;;
+  mistral)
+    SEQ_DEFAULT=8192
+    EXTRA=(--use_rms_norm --glu_activation swiglu --no_tie_embed_logits
+           --position_embedding_type rotary --sliding_window_size 4096)
+    TOKENIZER=SentencePieceTokenizer;;
+  falcon)
+    SEQ_DEFAULT=2048
+    EXTRA=(--parallel_attn --num_attention_heads_kv 1
+           --position_embedding_type rotary)
+    TOKENIZER=FalconTokenizer;;
+  gpt)
+    SEQ_DEFAULT=2048
+    EXTRA=(--num_layers 12 --hidden_size 768 --num_attention_heads 12)
+    TOKENIZER=GPT2BPETokenizer;;
+  *) echo "unknown model $MODEL"; exit 1;;
+esac
+[ "$SEQ" = none ] && SEQ=$SEQ_DEFAULT
+
+ARGS=(--model_name="$MODEL"
+      --tensor_model_parallel_size="$TP"
+      --pipeline_model_parallel_size="$PP"
+      --micro_batch_size="$MICRO" --global_batch_size="$GLOBAL"
+      --train_iters="$ITERS" --seq_length="$SEQ"
+      --max_position_embeddings="$SEQ"
+      --lr "$LR" --min_lr "$MIN_LR" --lr_decay_style cosine
+      --lr_warmup_iters 100 --weight_decay 0.1 --clip_grad 1.0
+      --bf16 --sequence_parallel --use_flash_attn
+      --log_interval 1 --save_interval 200 --eval_interval 200
+      --save "$OUT" --tokenizer_type "$TOKENIZER"
+      "${EXTRA[@]}")
+
+[ "$CKPT" != none ] && ARGS+=(--load "$CKPT" --use_checkpoint_args)
+[ "$DATA" != none ] && ARGS+=(--data_path "$DATA")
+[ "$INSTRUCT" = 1 ] && ARGS+=(--data_type instruction
+                              --variable_seq_lengths
+                              --scalar_loss_mask="$LOSS_MASK")
+[ "$WANDB" = 1 ] && ARGS+=(--wandb_logger)
+
+exec python finetune.py "${ARGS[@]}"

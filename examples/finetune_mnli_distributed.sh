@@ -1,0 +1,19 @@
+#!/bin/bash
+# MNLI classification finetune from a pretrained BERT checkpoint
+# (reference: examples/finetune_mnli_distributed.sh).
+set -euo pipefail
+TRAIN_DATA=${1:?train.tsv}
+VALID_DATA=${2:?dev_matched.tsv}
+PRETRAINED=${3:?pretrained BERT checkpoint}
+VOCAB=${4:-bert-vocab.txt}
+
+exec python tasks/main.py --task MNLI \
+  --train_data "$TRAIN_DATA" --valid_data "$VALID_DATA" \
+  --pretrained_checkpoint "$PRETRAINED" --epochs 5 \
+  --num_layers 24 --hidden_size 1024 --num_attention_heads 16 \
+  --seq_length 512 --max_position_embeddings 512 \
+  --micro_batch_size 8 --global_batch_size 64 --train_iters 0 \
+  --lr 5e-5 --min_lr 0 --lr_decay_style linear --weight_decay 1e-2 \
+  --clip_grad 1.0 --bf16 \
+  --tokenizer_type BertWordPieceLowerCase --vocab_file "$VOCAB" \
+  --log_interval 10 --save checkpoints/bert_mnli

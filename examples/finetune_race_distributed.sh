@@ -1,0 +1,18 @@
+#!/bin/bash
+# RACE multiple-choice finetune (reference: examples/finetune_race_distributed.sh).
+set -euo pipefail
+TRAIN_DATA=${1:?RACE/train/middle (dir)}
+VALID_DATA=${2:?RACE/dev/middle (dir)}
+PRETRAINED=${3:?pretrained BERT checkpoint}
+VOCAB=${4:-bert-vocab.txt}
+
+exec python tasks/main.py --task RACE \
+  --train_data "$TRAIN_DATA" --valid_data "$VALID_DATA" \
+  --pretrained_checkpoint "$PRETRAINED" --epochs 3 \
+  --num_layers 24 --hidden_size 1024 --num_attention_heads 16 \
+  --seq_length 512 --max_position_embeddings 512 \
+  --micro_batch_size 4 --global_batch_size 32 --train_iters 0 \
+  --lr 1e-5 --min_lr 0 --lr_decay_style linear --weight_decay 1e-2 \
+  --clip_grad 1.0 --bf16 \
+  --tokenizer_type BertWordPieceLowerCase --vocab_file "$VOCAB" \
+  --log_interval 10 --save checkpoints/bert_race

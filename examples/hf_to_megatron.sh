@@ -1,0 +1,14 @@
+#!/bin/bash
+# Convert HuggingFace (or Meta-format) weights into a TPU release
+# checkpoint (reference: examples/hf_to_megatron.sh).
+set -euo pipefail
+MODEL=${1:?gpt/llama/llama2/codellama/falcon/mistral}
+SRC=${2:?HF id / local path / Meta dir}
+OUT=${3:-checkpoints/${MODEL}-release}
+
+if [ -f "$SRC/params.json" ]; then
+  exec python weights_conversion/hf_to_megatron.py "$MODEL" \
+    --model_path "$SRC" --meta_weights --out "$OUT" --dtype bf16
+fi
+exec python weights_conversion/hf_to_megatron.py "$MODEL" \
+  --model_path "$SRC" --out "$OUT" --dtype bf16

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Reshard a checkpoint to a different (tp, pp) layout
+# (reference: examples/parallelize.sh -> tools/checkpoint_util.py).
+set -euo pipefail
+LOAD=${1:?source checkpoint}
+SAVE=${2:?target checkpoint dir}
+TP=${3:-8}
+PP=${4:-1}
+
+exec python tools/checkpoint_util.py \
+  --load_dir "$LOAD" --save_dir "$SAVE" \
+  --target_tensor_parallel_size "$TP" \
+  --target_pipeline_parallel_size "$PP"

@@ -1,0 +1,19 @@
+#!/bin/bash
+# BERT-large MLM+SOP pretraining (reference: examples/pretrain_bert.sh).
+# The data prefix must be a SENTENCE-LEVEL corpus: build it with
+#   tools/preprocess_data.py --split_sentences
+set -euo pipefail
+DATA_PATH=${1:?data prefix required}
+VOCAB=${2:-bert-vocab.txt}
+
+exec python pretrain_bert.py \
+  --num_layers 24 --hidden_size 1024 --num_attention_heads 16 \
+  --seq_length 512 --max_position_embeddings 512 \
+  --micro_batch_size 4 --global_batch_size 32 \
+  --train_iters 1000000 --lr 0.0001 --min_lr 1e-5 \
+  --lr_decay_style linear --lr_warmup_fraction 0.01 \
+  --weight_decay 0.01 --clip_grad 1.0 --bf16 \
+  --data_path "$DATA_PATH" --split 949,50,1 \
+  --tokenizer_type BertWordPieceLowerCase --vocab_file "$VOCAB" \
+  --masked_lm_prob 0.15 --short_seq_prob 0.1 \
+  --log_interval 100 --save_interval 10000 --save checkpoints/bert_large

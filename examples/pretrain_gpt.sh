@@ -1,0 +1,19 @@
+#!/bin/bash
+# Single-host GPT-2 345M pretraining (reference: examples/pretrain_gpt.sh).
+set -euo pipefail
+DATA_PATH=${1:?usage: $0 <data prefix> [vocab.json] [merges.txt]}
+VOCAB=${2:-gpt2-vocab.json}
+MERGES=${3:-gpt2-merges.txt}
+
+exec python pretrain_gpt.py \
+  --num_layers 24 --hidden_size 1024 --num_attention_heads 16 \
+  --seq_length 1024 --max_position_embeddings 1024 \
+  --micro_batch_size 4 --global_batch_size 8 \
+  --train_iters 500000 --lr_decay_iters 320000 \
+  --lr 0.00015 --min_lr 1e-5 --lr_decay_style cosine \
+  --lr_warmup_fraction 0.01 --weight_decay 0.01 --clip_grad 1.0 \
+  --bf16 --data_path "$DATA_PATH" --split 949,50,1 \
+  --tokenizer_type GPT2BPETokenizer \
+  --vocab_file "$VOCAB" --merge_file "$MERGES" \
+  --log_interval 100 --save_interval 10000 --eval_interval 1000 \
+  --eval_iters 10 --save checkpoints/gpt_345m

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Multi-host GPT pretraining with tensor + pipeline parallelism
+# (reference: examples/pretrain_gpt_distributed_with_mp.sh).
+#
+# Launch ONE copy per host with the bootstrap env set:
+#   WORLD_SIZE=<n hosts> RANK=<this host> MASTER_ADDR=<host0> \
+#   MASTER_PORT=8476 examples/pretrain_gpt_distributed_with_mp.sh <data>
+# jax.distributed.initialize picks these up (topology.initialize_distributed).
+set -euo pipefail
+DATA_PATH=${1:?data prefix required}
+
+exec python pretrain_gpt.py \
+  --tensor_model_parallel_size 8 --pipeline_model_parallel_size 2 \
+  --sequence_parallel \
+  --num_layers 24 --hidden_size 1024 --num_attention_heads 16 \
+  --seq_length 1024 --max_position_embeddings 1024 \
+  --micro_batch_size 2 --global_batch_size 16 \
+  --train_iters 500000 --lr 0.00015 --min_lr 1e-5 \
+  --lr_decay_style cosine --lr_warmup_fraction 0.01 \
+  --weight_decay 0.01 --clip_grad 1.0 --bf16 --use_flash_attn \
+  --use_distributed_optimizer \
+  --data_path "$DATA_PATH" --split 949,50,1 \
+  --tokenizer_type GPT2BPETokenizer \
+  --vocab_file gpt2-vocab.json --merge_file gpt2-merges.txt \
+  --log_interval 100 --save_interval 10000 --save checkpoints/gpt_mp

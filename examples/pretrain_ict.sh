@@ -1,0 +1,21 @@
+#!/bin/bash
+# BiEncoder inverse-cloze-task pretraining (reference: examples/pretrain_ict.sh).
+# Needs a sentence-level evidence corpus + a one-title-per-document dataset.
+set -euo pipefail
+DATA_PATH=${1:?evidence data prefix required}
+TITLES_PATH=${2:?titles data prefix required}
+VOCAB=${3:-bert-vocab.txt}
+
+exec python pretrain_ict.py \
+  --num_layers 12 --hidden_size 768 --num_attention_heads 12 \
+  --seq_length 256 --max_position_embeddings 512 \
+  --micro_batch_size 32 --global_batch_size 128 \
+  --train_iters 100000 --lr 0.0001 --min_lr 1e-5 \
+  --lr_decay_style linear --lr_warmup_fraction 0.01 \
+  --weight_decay 0.01 --clip_grad 1.0 --bf16 \
+  --data_path "$DATA_PATH" --titles_data_path "$TITLES_PATH" \
+  --split 100,0,0 \
+  --tokenizer_type BertWordPieceLowerCase --vocab_file "$VOCAB" \
+  --query_in_block_prob 0.1 --biencoder_projection_dim 128 \
+  --retriever_score_scaling \
+  --log_interval 100 --save_interval 10000 --save checkpoints/ict

@@ -1,0 +1,21 @@
+#!/bin/bash
+# T5 span-corruption pretraining (reference: examples/pretrain_t5.sh).
+# Sentence-level corpus (tools/preprocess_data.py --split_sentences) and a
+# tokenizer with --vocab_extra_ids sentinels.
+set -euo pipefail
+DATA_PATH=${1:?data prefix required}
+VOCAB=${2:-bert-vocab.txt}
+
+exec python pretrain_t5.py \
+  --num_layers 12 --hidden_size 768 --num_attention_heads 12 \
+  --kv_channels 64 --ffn_hidden_size 3072 \
+  --seq_length 512 --decoder_seq_length 128 \
+  --max_position_embeddings 512 \
+  --micro_batch_size 16 --global_batch_size 128 \
+  --train_iters 1000000 --lr 0.0001 --min_lr 1e-5 \
+  --lr_decay_style linear --lr_warmup_fraction 0.01 \
+  --weight_decay 0.01 --clip_grad 1.0 --bf16 \
+  --data_path "$DATA_PATH" --split 949,50,1 \
+  --tokenizer_type BertWordPieceLowerCase --vocab_file "$VOCAB" \
+  --vocab_extra_ids 100 --masked_lm_prob 0.15 --short_seq_prob 0.1 \
+  --log_interval 100 --save_interval 10000 --save checkpoints/t5_base

@@ -1,0 +1,11 @@
+#!/bin/bash
+# REST generation server (reference: examples/run_text_generation_server_345M.sh).
+set -euo pipefail
+CHECKPOINT=${1:?checkpoint dir required}
+TOKENIZER_MODEL=${2:?tokenizer model/vocab required}
+
+exec python tools/run_text_generation_server.py \
+  --model_name=llama2 --load "$CHECKPOINT" --use_checkpoint_args \
+  --tokenizer_type SentencePieceTokenizer --vocab_file "$TOKENIZER_MODEL" \
+  --bf16 --micro_batch_size 1 --train_iters 0 --lr 0.0 \
+  --port 5000

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Correctness check of a converted checkpoint vs the HF reference weights
+# (reference: examples/verify.sh -> verify_correctness.py).
+set -euo pipefail
+MODEL=${1:?model name}
+CKPT=${2:?converted checkpoint}
+HF_PATH=${3:?HF baseline path}
+
+exec python verify_correctness.py --model_name="$MODEL" \
+  --load "$CKPT" --huggingface_path "$HF_PATH" \
+  --iters 10 --batch 2 --seq_length 512

@@ -1,0 +1,133 @@
+"""Meta consolidated.*.pth shard merging -> HF naming -> TPU conversion."""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from weights_conversion.merge_llama import (  # noqa: E402
+    merge_llama,
+    meta_to_hf_names,
+)
+
+DIM, FFN, HEADS, LAYERS, VOCAB = 16, 40, 4, 2, 64
+
+
+def _full_meta_state(rng):
+    sd = {}
+    sd["tok_embeddings.weight"] = rng.randn(VOCAB, DIM)
+    sd["norm.weight"] = rng.randn(DIM)
+    sd["output.weight"] = rng.randn(VOCAB, DIM)
+    for i in range(LAYERS):
+        p = f"layers.{i}."
+        sd[p + "attention.wq.weight"] = rng.randn(DIM, DIM)
+        sd[p + "attention.wk.weight"] = rng.randn(DIM, DIM)
+        sd[p + "attention.wv.weight"] = rng.randn(DIM, DIM)
+        sd[p + "attention.wo.weight"] = rng.randn(DIM, DIM)
+        sd[p + "feed_forward.w1.weight"] = rng.randn(FFN, DIM)
+        sd[p + "feed_forward.w2.weight"] = rng.randn(DIM, FFN)
+        sd[p + "feed_forward.w3.weight"] = rng.randn(FFN, DIM)
+        sd[p + "attention_norm.weight"] = rng.randn(DIM)
+        sd[p + "ffn_norm.weight"] = rng.randn(DIM)
+    return {k: v.astype(np.float32) for k, v in sd.items()}
+
+
+def _shard(sd, n, which):
+    """Split like Meta: dim-0 for column-parallel keys, dim-1 for
+    row-parallel, replicate norms."""
+    from weights_conversion.merge_llama import MERGE_DIM, _short_name
+
+    out = {}
+    for name, arr in sd.items():
+        dim = MERGE_DIM.get(_short_name(name))
+        if dim is None:
+            out[name] = arr
+        elif dim == 0:
+            out[name] = np.split(arr, n, axis=0)[which]
+        else:
+            out[name] = np.split(arr, n, axis=1)[which]
+    return {k: torch.from_numpy(v.copy()) for k, v in out.items()}
+
+
+def _write_meta_dir(tmp_path, sd, n_shards=2):
+    for s in range(n_shards):
+        torch.save(_shard(sd, n_shards, s),
+                   tmp_path / f"consolidated.{s:02d}.pth")
+    with open(tmp_path / "params.json", "w") as f:
+        json.dump({"dim": DIM, "n_layers": LAYERS, "n_heads": HEADS,
+                   "norm_eps": 1e-5, "vocab_size": VOCAB}, f)
+
+
+def test_merge_llama_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    full = _full_meta_state(rng)
+    _write_meta_dir(tmp_path, full, n_shards=2)
+    merged = merge_llama(str(tmp_path))
+    assert set(merged) == set(full)
+    for name in full:
+        np.testing.assert_array_equal(merged[name], full[name]), name
+
+
+def test_meta_to_hf_names(tmp_path):
+    rng = np.random.RandomState(1)
+    full = _full_meta_state(rng)
+    _write_meta_dir(tmp_path, full)
+    hf = meta_to_hf_names(merge_llama(str(tmp_path)), HEADS, HEADS)
+    assert "model.embed_tokens.weight" in hf
+    assert "lm_head.weight" in hf
+    assert f"model.layers.{LAYERS-1}.mlp.down_proj.weight" in hf
+    assert hf["model.layers.0.self_attn.q_proj.weight"].shape == (DIM, DIM)
+
+
+def test_meta_rotary_layout_roundtrip(tmp_path):
+    """Meta wq/wk are interleaved; meta_to_hf_names must emit the HF
+    half-split layout so the converter's rotary_hf_to_interleaved recovers
+    the ORIGINAL Meta weights (regression: double-permutation scrambled
+    q/k)."""
+    from weights_conversion.util import rotary_hf_to_interleaved
+
+    rng = np.random.RandomState(3)
+    full = _full_meta_state(rng)
+    _write_meta_dir(tmp_path, full)
+    hf = meta_to_hf_names(merge_llama(str(tmp_path)), HEADS, HEADS)
+    head_dim = DIM // HEADS
+    for i in range(LAYERS):
+        for meta_key, hf_key in [
+                (f"layers.{i}.attention.wq.weight",
+                 f"model.layers.{i}.self_attn.q_proj.weight"),
+                (f"layers.{i}.attention.wk.weight",
+                 f"model.layers.{i}.self_attn.k_proj.weight")]:
+            np.testing.assert_array_equal(
+                rotary_hf_to_interleaved(hf[hf_key].copy(), head_dim),
+                full[meta_key])
+    # v is untouched
+    np.testing.assert_array_equal(
+        hf["model.layers.0.self_attn.v_proj.weight"],
+        full["layers.0.attention.wv.weight"])
+
+
+def test_meta_shim_llama1_context(tmp_path):
+    from weights_conversion.hf_to_megatron import MetaLlamaShim
+
+    rng = np.random.RandomState(4)
+    _write_meta_dir(tmp_path, _full_meta_state(rng))
+    assert MetaLlamaShim(str(tmp_path), "llama").config \
+        .max_position_embeddings == 2048
+    assert MetaLlamaShim(str(tmp_path), "llama2").config \
+        .max_position_embeddings == 4096
+
+
+def test_meta_shim_converts(tmp_path):
+    from weights_conversion.hf_to_megatron import CONVERTERS, MetaLlamaShim
+
+    rng = np.random.RandomState(2)
+    _write_meta_dir(tmp_path, _full_meta_state(rng))
+    shim = MetaLlamaShim(str(tmp_path))
+    assert shim.config.num_hidden_layers == LAYERS
+    assert shim.config.intermediate_size == FFN
+    params, config = CONVERTERS["llama2"](shim)
+    qkv = params["transformer"]["layers"]["attention"]["query_key_value"]["kernel"]
+    assert qkv.shape[0] == LAYERS
+    assert config["num_layers"] == LAYERS

@@ -33,12 +33,34 @@ def _init_worker(args):
     _TOKENIZER = build_tokenizer(args)
 
 
+_SENT_RE = None
+
+
+def _split_sentences(text):
+    """Regex sentence splitter (the reference uses nltk punkt; a
+    dependency-free splitter on terminal punctuation keeps the same
+    one-sequence-per-sentence dataset shape for BERT/T5/ICT)."""
+    global _SENT_RE
+    if _SENT_RE is None:
+        import re
+        _SENT_RE = re.compile(r"(?<=[.!?])\s+(?=[^\s])")
+    return [s for s in _SENT_RE.split(text) if s.strip()]
+
+
 def _encode(line):
     line = line.strip()
     if not line:
         return None, 0
     doc = json.loads(line)
     text = doc[_ARGS.json_key]
+    if _ARGS.split_sentences:
+        # one sequence per sentence, document boundary preserved — the
+        # layout BertDataset/T5Dataset/ICTDataset sample spans from
+        ids = [_TOKENIZER.tokenize(s) for s in _split_sentences(text)]
+        ids = [s for s in ids if s]
+        if ids and _ARGS.append_eod:
+            ids[-1] = list(ids[-1]) + [_TOKENIZER.eod]
+        return (ids if ids else None), len(line)
     ids = _TOKENIZER.tokenize(text)
     if _ARGS.append_eod:
         ids = list(ids) + [_TOKENIZER.eod]
@@ -64,6 +86,9 @@ def get_args():
     g.add_argument("--vocab_size", type=int, default=None)
     g.add_argument("--append_eod", "--append-eod", dest="append_eod",
                    action="store_true")
+    g.add_argument("--split_sentences", "--split-sentences",
+                   dest="split_sentences", action="store_true",
+                   help="one sequence per sentence (BERT/T5/ICT corpora)")
     g = p.add_argument_group("output")
     g.add_argument("--output_prefix", "--output-prefix",
                    dest="output_prefix", required=True)
@@ -97,7 +122,11 @@ def main():
         for ids, nb in encoded:
             if ids is None:
                 continue
-            builder.add_item(ids)
+            if args.split_sentences:
+                for sent in ids:
+                    builder.add_item(sent)
+            else:
+                builder.add_item(ids)
             builder.end_document()
             n_docs += 1
             n_bytes += nb

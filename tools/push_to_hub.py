@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Push an HF-format checkpoint (e.g. produced by
+weights_conversion/megatron_to_hf.py) to the Hugging Face Hub.
+
+Reference: ``tools/push_to_hub.py`` — loads the model + tokenizer, applies
+optional dtype conversion and RoPE-scaling config overrides, then
+``push_to_hub`` (or saves to --output_folder for offline use; this image
+has no egress, so the save path is the testable one).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def parse_args():
+    p = argparse.ArgumentParser(
+        description="Push an HF-format checkpoint to the Hugging Face Hub "
+                    "or re-save it with a different dtype / rope scaling.")
+    p.add_argument("model_name_or_path")
+    p.add_argument("--dtype", default="auto",
+                   choices=["auto", "bf16", "fp16", "fp32"])
+    p.add_argument("--hf_repo_name", default=None)
+    p.add_argument("--auth_token", default=None)
+    p.add_argument("--output_folder", default=None)
+    p.add_argument("--max_shard_size", default="10GB")
+    p.add_argument("--unsafe", action="store_true",
+                   help="disable safetensors serialization")
+    p.add_argument("--rope_scaling_type", default=None,
+                   choices=[None, "linear", "dynamic"])
+    p.add_argument("--rope_scaling_factor", type=float, default=None)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    if args.hf_repo_name is None and args.output_folder is None:
+        sys.exit("need --hf_repo_name and/or --output_folder")
+
+    import torch
+    from transformers import AutoModelForCausalLM, AutoTokenizer
+
+    dtype = {"auto": "auto", "bf16": torch.bfloat16, "fp16": torch.float16,
+             "fp32": torch.float32}[args.dtype]
+    print(f" > loading {args.model_name_or_path} (dtype={args.dtype})",
+          flush=True)
+    model = AutoModelForCausalLM.from_pretrained(
+        args.model_name_or_path, torch_dtype=dtype)
+    tokenizer = AutoTokenizer.from_pretrained(args.model_name_or_path)
+
+    if args.rope_scaling_type is not None and args.rope_scaling_factor is None:
+        sys.exit("--rope_scaling_type requires --rope_scaling_factor")
+    if args.rope_scaling_factor is not None:
+        if args.rope_scaling_factor <= 1.0:
+            sys.exit("--rope_scaling_factor must be > 1.0")
+        model.config.rope_scaling = {
+            "type": args.rope_scaling_type or "linear",
+            "factor": args.rope_scaling_factor,
+        }
+        print(f" > set rope_scaling = {model.config.rope_scaling}",
+              flush=True)
+
+    kwargs = dict(max_shard_size=args.max_shard_size,
+                  safe_serialization=not args.unsafe)
+    if args.output_folder:
+        model.save_pretrained(args.output_folder, **kwargs)
+        tokenizer.save_pretrained(args.output_folder)
+        print(f" > saved to {args.output_folder}", flush=True)
+    if args.hf_repo_name:
+        model.push_to_hub(args.hf_repo_name, token=args.auth_token, **kwargs)
+        tokenizer.push_to_hub(args.hf_repo_name, token=args.auth_token)
+        print(f" > pushed to {args.hf_repo_name}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -275,11 +275,64 @@ CONVERTERS = {
 }
 
 
+class MetaLlamaShim:
+    """Duck-types the (config, state_dict) surface the converters read, fed
+    from merged Meta shards (reference: hf_to_megatron downloads/merges Meta
+    weights via utils/merge_llama.py before converting)."""
+
+    # Meta params.json has no max_seq_len (it's a runtime arg in Meta's
+    # code); trained context depends on the release
+    MODEL_CONTEXT = {"llama": 2048, "llama2": 4096, "codellama": 16384}
+
+    def __init__(self, model_dir: str, model: str = "llama2"):
+        import json
+        import os
+        from types import SimpleNamespace
+
+        import torch
+
+        from weights_conversion.merge_llama import (
+            merge_llama,
+            meta_to_hf_names,
+        )
+
+        with open(os.path.join(model_dir, "params.json")) as f:
+            meta_cfg = json.load(f)
+        n_heads = meta_cfg["n_heads"]
+        n_kv = meta_cfg.get("n_kv_heads", n_heads)
+        merged = merge_llama(model_dir)
+        sd = meta_to_hf_names(merged, n_heads, n_kv)
+        self._sd = {k: torch.from_numpy(v) for k, v in sd.items()}
+        hidden = meta_cfg["dim"]
+        vocab = sd["model.embed_tokens.weight"].shape[0]
+        ffn = sd["model.layers.0.mlp.gate_proj.weight"].shape[0]
+        self.config = SimpleNamespace(
+            num_attention_heads=n_heads,
+            num_key_value_heads=n_kv,
+            num_hidden_layers=meta_cfg["n_layers"],
+            hidden_size=hidden,
+            intermediate_size=ffn,
+            vocab_size=vocab,
+            rms_norm_eps=meta_cfg.get("norm_eps", 1e-5),
+            max_position_embeddings=meta_cfg.get(
+                "max_seq_len", self.MODEL_CONTEXT.get(model, 4096)),
+            rope_theta=meta_cfg.get("rope_theta", 10000.0),
+        )
+
+    def state_dict(self):
+        return self._sd
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("model", choices=sorted(CONVERTERS))
     p.add_argument("--model-path", "--model_path", dest="model_path",
-                   required=True, help="HF hub id or local path")
+                   required=True,
+                   help="HF hub id / local path, or a Meta llama release "
+                        "dir (consolidated.*.pth + params.json) with "
+                        "--meta_weights")
+    p.add_argument("--meta_weights", action="store_true",
+                   help="treat --model_path as Meta-format llama shards")
     p.add_argument("--out", required=True)
     p.add_argument("--dtype", default="fp32",
                    choices=["fp32", "bf16", "fp16"])
@@ -292,9 +345,15 @@ def main():
 
     from megatron_llm_tpu import checkpointing
 
-    hf = AutoModelForCausalLM.from_pretrained(
-        args.model_path, torch_dtype=torch.float32, trust_remote_code=False
-    )
+    if args.meta_weights:
+        assert args.model in ("llama", "llama2", "codellama"), \
+            "--meta_weights only applies to the llama family"
+        hf = MetaLlamaShim(args.model_path, args.model)
+    else:
+        hf = AutoModelForCausalLM.from_pretrained(
+            args.model_path, torch_dtype=torch.float32,
+            trust_remote_code=False
+        )
     dtype = {"fp32": jnp.float32, "bf16": jnp.bfloat16,
              "fp16": jnp.float16}[args.dtype]
     params, config = CONVERTERS[args.model](hf, dtype)
