@@ -47,6 +47,16 @@ def get_tasks_args(parser):
                    action="store_true")
     g.add_argument("--retriever_report_topk_accuracies", nargs="*",
                    type=int, default=None)
+    # MSDP (multi-stage dialogue prompting) flags
+    g.add_argument("--guess_file", default=None)
+    g.add_argument("--answer_file", default=None)
+    g.add_argument("--prompt_file", default=None)
+    g.add_argument("--prompt_type", default=None,
+                   choices=[None, "knowledge", "response"])
+    g.add_argument("--sample_input_file", default=None)
+    g.add_argument("--sample_output_file", default=None)
+    g.add_argument("--num_prompt_examples", type=int, default=10)
+    g.add_argument("--out_seq_length", type=int, default=100)
     return parser
 
 
@@ -61,6 +71,10 @@ def main():
         from tasks.zeroshot_gpt.evaluate import main as task_main
     elif args.task in ("ICT-ZEROSHOT-NQ", "RETRIEVER-EVAL"):
         from tasks.orqa.evaluate_orqa import main as task_main
+    elif args.task in ("MSDP-PROMPT-KNWL", "MSDP-PROMPT-RESP"):
+        from tasks.msdp.prompt import main as task_main
+    elif args.task == "MSDP-EVAL-F1":
+        from tasks.msdp.evaluate import main as task_main
     else:
         raise NotImplementedError(f"task {args.task!r} is not implemented")
 

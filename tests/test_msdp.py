@@ -1,0 +1,128 @@
+"""MSDP: F1 metric, file evaluation, WoW preprocessing, prompt building."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tasks.msdp.metrics import F1Metric, normalize_answer, token_f1
+
+
+def test_normalize_answer():
+    assert normalize_answer("The Cat, sat!") == "cat sat"
+    assert normalize_answer("A  b   c") == "b c"
+
+
+def test_token_f1():
+    p, r, f = token_f1("the cat sat", "a cat sat down")
+    assert p == pytest.approx(2 / 2)  # "cat sat" of "cat sat"
+    assert r == pytest.approx(2 / 3)
+    assert f == pytest.approx(2 * 1 * (2 / 3) / (1 + 2 / 3))
+    assert token_f1("anything", "") == (None, None, None)
+    assert token_f1("", "gold") == (0.0, 0.0, 0.0)
+    assert token_f1("zebra", "yak")[2] == 0.0
+
+
+def test_f1_all_pairs():
+    p, r, f = F1Metric.compute_all_pairs(
+        ["cat sat", "dog ran", "x"], ["cat sat", "", "x"])
+    # middle pair skipped (empty answer)
+    assert f == pytest.approx((1.0 + 1.0) / 2)
+
+
+def test_evaluate_f1_files(tmp_path):
+    from tasks.msdp.evaluate import evaluate_f1
+
+    g = tmp_path / "guess.txt"
+    a = tmp_path / "answer.txt"
+    g.write_text("the cat<|endoftext|>\nhello world\n")
+    a.write_text("cat\nno_passages_used\n")
+    p, r, f = evaluate_f1(str(g), str(a))
+    assert f == pytest.approx(1.0)  # only the first pair counts
+
+
+def test_process_wow(tmp_path):
+    from tasks.msdp.preprocessing import process_wow_dataset
+
+    raw = [{
+        "chosen_topic": "Cats",
+        "dialog": [
+            {"speaker": "0_Apprentice", "text": "tell me about cats"},
+            {"speaker": "1_Wizard", "text": "cats are felines",
+             "checked_sentence": {"k": "A cat is a feline."}},
+            {"speaker": "0_Apprentice", "text": "cool"},
+            {"speaker": "1_Wizard", "text": "indeed",
+             "checked_sentence": {}},
+        ],
+    }]
+    rawf = tmp_path / "wow.json"
+    rawf.write_text(json.dumps(raw))
+    out = tmp_path / "processed.tsv"
+    kref = tmp_path / "knwl.txt"
+    rref = tmp_path / "resp.txt"
+    n = process_wow_dataset(str(rawf), str(out), str(kref), str(rref))
+    assert n == 2
+    lines = out.read_text().splitlines()
+    topic, dialogue, knowledge, resp = lines[0].split("\t")
+    assert topic == "Cats" and knowledge == "A cat is a feline."
+    assert resp == "cats are felines"
+    assert lines[1].split("\t")[2] == "no_passages_used"
+    assert kref.read_text().splitlines()[0] == "A cat is a feline."
+
+
+def test_prompt_building(tmp_path):
+    from tasks.msdp.preprocessing import (
+        build_knowledge_prompts,
+        build_response_prompts,
+    )
+    from tasks.msdp.prompt import (
+        build_input,
+        read_knowledge_prompts,
+        read_response_prompt,
+    )
+
+    train = tmp_path / "train.tsv"
+    train.write_text(
+        "Cats\thi [SEP] tell me about cats\tA cat is a feline.\tfelines!\n"
+        "Dogs\thello [SEP] dogs?\tDogs bark.\twoof\n")
+    # the prompt keys must come from the file generation will run on
+    test = tmp_path / "test.tsv"
+    test.write_text("Cats\tyo [SEP] what about cats\n")
+    kp = tmp_path / "kprompts.jsonl"
+    build_knowledge_prompts(str(train), str(kp), n_examples=2,
+                            test_file=str(test))
+    prompts = read_knowledge_prompts(str(kp))
+    # keyed by the TEST sample's topic + last turn (regression: train-keyed
+    # prompts never matched at generation time)
+    assert "Cats what about cats" in prompts
+    assert "A cat is a feline." in prompts["Cats what about cats"]
+
+    rp = tmp_path / "rprompts.txt"
+    build_response_prompts(str(train), str(rp), n_examples=2)
+    fixed = read_response_prompt(str(rp), 2)
+    assert "Response:" in fixed
+
+    line = "Cats\tyo [SEP] what about cats"
+    knowledge_input = build_input(line, "knowledge", prompts, "")
+    assert knowledge_input.endswith("( what about cats ) Cats =>")
+    # the few-shot examples actually made it into the input
+    assert "A cat is a feline." in knowledge_input
+    resp_line = "Cats\thi [SEP] tell me about cats\tA cat is a feline."
+    resp_input = build_input(resp_line, "response", None, fixed)
+    assert resp_input.endswith("Response:")
+    assert "Knowledge: A cat is a feline." in resp_input
+
+
+def test_prepare_response_inputs(tmp_path):
+    from tasks.msdp.preprocessing import (
+        prepare_input_for_response_generation,
+    )
+
+    test = tmp_path / "test.tsv"
+    test.write_text("Cats\thi [SEP] q\tgold knowledge\tgold resp\n")
+    gen = tmp_path / "gen.txt"
+    gen.write_text("generated knowledge\n")
+    out = tmp_path / "resp_in.tsv"
+    prepare_input_for_response_generation(str(test), str(gen), str(out))
+    assert out.read_text().strip() == \
+        "Cats\thi [SEP] q\tgenerated knowledge"

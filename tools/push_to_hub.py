@@ -11,7 +11,6 @@ has no egress, so the save path is the testable one).
 from __future__ import annotations
 
 import argparse
-import sys
 
 
 def parse_args():
@@ -30,13 +29,19 @@ def parse_args():
     p.add_argument("--rope_scaling_type", default=None,
                    choices=[None, "linear", "dynamic"])
     p.add_argument("--rope_scaling_factor", type=float, default=None)
-    return p.parse_args()
+    args = p.parse_args()
+    # validate before the (potentially multi-hundred-GB) model load
+    if args.rope_scaling_type is not None and args.rope_scaling_factor is None:
+        p.error("--rope_scaling_type requires --rope_scaling_factor")
+    if args.rope_scaling_factor is not None and args.rope_scaling_factor <= 1.0:
+        p.error("--rope_scaling_factor must be > 1.0")
+    if args.hf_repo_name is None and args.output_folder is None:
+        p.error("need --hf_repo_name and/or --output_folder")
+    return args
 
 
 def main():
     args = parse_args()
-    if args.hf_repo_name is None and args.output_folder is None:
-        sys.exit("need --hf_repo_name and/or --output_folder")
 
     import torch
     from transformers import AutoModelForCausalLM, AutoTokenizer
@@ -49,11 +54,7 @@ def main():
         args.model_name_or_path, torch_dtype=dtype)
     tokenizer = AutoTokenizer.from_pretrained(args.model_name_or_path)
 
-    if args.rope_scaling_type is not None and args.rope_scaling_factor is None:
-        sys.exit("--rope_scaling_type requires --rope_scaling_factor")
     if args.rope_scaling_factor is not None:
-        if args.rope_scaling_factor <= 1.0:
-            sys.exit("--rope_scaling_factor must be > 1.0")
         model.config.rope_scaling = {
             "type": args.rope_scaling_type or "linear",
             "factor": args.rope_scaling_factor,
