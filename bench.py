@@ -1,62 +1,121 @@
 #!/usr/bin/env python
 """Benchmark: training throughput (tokens/sec/chip) + MFU on one chip.
 
-Prints ONE JSON line:
+Prints ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 Baseline: the reference's derived Llama-2-7B finetune throughput is
-~3.5k tokens/sec per A100-80GB (BASELINE.md).  A single v5e chip can't
-hold 7B training state, so the bench trains the largest Llama-family
-model that fits one chip and reports MFU alongside raw tokens/sec;
+~3.5k tokens/sec per A100-80GB (BASELINE.md).  A single TPU chip can't
+hold 7B training state, so the bench trains a mid-size Llama-family
+model on one chip and reports MFU alongside raw tokens/sec;
 ``vs_baseline`` compares achieved MFU against the reference's implied
 A100 MFU on its 7B recipe (~3.5k tok/s x 6x7e9 FLOP/tok / 312 TFLOPs
 = 47%), i.e. vs_baseline > 1 means better hardware utilization than the
 reference's own headline recipe.
+
+Robustness contract (the driver runs this unattended):
+ * the parent process imports NO jax; it launches the measurement in a
+   child under a hard deadline and streams the child's stderr progress;
+ * if the TPU child hangs at backend init, fails, or exceeds its
+   deadline, the parent kills it and falls back to a forced-CPU child
+   (axon env stripped) so a JSON line is produced either way;
+ * the child enables the persistent compilation cache (.jax_cache/) so
+   repeat runs skip compilation;
+ * staged progress is printed to stderr with elapsed timestamps.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+T0 = time.time()
 
-from megatron_llm_tpu.config import ParallelConfig, TrainConfig
-from megatron_llm_tpu.models.llama import LlamaModel, llama_config
-from megatron_llm_tpu.optimizer import MegatronOptimizer
-from megatron_llm_tpu.training import build_train_step
+
+def log(msg):
+    print(f"[bench +{time.time() - T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+# --------------------------------------------------------------------------
+# Child: the actual measurement (runs with jax, under parent's deadline)
+# --------------------------------------------------------------------------
 
 PEAK_FLOPS = {
-    # bf16 peak per chip
+    # bf16 peak per chip, keyed by device_kind substrings; spellings vary
+    # across libtpu versions (v5e reports "TPU v5 lite" or "TPU v5e")
     "TPU v5 lite": 197e12,
     "TPU v5e": 197e12,
     "TPU v5p": 459e12,
     "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
     "TPU v6e": 918e12,
 }
 A100_REFERENCE_MFU = 0.47  # BASELINE.md derivation
 
 
-def main():
-    dev = jax.devices()[0]
-    peak = next((v for k, v in PEAK_FLOPS.items() if k in dev.device_kind), 197e12)
-    on_tpu = jax.default_backend() in ("tpu", "axon") or "TPU" in dev.device_kind
+def child_main():
+    log("child: importing jax")
+    import jax  # noqa: E402
 
-    # ~350M-param llama (fits one 16GB chip with fp32 master + adam state)
-    cfg = llama_config(
-        "tiny",
-        num_layers=24, hidden_size=1024, num_attention_heads=16,
-        ffn_hidden_size=2816, padded_vocab_size=32000,
-        seq_length=2048, max_position_embeddings=2048,
-        params_dtype="bf16", compute_dtype="bf16",
-        recompute_granularity="selective",
-    )
-    micro_batch, num_micro = (8, 1) if on_tpu else (2, 1)
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # cache is an optimization, never fatal
+        log(f"child: compilation cache unavailable: {e}")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    log("child: initializing backend (first device query)")
+    dev = jax.devices()[0]
+    on_tpu = jax.default_backend() in ("tpu", "axon") or "TPU" in dev.device_kind
+    # peak FLOPs only meaningful on real TPU hardware; None elsewhere so the
+    # CPU fallback never fabricates an MFU / vs_baseline measurement
+    peak = next((v for k, v in PEAK_FLOPS.items() if k in dev.device_kind),
+                197e12 if on_tpu else None)
+    log(f"child: BENCH_INIT_OK backend={jax.default_backend()} "
+        f"device={dev.device_kind}")
+
+    from megatron_llm_tpu.config import ParallelConfig, TrainConfig
+    from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+    from megatron_llm_tpu.optimizer import MegatronOptimizer
+    from megatron_llm_tpu.training import build_train_step
+
+    if on_tpu:
+        # ~300M llama: big enough for meaningful MFU, small enough that
+        # compile + 1 step completes well inside the parent deadline.
+        cfg = llama_config(
+            "tiny",
+            num_layers=16, hidden_size=1280, num_attention_heads=16,
+            ffn_hidden_size=3584, padded_vocab_size=32000,
+            seq_length=2048, max_position_embeddings=2048,
+            params_dtype="bf16", compute_dtype="bf16",
+            recompute_granularity="selective",
+        )
+        micro_batch, num_micro = 8, 1
+        model_name = "llama-300M"
+    else:
+        cfg = llama_config(
+            "tiny",
+            num_layers=4, hidden_size=512, num_attention_heads=8,
+            ffn_hidden_size=1408, padded_vocab_size=32000,
+            seq_length=512, max_position_embeddings=512,
+            params_dtype="bf16", compute_dtype="bf16",
+            recompute_granularity="selective",
+        )
+        micro_batch, num_micro = 2, 1
+        model_name = "llama-tiny-cpu"
     seq = cfg.seq_length
 
+    log(f"child: building {model_name} (seq={seq}, mb={micro_batch})")
     model = LlamaModel(cfg)
     params = model.init(jax.random.PRNGKey(0))
     n_params = model.num_params(params)
+    log(f"child: {n_params/1e6:.1f}M params initialized")
 
     tc = TrainConfig(
         micro_batch_size=micro_batch, global_batch_size=micro_batch * num_micro,
@@ -76,36 +135,146 @@ def main():
     }
     key = jax.random.PRNGKey(1)
 
-    # compile + warmup
+    log("child: compiling train step (first call)")
+    tc0 = time.time()
     params, opt_state, m = step(params, opt_state, batch, key, 1e-4, 0.0)
     jax.block_until_ready(m["lm loss"])
+    log(f"child: compile+warmup done in {time.time() - tc0:.1f}s")
 
-    iters = 20 if on_tpu else 3
+    # Adaptive timing: run until ~20s of measurement or the iter cap,
+    # whichever first, so slow backends still finish inside the deadline.
+    max_iters = 30 if on_tpu else 3
+    budget_s = 20.0
+    iters = 0
     t0 = time.perf_counter()
-    for i in range(iters):
+    while iters < max_iters:
         params, opt_state, m = step(params, opt_state, batch, key, 1e-4, 0.0)
+        iters += 1
+        if iters % 5 == 0 or iters == max_iters:
+            jax.block_until_ready(m["lm loss"])
+            if time.perf_counter() - t0 > budget_s:
+                break
     jax.block_until_ready(m["lm loss"])
     dt = (time.perf_counter() - t0) / iters
+    log(f"child: timed {iters} iters, {dt*1000:.1f} ms/iter")
 
     tokens_per_iter = micro_batch * num_micro * seq
     tps = tokens_per_iter / dt
     flops_tok = model.flops_per_token()
-    mfu = tps * flops_tok / peak
+    mfu = tps * flops_tok / peak if peak else None
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(mfu / A100_REFERENCE_MFU, 4),
-        "mfu": round(mfu, 4),
-        "model": "llama-354M",
+        "vs_baseline": round(mfu / A100_REFERENCE_MFU, 4) if mfu else None,
+        "mfu": round(mfu, 4) if mfu else None,
+        "model": model_name,
         "n_params": int(n_params),
         "seq_length": seq,
         "micro_batch": micro_batch,
         "device": dev.device_kind,
+        "backend": jax.default_backend(),
         "ms_per_iter": round(dt * 1000, 2),
+        "iters": iters,
         "loss": float(m["lm loss"]),
-    }))
+    }), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Parent: deadline + fallback orchestration (no jax imported here)
+# --------------------------------------------------------------------------
+
+def run_child(force_cpu: bool, deadline_s: float, init_s: float):
+    """Run the measurement child; returns the JSON line or None.
+
+    Two kill conditions: a hard overall deadline, and an init timeout —
+    the child hasn't logged the BENCH_INIT_OK sentinel within
+    ``init_s`` — so a child wedged dialing the TPU tunnel (the round-1
+    failure mode, a blocked C call) is cut loose long before the overall
+    deadline, leaving time for the CPU fallback.  A healthy child that is
+    merely slow to *compile* is never killed before the hard deadline.
+    """
+    import threading
+
+    if force_cpu:
+        from __graft_entry__ import _forced_cpu_env
+
+        env = _forced_cpu_env(1)  # also sanitizes inherited XLA_FLAGS
+    else:
+        env = dict(os.environ)
+    env["_BENCH_CHILD"] = "1"
+    here = os.path.abspath(__file__)
+    log(f"parent: launching {'CPU' if force_cpu else 'default-backend'} child "
+        f"(deadline {deadline_s:.0f}s, init timeout {init_s:.0f}s)")
+    proc = subprocess.Popen(
+        [sys.executable, here], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    state = {"init_done": False, "out": []}
+
+    def pump_err(stream):
+        for line in stream:
+            if "BENCH_INIT_OK" in line:  # sentinel emitted by child_main
+                state["init_done"] = True
+            print(line, end="", file=sys.stderr, flush=True)
+
+    def pump_out(stream):
+        for line in stream:
+            state["out"].append(line)
+
+    t_err = threading.Thread(target=pump_err, args=(proc.stderr,), daemon=True)
+    t_out = threading.Thread(target=pump_out, args=(proc.stdout,), daemon=True)
+    t_err.start()
+    t_out.start()
+
+    start = time.time()
+    why = None
+    while proc.poll() is None:
+        now = time.time()
+        if now - start > deadline_s:
+            why = "deadline"
+            break
+        if not state["init_done"] and now - start > init_s:
+            why = f"backend init not done after {init_s:.0f}s"
+            break
+        time.sleep(1.0)
+    if why is not None:
+        log(f"parent: killing child: {why}")
+        proc.kill()
+    proc.wait()
+    t_err.join(timeout=5)
+    t_out.join(timeout=5)
+    if why is None and proc.returncode != 0:
+        log(f"parent: child exited rc={proc.returncode}")
+    for line in state["out"]:
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            return line
+    if why is None and proc.returncode == 0:
+        log("parent: child produced no JSON line")
+    return None
+
+
+def main():
+    attempts = []
+    if os.environ.get("BENCH_FORCE_CPU") != "1":
+        attempts.append({"force_cpu": False, "deadline_s": 330.0, "init_s": 180.0})
+    attempts.append({"force_cpu": True, "deadline_s": 120.0, "init_s": 60.0})
+
+    for i, a in enumerate(attempts):
+        line = run_child(**a)
+        if line is not None:
+            print(line, flush=True)
+            log("parent: done")
+            return 0
+        if i + 1 < len(attempts):
+            log("parent: falling back")
+    log("parent: all attempts failed")
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("_BENCH_CHILD") == "1":
+        child_main()
+    else:
+        sys.exit(main())
