@@ -203,7 +203,32 @@ def main():
             print(f" loaded checkpoint at iteration {start_iteration}")
     if params is None:
         params = model.init(jax.random.PRNGKey(args.seed))
+
+    # interleaved VPP trains with the layer stack in stage-major order;
+    # checkpoints stay in natural order (see pipeline.permute_layer_stack)
+    vpp = pc.virtual_pipeline_model_parallel_size or 1
+    from megatron_llm_tpu.parallel.pipeline import (
+        convert_opt_state_layout,
+        convert_params_layout,
+    )
+    params = convert_params_layout(
+        params, args.num_layers, pc.pipeline_model_parallel_size, vpp,
+        to_stage_major=True)
+    opt_state = convert_opt_state_layout(
+        opt_state, args.num_layers, pc.pipeline_model_parallel_size, vpp,
+        to_stage_major=True)
     params = sh.shard_params(params, model.param_specs(params))
+
+    def save_natural(save_dir, it_, params_, opt_state_):
+        checkpointing.save_checkpoint(
+            save_dir, it_,
+            convert_params_layout(
+                params_, args.num_layers, pc.pipeline_model_parallel_size,
+                vpp, to_stage_major=False),
+            convert_opt_state_layout(
+                opt_state_, args.num_layers, pc.pipeline_model_parallel_size,
+                vpp, to_stage_major=False),
+        )
 
     if args.fp16 or args.bf16:
         dt = jnp.float16 if args.fp16 else jnp.bfloat16
@@ -253,11 +278,10 @@ def main():
                                {k: float(v) for k, v in metrics.items()},
                                el, batch["tokens"].size, lr)
             if args.save and args.save_interval and it % args.save_interval == 0:
-                checkpointing.save_checkpoint(args.save, it, params, opt_state)
+                save_natural(args.save, it, params, opt_state)
             if handler and handler.signals_received():
                 if args.save:
-                    checkpointing.save_checkpoint(args.save, it, params,
-                                                  opt_state)
+                    save_natural(args.save, it, params, opt_state)
                 sys.exit(0)
     else:
         params, opt_state, it = pretrain(
@@ -274,7 +298,7 @@ def main():
         )
 
     if args.save:
-        checkpointing.save_checkpoint(args.save, it, params, opt_state)
+        save_natural(args.save, it, params, opt_state)
         print(f" saved final checkpoint at iteration {it}")
 
 

@@ -156,6 +156,28 @@ def language_model_param_specs(params, cfg: TransformerConfig):
     return specs
 
 
+@jax.custom_vjp
+def scatter_free_lookup(table: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Embedding lookup whose backward is a one-hot einsum instead of the
+    gather transpose (scatter-add).  XLA's scatter partitioner check-fails
+    under a manual submesh (used by the pipeline engines); the matmul
+    transpose partitions robustly and is head-matmul-sized."""
+    return jnp.take(table, tokens, axis=0)
+
+
+def _sfl_fwd(table, tokens):
+    return jnp.take(table, tokens, axis=0), (table.shape[0], tokens)
+
+
+def _sfl_bwd(res, g):
+    vocab, tokens = res
+    one_hot = jax.nn.one_hot(tokens, vocab, dtype=g.dtype)
+    return jnp.einsum("...v,...h->vh", one_hot, g), None
+
+
+scatter_free_lookup.defvjp(_sfl_fwd, _sfl_bwd)
+
+
 def embedding_forward(
     tokens: jax.Array,
     position_ids: Optional[jax.Array],
@@ -165,13 +187,24 @@ def embedding_forward(
     tokentype_ids: Optional[jax.Array] = None,
     rng_key=None,
     train: bool = False,
+    scatter_free: bool = False,
 ) -> jax.Array:
     """Word (+position, +tokentype) embedding with dropout; under sequence
     parallelism the output is scattered along the sequence axis
-    (reference: language_model.py:230-262)."""
-    h = vocab_parallel_embedding(
-        tokens, params["word"], compute_dtype=cfg.compute_jnp_dtype
-    )
+    (reference: language_model.py:230-262).  ``scatter_free`` swaps the
+    word-lookup backward for the one-hot einsum (pipeline engines)."""
+    if scatter_free:
+        h = constrain(
+            scatter_free_lookup(
+                params["word"]["embedding"].astype(cfg.compute_jnp_dtype),
+                tokens,
+            ),
+            "batch", "seq", None,
+        )
+    else:
+        h = vocab_parallel_embedding(
+            tokens, params["word"], compute_dtype=cfg.compute_jnp_dtype
+        )
     if "position" in params:
         if position_ids is None:
             position_ids = jnp.arange(tokens.shape[1])[None, :]

@@ -51,9 +51,15 @@ def vocab_parallel_cross_entropy(
     shifted = logits - jax.lax.stop_gradient(logits_max)
     sum_exp = jnp.sum(jnp.exp(shifted), axis=-1)           # -> allreduce(SUM)
     log_z = jnp.log(sum_exp)
-    target_logit = jnp.take_along_axis(
-        shifted, labels[..., None].astype(jnp.int32), axis=-1
-    )[..., 0]
+    # target pick as a one-hot-masked reduction rather than a gather: this is
+    # the reference's masked-select + allreduce(SUM) (cross_entropy.py:28-55),
+    # partitions trivially when the vocab axis is sharded (XLA's gather
+    # partitioner check-fails on take_along_axis under a manual submesh),
+    # and XLA fuses the iota+select so no [.., vocab] one-hot materializes.
+    iota = jax.lax.broadcasted_iota(jnp.int32, shifted.shape,
+                                    shifted.ndim - 1)
+    one_hot = iota == labels[..., None].astype(jnp.int32)
+    target_logit = jnp.sum(jnp.where(one_hot, shifted, 0.0), axis=-1)
     loss = log_z - target_logit
     if label_smoothing > 0.0:
         # reference: cross_entropy.py:87-109 — smooth against the uniform
