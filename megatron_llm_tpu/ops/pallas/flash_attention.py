@@ -13,7 +13,10 @@ Kernel structure (standard online-softmax tiling):
   sequential on TPU, so fp32 scratch (m, l, acc) carries across k blocks;
   fully-masked blocks (beyond causal diagonal / outside sliding window)
   are skipped with ``pl.when``.  Emits O and the per-row logsumexp L for
-  the backward pass.
+  the backward pass.  Per-row stats (L, delta) live in lane-broadcast
+  ``[..., s, LANES]`` fp32 arrays so every BlockSpec keeps a Mosaic-legal
+  (8, 128) trailing tile — a ``(1, 1, bq)`` row-vector out-spec does NOT
+  lower on TPU (sublane block 1 over the head axis violates tiling).
 * backward: two kernels — dQ (grid over q blocks, k innermost) and
   dK/dV (grid over k blocks, q innermost), both using the saved L and the
   delta = rowsum(dO * O) trick, computing p = exp(s - L) without
@@ -39,9 +42,18 @@ from jax.experimental.pallas import tpu as pltpu
 from megatron_llm_tpu.ops.softmax import causal_mask, sliding_window_mask
 
 _INTERPRET = False
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Measured on TPU v5e (round 3, llama-400M, seq 2048, bf16): 128x128 blocks
+# give 0.17 MFU, 512x512 0.37, 1024x1024 0.39 — the (qi, ki) grid overhead
+# and per-block DMA dominate at small tiles.  1024 blocks fit VMEM at
+# d=128 (4 MB fp32 score tile) and are clamped to the sequence length for
+# short inputs; 2048 tiles fail to compile (scoped-vmem OOM).
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
+# trailing lane width for per-row stats (LSE, delta): Mosaic requires the
+# minor-most block dim to be a multiple of 128 (or the full array dim), so
+# row stats are stored value-broadcast across a 128-lane axis.
+LANES = 128
 
 
 def _use_pallas() -> bool:
@@ -137,11 +149,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(ki == nk - 1)
     def _finish():
-        l = l_scr[:]
+        l = l_scr[:]                                  # [bq, 1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse = m_scr[:] + jnp.log(l_safe)
-        lse_ref[0, 0] = jnp.where(l[:, 0] == 0.0, NEG_INF, lse[:, 0])
+        lse = jnp.where(l == 0.0, NEG_INF, m_scr[:] + jnp.log(l_safe))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, (lse.shape[0], LANES))
 
 
 def _fwd_call(q, k, v, *, scale, causal, window, block_q, block_k):
@@ -174,12 +186,13 @@ def _fwd_call(q, k, v, *, scale, causal, window, block_q, block_k):
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bb, h, qi, ki: (bb, h, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq), lambda bb, h, qi, ki: (bb, h, qi),
+            pl.BlockSpec((1, 1, bq, LANES),
+                         lambda bb, h, qi, ki: (bb, h, qi, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, nh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, nh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, nh, sq, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -225,8 +238,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = jnp.where(k_row_valid, k_ref[0, 0].astype(jnp.float32), 0.0)
         v = jnp.where(k_row_valid, v_ref[0, 0].astype(jnp.float32), 0.0)
         do = jnp.where(q_row_valid, do_ref[0, 0].astype(jnp.float32), 0.0)
-        lse = jnp.where(q_row_valid, lse_ref[0, 0][:, None], 0.0)
-        delta = jnp.where(q_row_valid, delta_ref[0, 0][:, None], 0.0)
+        # stats arrive lane-broadcast [bq, LANES]; any lane reduction
+        # recovers the row value (max also tolerates padded-row garbage)
+        lse = jnp.where(q_row_valid,
+                        jnp.max(lse_ref[0, 0], axis=-1, keepdims=True), 0.0)
+        delta = jnp.where(q_row_valid,
+                          jnp.max(delta_ref[0, 0], axis=-1, keepdims=True),
+                          0.0)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -284,8 +302,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = jnp.where(k_row_valid, k_ref[0, 0].astype(jnp.float32), 0.0)
         v = jnp.where(k_row_valid, v_ref[0, 0].astype(jnp.float32), 0.0)
         do = jnp.where(q_row_valid, do_ref[0, 0].astype(jnp.float32), 0.0)
-        lse = jnp.where(q_row_valid, lse_ref[0, 0][:, None], 0.0)
-        delta = jnp.where(q_row_valid, delta_ref[0, 0][:, None], 0.0)
+        # stats arrive lane-broadcast [bq, LANES]; any lane reduction
+        # recovers the row value (max also tolerates padded-row garbage)
+        lse = jnp.where(q_row_valid,
+                        jnp.max(lse_ref[0, 0], axis=-1, keepdims=True), 0.0)
+        delta = jnp.where(q_row_valid,
+                          jnp.max(delta_ref[0, 0], axis=-1, keepdims=True),
+                          0.0)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -328,6 +351,7 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, window,
     nk = pl.cdiv(sk, bk)
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
     kw = dict(scale=scale, block_q=bq, block_k=bk, causal=causal,
               window=window, kv_len=sk, q_len=sq)
@@ -346,9 +370,11 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, window,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, bq, d), lambda bb, h, qi, ki: (bb, h, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq), lambda bb, h, qi, ki: (bb, h, qi),
+            pl.BlockSpec((1, 1, bq, LANES),
+                         lambda bb, h, qi, ki: (bb, h, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq), lambda bb, h, qi, ki: (bb, h, qi),
+            pl.BlockSpec((1, 1, bq, LANES),
+                         lambda bb, h, qi, ki: (bb, h, qi, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, d),
@@ -374,9 +400,11 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, window,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, bq, d), lambda bb, h, ki, qi: (bb, h, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq), lambda bb, h, ki, qi: (bb, h, qi),
+            pl.BlockSpec((1, 1, bq, LANES),
+                         lambda bb, h, ki, qi: (bb, h, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bq), lambda bb, h, ki, qi: (bb, h, qi),
+            pl.BlockSpec((1, 1, bq, LANES),
+                         lambda bb, h, ki, qi: (bb, h, qi, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -445,10 +473,18 @@ def flash_attention(
     causal: bool = True,
     sliding_window: Optional[int] = None,
     softmax_scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jax.Array:
-    """q: [b, s, nh, d]; k, v: [b, s, ng, d] (GQA when ng < nh)."""
+    """q: [b, s, nh, d]; k, v: [b, s, ng, d] (GQA when ng < nh).
+
+    block_q/block_k default to the module-level DEFAULT_BLOCK_Q/K *at call
+    time* so benchmarks and configs can retune them without re-importing.
+    """
+    if block_q is None:
+        block_q = DEFAULT_BLOCK_Q
+    if block_k is None:
+        block_k = DEFAULT_BLOCK_K
     if softmax_scale is None:
         softmax_scale = 1.0 / math.sqrt(q.shape[-1])
     if not _use_pallas():

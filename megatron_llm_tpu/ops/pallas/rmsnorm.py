@@ -33,31 +33,56 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu" or _INTERPRET
 
 
+def _pick_rows(n: int, h: int, itemsize: int) -> int:
+    """Row-block height: <=1 MiB per (rows, h) block so the handful of
+    double-buffered VMEM blocks (x, g, dx...) stay inside the ~16 MiB
+    scoped-vmem budget at any hidden size; multiple of 8 sublanes."""
+    budget = 1 << 20
+    rows = max(8, min(_BLOCK_ROWS, budget // max(1, h * itemsize) // 8 * 8))
+    return min(rows, max(8, n))
+
+
 def _fwd_kernel(x_ref, s_ref, y_ref, rstd_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)
     rstd = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
     y = x * rstd * s_ref[:].astype(jnp.float32)
     y_ref[:] = y.astype(y_ref.dtype)
-    rstd_ref[:] = rstd[:, 0]
+    rstd_ref[:] = rstd                      # [rows, 1]
 
 
-def _bwd_kernel(x_ref, s_ref, g_ref, rstd_ref, dx_ref, ds_ref, *, eps):
-    x = x_ref[:].astype(jnp.float32)
-    g = g_ref[:].astype(jnp.float32)
-    s = s_ref[:].astype(jnp.float32)
-    rstd = rstd_ref[:][:, None]
+def _bwd_kernel(x_ref, s_ref, g_ref, rstd_ref, dx_ref, ds_ref, ds_scr,
+                *, eps, n, rows):
+    i = pl.program_id(0)
+    nblocks = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        ds_scr[:] = jnp.zeros_like(ds_scr)
+
+    # mask padded rows of the final block (block padding is undefined
+    # memory; it must not leak into the cross-row dscale reduction)
+    row_valid = (i * rows + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, 1), 0)) < n
+    x = jnp.where(row_valid, x_ref[:].astype(jnp.float32), 0.0)
+    g = jnp.where(row_valid, g_ref[:].astype(jnp.float32), 0.0)
+    s = s_ref[:].astype(jnp.float32)        # [1, h]
+    rstd = jnp.where(row_valid, rstd_ref[:], 0.0)  # [rows, 1]
     gs = g * s
     h = x.shape[-1]
     m = jnp.sum(gs * x, axis=-1, keepdims=True) / h
     dx = rstd * (gs - x * (rstd * rstd) * m)
     dx_ref[:] = dx.astype(dx_ref.dtype)
-    # partial dscale for this row block; reduced over blocks by the caller
-    ds_ref[:] = jnp.sum(g * x * rstd, axis=0)[None, :]
+    # dscale accumulates across the (sequential) TPU grid in VMEM scratch
+    ds_scr[:] += jnp.sum(g * x * rstd, axis=0, keepdims=True)
+
+    @pl.when(i == nblocks - 1)
+    def _finish():
+        ds_ref[:] = ds_scr[:]
 
 
 def _fwd_call(x2d, scale, eps):
     n, h = x2d.shape
-    rows = min(_BLOCK_ROWS, n)
+    rows = _pick_rows(n, h, x2d.dtype.itemsize)
     grid = (pl.cdiv(n, rows),)
     y, rstd = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
@@ -65,49 +90,52 @@ def _fwd_call(x2d, scale, eps):
         in_specs=[
             pl.BlockSpec((rows, h), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((rows, h), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((rows,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, h), x2d.dtype),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
         ],
         interpret=_INTERPRET,
-    )(x2d, scale)
+    )(x2d, scale.reshape(1, h))
     return y, rstd
 
 
 def _bwd_call(x2d, scale, g2d, rstd, eps):
     n, h = x2d.shape
-    rows = min(_BLOCK_ROWS, n)
+    rows = _pick_rows(n, h, x2d.dtype.itemsize)
     nblocks = pl.cdiv(n, rows)
-    dx, ds_part = pl.pallas_call(
-        functools.partial(_bwd_kernel, eps=eps),
+    dx, ds = pl.pallas_call(
+        functools.partial(_bwd_kernel, eps=eps, n=n, rows=rows),
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((rows, h), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((h,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((rows, h), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((rows,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((rows, h), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, h), x2d.dtype),
-            jax.ShapeDtypeStruct((nblocks, h), jnp.float32),
+            jax.ShapeDtypeStruct((1, h), jnp.float32),
         ],
+        scratch_shapes=[pltpu.VMEM((1, h), jnp.float32)],
         interpret=_INTERPRET,
-    )(x2d, scale, g2d, rstd)
-    return dx, jnp.sum(ds_part, axis=0)
+    )(x2d, scale.reshape(1, h), g2d, rstd)
+    return dx, ds[0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

@@ -9,8 +9,24 @@ hardware.
 
 import os
 
-# Must happen before jax initializes its backends.
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# Must happen before jax initializes its backends.  The collective-call
+# rendezvous timeouts default to 20s/40s; on a loaded or few-core CI box
+# the 8 virtual device threads can legitimately take longer to converge
+# (compilation runs on the same cores), and the default *aborts the
+# process*.  Raise them — slow is fine, SIGABRT mid-suite is not.
+_WANTED_FLAGS = (
+    "--xla_force_host_platform_device_count=8 "
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120 "
+    "--xla_cpu_collective_call_terminate_timeout_seconds=1200"
+)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    _flags = (_flags + " " + _WANTED_FLAGS).strip()
+elif "collective_call_terminate_timeout" not in _flags:
+    _flags = (_flags + " "
+              + "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120 "
+              + "--xla_cpu_collective_call_terminate_timeout_seconds=1200")
+os.environ["XLA_FLAGS"] = _flags
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402

@@ -1,0 +1,106 @@
+"""On-chip MFU sweep harness (the tool behind docs/perf_tpu.md).
+
+Usage: python tools/mfu_sweep.py <group>   (groups defined at the bottom)
+Each trial builds a fresh llama-family model + fused-Adam train step,
+runs 2 warmup + 5 timed iterations and prints ms/iter, tokens/s and MFU.
+Timing syncs use a host-side scalar fetch, NOT block_until_ready — on the
+axon remote platform the latter can return before the first enqueued
+execution finishes (docs/perf_tpu.md "measurement traps").
+"""
+
+import os
+import sys
+import time
+
+import jax, jax.numpy as jnp, numpy as np
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from megatron_llm_tpu.config import ParallelConfig, TrainConfig
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+from megatron_llm_tpu.optimizer import MegatronOptimizer
+from megatron_llm_tpu.training import build_train_step
+
+PEAK = 197e12
+
+def bench_cfg(label, mb=8, remat="selective", flash=True, fused_rms=True,
+              L=16, h=1280, ffn=3584, heads=16, seq=2048, iters=5, bq=None, bk=None):
+    import megatron_llm_tpu.ops.pallas.flash_attention as fa
+    orig_bq, orig_bk = fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K
+    if bq: fa.DEFAULT_BLOCK_Q = bq
+    if bk: fa.DEFAULT_BLOCK_K = bk
+    cfg = llama_config("tiny", num_layers=L, hidden_size=h, num_attention_heads=heads,
+        ffn_hidden_size=ffn, padded_vocab_size=32000, seq_length=seq,
+        max_position_embeddings=seq, params_dtype="bf16", compute_dtype="bf16",
+        recompute_granularity=remat, use_flash_attn=flash, use_fused_rmsnorm=fused_rms)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    n = model.num_params(params)
+    tc = TrainConfig(micro_batch_size=mb, global_batch_size=mb, train_iters=0, lr=1e-4,
+                     optimizer="adam", bf16=True, clip_grad=1.0)
+    opt = MegatronOptimizer(tc, params_dtype=jnp.bfloat16)
+    opt_state = opt.init(params)
+    step = build_train_step(model, opt, ParallelConfig(), 1)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 32000, (1, mb, seq)))
+    batch = {"tokens": toks, "labels": jnp.roll(toks, -1, -1),
+             "loss_mask": jnp.ones_like(toks, jnp.float32)}
+    key = jax.random.PRNGKey(1)
+    try:
+        for _ in range(2):
+            params, opt_state, m = step(params, opt_state, batch, key, 1e-4, 0.0)
+            float(m["lm loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, m = step(params, opt_state, batch, key, 1e-4, 0.0)
+        float(m["lm loss"])
+        dt = (time.perf_counter() - t0) / iters
+        tps = mb * seq / dt
+        mfu = tps * model.flops_per_token() / PEAK
+        print(f"{label:44s} n={n/1e6:6.1f}M dt={dt*1000:8.1f}ms tps={tps:9.1f} mfu={mfu:.3f}", flush=True)
+    except Exception as e:
+        print(f"{label:44s} FAILED: {type(e).__name__}: {str(e)[:120]}", flush=True)
+    fa.DEFAULT_BLOCK_Q = orig_bq
+    fa.DEFAULT_BLOCK_K = orig_bk
+
+GROUPS = {
+    "baseline": [
+        dict(label="flash defaults mb4", mb=4),
+        dict(label="flash defaults mb8", mb=8),
+        dict(label="xla attention mb4", mb=4, flash=False),
+    ],
+    "blocks": [
+        dict(label="flash bq128 bk128", bq=128, bk=128),
+        dict(label="flash bq256 bk256", bq=256, bk=256),
+        dict(label="flash bq512 bk512", bq=512, bk=512),
+        dict(label="flash bq1024 bk1024", bq=1024, bk=1024),
+    ],
+    "mb": [
+        dict(label="flash mb2", mb=2),
+        dict(label="flash mb4", mb=4),
+        dict(label="flash mb8", mb=8),
+        dict(label="flash mb16", mb=16),
+    ],
+    "remat": [
+        dict(label="selective", remat="selective", mb=4),
+        dict(label="full", remat="full", mb=4),
+        dict(label="none", remat="none", mb=4),
+    ],
+    "long": [
+        dict(label="seq4096 mb4 flash", seq=4096, mb=4),
+        dict(label="seq8192 mb2 flash", seq=8192, mb=2),
+        dict(label="seq4096 mb4 xla", seq=4096, mb=4, flash=False),
+    ],
+}
+GROUPS["all"] = GROUPS["baseline"] + GROUPS["blocks"]
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which not in GROUPS:
+        print(f"unknown group {which!r}; available: {', '.join(GROUPS)}")
+        sys.exit(1)
+    for trial in GROUPS[which]:
+        bench_cfg(**trial)
