@@ -21,18 +21,43 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
+def _needs_build(src: str) -> bool:
+    stale = (os.path.exists(_SO) and os.path.exists(src)
+             and os.path.getmtime(src) > os.path.getmtime(_SO))
+    return not os.path.exists(_SO) or stale
+
+
+def _build(src: str) -> None:
+    """Rebuild libhelpers.so safely under concurrency: an exclusive file
+    lock serializes builders across processes, and the compile goes to a
+    temp name + atomic os.replace so a concurrent loader can never dlopen
+    a partially written .so."""
+    import fcntl
+
+    with open(os.path.join(_HERE, ".helpers.build.lock"), "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        if not _needs_build(src):   # another process built it while we waited
+            return
+        tmp = f"{_SO}.tmp.{os.getpid()}"
+        try:
+            subprocess.run(
+                ["make", "-C", _HERE, "-B", f"SO={os.path.basename(tmp)}"],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _SO)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _LIB, _TRIED
     if _LIB is not None or _TRIED:
         return _LIB
     _TRIED = True
     src = os.path.join(_HERE, "helpers.cpp")
-    stale = (os.path.exists(_SO) and os.path.exists(src)
-             and os.path.getmtime(src) > os.path.getmtime(_SO))
-    if not os.path.exists(_SO) or stale:
+    if _needs_build(src):
         try:
-            subprocess.run(["make", "-C", _HERE, "-B"], check=True,
-                           capture_output=True, timeout=120)
+            _build(src)
         except Exception:
             if not os.path.exists(_SO):
                 return None

@@ -160,6 +160,12 @@ class _BertWordPieceTokenizer(AbstractTokenizer):
 
         self._tok = BertTokenizerFast(vocab_file=vocab_file,
                                       do_lower_case=lower_case)
+        # dedicated [BOS]/[EOS] tokens, matching the reference's
+        # _BertWordPieceTokenizer (tokenizer.py:156-200: add_token('[BOS]'),
+        # add_token('[EOS]')) — bos/eos must NOT collide with CLS/SEP/eod,
+        # or T5 decoder-start tokens alias segment separators
+        self._tok.add_special_tokens(
+            {"bos_token": "[BOS]", "eos_token": "[EOS]"})
         if vocab_extra_ids > 0:
             # T5-style span sentinels (reference: tokenizer.py:123+ adds
             # <extra_id_N> when --vocab_extra_ids is set)
@@ -206,6 +212,14 @@ class _BertWordPieceTokenizer(AbstractTokenizer):
     @property
     def eod(self):
         return self._tok.sep_token_id
+
+    @property
+    def bos_token_id(self):
+        return self._tok.bos_token_id
+
+    @property
+    def eos_token_id(self):
+        return self._tok.eos_token_id
 
 
 class _SentencePieceTokenizer(AbstractTokenizer):

@@ -124,7 +124,6 @@ def finetune(args, model, train_dataset, valid_dataset,
 
     tc = train_config_from_args(args)
     pc = parallel_config_from_args(args)
-    optimizer = MegatronOptimizer(tc)
 
     params = model.init(jax.random.PRNGKey(args.seed))
     if getattr(args, "pretrained_checkpoint", None):
@@ -133,6 +132,11 @@ def finetune(args, model, train_dataset, valid_dataset,
     if args.fp16 or args.bf16:
         dt = jnp.float16 if args.fp16 else jnp.bfloat16
         params = jax.tree_util.tree_map(lambda p: p.astype(dt), params)
+    # build the optimizer from the *post-cast* leaf dtype (matching
+    # training.py) so half-precision params get fp32 master weights and
+    # fp32 Adam state instead of silently updating in fp16/bf16
+    optimizer = MegatronOptimizer(
+        tc, params_dtype=jax.tree_util.tree_leaves(params)[0].dtype)
     opt_state = optimizer.init(params)
 
     step_fn = build_train_step(model, optimizer, pc, num_microbatches=1)

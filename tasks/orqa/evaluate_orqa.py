@@ -11,6 +11,7 @@ Input file: jsonl or TSV with fields question / answers (list).
 
 from __future__ import annotations
 
+import ast
 import json
 import re
 
@@ -43,9 +44,15 @@ def load_qa_pairs(path):
             else:
                 q, ans = line.split("\t", 1)
                 try:
-                    answers = eval(ans, {"__builtins__": {}})  # DPR-style
+                    # DPR-style python-literal answer list; literal_eval
+                    # cannot execute expressions from the data file (it can
+                    # still raise TypeError/RecursionError/MemoryError on
+                    # hostile input — any failure means "plain string")
+                    answers = ast.literal_eval(ans)
                 except Exception:
                     answers = [ans]
+                if not isinstance(answers, (list, tuple)):
+                    answers = [str(answers)]
             pairs.append((q, list(answers)))
     return pairs
 

@@ -80,6 +80,16 @@ class LambadaDataset:
     def __getitem__(self, idx):
         toks = list(self.tokens[idx])
         labels = list(self.labels[idx])
+        # left-truncate over-long rows so every row is exactly seq_len+1
+        # wide: a single long passage must not produce a ragged batch
+        # (np.stack raise) or a shape-mismatched jit input.  Degenerate
+        # case first: a label longer than the whole window keeps only its
+        # own tail.
+        if len(labels) > self.seq_len + 1:
+            labels = labels[-(self.seq_len + 1):]
+        keep = self.seq_len + 1 - len(labels)
+        if len(toks) > keep:
+            toks = toks[len(toks) - keep:]
         pad_mask = [0] * len(toks) + [1] * len(labels)
         toks = toks + labels
         if len(toks) < self.seq_len + 1:
