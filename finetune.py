@@ -261,7 +261,7 @@ def main():
         sched.num_steps = start_iteration
         import time
         it = start_iteration
-        last = time.perf_counter()
+        last = last0 = time.perf_counter()
         while it < tc.train_iters:
             batch = next(train_iter)
             lr, wd = sched.step(1)
@@ -283,6 +283,18 @@ def main():
                 if args.save:
                     save_natural(args.save, it, params, opt_state)
                 sys.exit(0)
+            # exit flags (reference training.py:746-767), pipelined branch
+            if args.exit_interval and it % args.exit_interval == 0:
+                if args.save:
+                    save_natural(args.save, it, params, opt_state)
+                print(f" exiting program at iteration {it}", flush=True)
+                sys.exit(0)
+            if args.exit_duration_in_mins and \
+                    (time.perf_counter() - last0) / 60.0 > args.exit_duration_in_mins:
+                if args.save:
+                    save_natural(args.save, it, params, opt_state)
+                print(" exiting program on duration limit", flush=True)
+                sys.exit(0)
     else:
         params, opt_state, it = pretrain(
             model, params, tc, pc, train_iter,
@@ -295,6 +307,10 @@ def main():
             exit_signal_handler=handler,
             start_iteration=start_iteration,
             opt_state=opt_state,
+            skip_iters=getattr(args, "skip_iters", ()) or (),
+            exit_interval=getattr(args, "exit_interval", None),
+            exit_duration_in_mins=getattr(args, "exit_duration_in_mins",
+                                          None),
         )
 
     if args.save:

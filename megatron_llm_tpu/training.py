@@ -198,14 +198,38 @@ def pretrain(
     start_iteration: int = 0,
     opt_state=None,
     on_metrics=None,
+    timers=None,
+    skip_iters=(),
+    exit_interval: Optional[int] = None,
+    exit_duration_in_mins: Optional[float] = None,
 ):
     """Minimal-dependency pretrain loop (the full CLI driver lives in
     ``finetune.py`` / ``pretrain_gpt.py`` at the repo root).
 
     ``batch_iterator`` yields batch dicts shaped
     [num_micro, global_batch, seq] (see build_train_step).
+
+    Behavioral flags (reference ``training.py:397-399,731-767``):
+      * ``skip_iters`` — iteration numbers that run forward-only (loss is
+        still computed/logged, no parameter update).
+      * ``exit_interval`` — save + exit when iteration %% interval == 0.
+      * ``exit_duration_in_mins`` — save + exit once the loop has run
+        this long.
+
+    Timers (reference ``training.py:500-525``): phases that exist under
+    the fused-jit TPU design are timed — ``batch-generator``,
+    ``train-step`` (async dispatch), ``train-step-sync`` (device wait at
+    the log boundary; dispatch+sync ~ the reference's forward-backward +
+    optimizer total), ``save-checkpoint``, ``eval-time``.  Finer split
+    timers (forward vs backward vs optimizer) do not exist because one
+    XLA program runs all three fused — that is the point of the design.
     """
     from megatron_llm_tpu import checkpointing
+    from megatron_llm_tpu.timers import Timers
+
+    if timers is None:
+        timers = Timers(log_level=2)
+    skip_iters = frozenset(skip_iters or ())
 
     num_micro = max(
         train_cfg.global_batch_size
@@ -246,20 +270,50 @@ def pretrain(
     counters = get_counters()
     iteration = start_iteration
     last_time = time.perf_counter()
+    train_start = time.perf_counter()
+    skip_step = None  # forward-only step, compiled lazily on first skip
+
+    def _save(it):
+        timers("save-checkpoint", log_level=0).start()
+        checkpointing.save_checkpoint(
+            save_dir, it, params, opt_state, scheduler,
+            consumed_samples=counters.get("samples", 0),
+        )
+        timers("save-checkpoint").stop()
 
     while iteration < train_cfg.train_iters:
+        timers("batch-generator", log_level=1).start()
         batch = next(batch_iterator)
+        timers("batch-generator").stop()
         lr, wd = scheduler.step(1)
         step_key = jax.random.fold_in(base_key, iteration)
-        params, opt_state, metrics = train_step(
-            params, opt_state, batch, step_key, lr, wd
-        )
+        if (iteration + 1) in skip_iters:
+            # reference training.py:397-399: forward-only, no update
+            print(" IMPORTANT! skipping backprop for this iteration!",
+                  flush=True)
+            if skip_step is None:
+                # eval_step is the same forward-only program; reuse its
+                # compilation when available
+                skip_step = eval_step or build_train_step(
+                    model, optimizer, parallel_cfg, num_micro, loss_func,
+                    forward_only=True)
+            metrics = dict(metrics) if iteration > start_iteration else {}
+            metrics["lm loss"] = skip_step(params, batch, step_key)
+            metrics["skipped_iter"] = 1
+        else:
+            timers("train-step", log_level=1).start()
+            params, opt_state, metrics = train_step(
+                params, opt_state, batch, step_key, lr, wd
+            )
+            timers("train-step").stop()
         iteration += 1
         tokens = batch["tokens"].size
         counters["tokens"] += tokens
 
         if log_interval and iteration % log_interval == 0:
+            timers("train-step-sync", log_level=1).start()
             jax.block_until_ready(metrics["lm loss"])
+            timers("train-step-sync").stop()
             now = time.perf_counter()
             elapsed = (now - last_time) / log_interval
             last_time = now
@@ -268,30 +322,46 @@ def pretrain(
                 {k: float(v) for k, v in metrics.items()},
                 elapsed, tokens, lr,
             )
+            timers.log(normalizer=log_interval)
             if on_metrics is not None:
                 on_metrics(iteration, metrics)
 
         if eval_step is not None and eval_interval and iteration % eval_interval == 0:
+            timers("eval-time", log_level=0).start()
             losses = []
             for _ in range(eval_iters):
                 eval_batch = next(eval_iterator)
                 losses.append(float(eval_step(params, eval_batch, None)))
+            timers("eval-time").stop()
             print(f" validation loss at iteration {iteration}: "
                   f"{sum(losses) / len(losses):.6E}")
 
+        saved = False
         if save_interval and save_dir and iteration % save_interval == 0:
-            checkpointing.save_checkpoint(
-                save_dir, iteration, params, opt_state, scheduler,
-                consumed_samples=counters.get("samples", 0),
-            )
+            _save(iteration)
+            saved = True
 
         if exit_signal_handler is not None and exit_signal_handler.signals_received():
             print("exiting on termination signal: saving checkpoint")
-            if save_dir:
-                checkpointing.save_checkpoint(
-                    save_dir, iteration, params, opt_state, scheduler,
-                    consumed_samples=counters.get("samples", 0),
-                )
+            if save_dir and not saved:
+                _save(iteration)
+            sys.exit(0)
+
+        # exit based on duration (reference training.py:746-758)
+        if exit_duration_in_mins:
+            train_mins = (time.perf_counter() - train_start) / 60.0
+            if train_mins > exit_duration_in_mins:
+                if save_dir and not saved:
+                    _save(iteration)
+                print(f" exiting program after {train_mins:.1f} minutes",
+                      flush=True)
+                sys.exit(0)
+
+        # exit based on iterations (reference training.py:761-767)
+        if exit_interval and iteration % exit_interval == 0:
+            if save_dir and not saved:
+                _save(iteration)
+            print(f" exiting program at iteration {iteration}", flush=True)
             sys.exit(0)
 
     return params, opt_state, iteration

@@ -1,0 +1,113 @@
+"""Behavioral train-loop flags + timers (reference training.py:397-399,
+500-525, 731-767): skip_iters runs forward-only, exit_interval /
+exit_duration_in_mins save + exit, per-phase timers accumulate."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from megatron_llm_tpu.config import ParallelConfig, TrainConfig
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+from megatron_llm_tpu.timers import Timers
+from megatron_llm_tpu.training import pretrain
+
+
+def _setup(utils):
+    cfg = llama_config("tiny", seq_length=16, max_position_embeddings=16,
+                       padded_vocab_size=64, num_layers=1, hidden_size=32,
+                       num_attention_heads=4, ffn_hidden_size=64)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    utils.initialize_model_parallel(tp=1)
+    rng = np.random.RandomState(0)
+    fixed = jnp.asarray(rng.randint(0, 64, size=(1, 8, 16)))
+
+    def it():
+        while True:
+            yield {
+                "tokens": fixed,
+                "labels": jnp.roll(fixed, -1, axis=-1),
+                "loss_mask": jnp.ones_like(fixed, jnp.float32),
+            }
+
+    return model, params, it
+
+
+def _tc(iters):
+    return TrainConfig(micro_batch_size=8, global_batch_size=8,
+                       train_iters=iters, lr=1e-2, optimizer="adam", seed=3)
+
+
+def _flat(params):
+    return np.concatenate([np.asarray(l).ravel()
+                           for l in jax.tree_util.tree_leaves(params)])
+
+
+def test_skip_iters_runs_forward_only(utils):
+    model, params, it = _setup(utils)
+    pc = ParallelConfig()
+    base = _flat(params)  # snapshot: train_step donates param buffers
+
+    # every iteration skipped -> parameters must be bit-identical
+    p_skip, _, n = pretrain(model, params, _tc(3), pc, it(),
+                            log_interval=0, skip_iters=[1, 2, 3])
+    assert n == 3
+    np.testing.assert_array_equal(_flat(p_skip), base)
+
+    # partial skip still trains on the non-skipped iterations
+    p_part, _, _ = pretrain(model, p_skip, _tc(3), pc, it(),
+                            log_interval=0, skip_iters=[2])
+    assert not np.array_equal(_flat(p_part), base)
+
+
+def test_exit_interval_saves_and_exits(utils, tmp_path):
+    model, params, it = _setup(utils)
+    pc = ParallelConfig()
+    with pytest.raises(SystemExit):
+        pretrain(model, params, _tc(10), pc, it(), log_interval=0,
+                 save_dir=str(tmp_path), exit_interval=2)
+    # exited at iteration 2, with a checkpoint written there
+    assert (tmp_path / "iter_0000002").exists()
+    assert not (tmp_path / "iter_0000003").exists()
+
+
+def test_exit_duration_saves_and_exits(utils, tmp_path, monkeypatch):
+    import megatron_llm_tpu.training as T
+
+    model, params, it = _setup(utils)
+    pc = ParallelConfig()
+    # fake clock: every perf_counter() call advances one minute, so the
+    # duration budget (5 min) trips after a handful of iterations
+    t = {"now": 0.0}
+
+    def fake_clock():
+        t["now"] += 60.0
+        return t["now"]
+
+    monkeypatch.setattr(T.time, "perf_counter", fake_clock)
+    with pytest.raises(SystemExit):
+        pretrain(model, params, _tc(1000), pc, it(), log_interval=0,
+                 save_dir=str(tmp_path), exit_duration_in_mins=5)
+    saved = sorted(p.name for p in tmp_path.glob("iter_*"))
+    assert len(saved) == 1  # saved exactly once, on exit
+
+
+def test_timers_accumulate_phases(utils):
+    model, params, it = _setup(utils)
+    pc = ParallelConfig()
+    timers = Timers(log_level=2)
+    pretrain(model, params, _tc(2), pc, it(), log_interval=0, timers=timers)
+    elapsed = timers.get_elapsed(reset=False)
+    assert elapsed.get("batch-generator", 0) > 0
+    assert elapsed.get("train-step", 0) > 0
+    assert timers("train-step").count == 2
+
+
+def test_timers_logged_at_log_interval(utils, capsys):
+    model, params, it = _setup(utils)
+    pc = ParallelConfig()
+    pretrain(model, params, _tc(2), pc, it(), log_interval=1)
+    out = capsys.readouterr().out
+    assert "time (ms)" in out
+    assert "train-step" in out
