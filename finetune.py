@@ -33,7 +33,10 @@ from megatron_llm_tpu.arguments import (
 from megatron_llm_tpu.dist_signal_handler import DistributedSignalHandler
 from megatron_llm_tpu.initialize import initialize_megatron
 from megatron_llm_tpu.models import MODEL_REGISTRY
-from megatron_llm_tpu.optimizer import MegatronOptimizer
+from megatron_llm_tpu.optimizer import (
+    MegatronOptimizer,
+    OptimizerParamScheduler,
+)
 from megatron_llm_tpu.parallel import sharding as sh
 from megatron_llm_tpu.training import pretrain
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -195,8 +198,23 @@ def main():
     start_iteration = 0
     opt_state = None
     if args.load:
+        # abstract template (shapes + current-mesh shardings, no device
+        # memory) makes the orbax restore direct-to-device on THIS mesh —
+        # i.e. load-time resharding even when the checkpoint was written
+        # under a different topology
+        try:
+            abstract = jax.eval_shape(
+                lambda: model.init(jax.random.PRNGKey(args.seed)))
+            shardings = sh.make_shardings(model.param_specs(abstract))
+            params_template = jax.tree_util.tree_map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                  sharding=s),
+                abstract, shardings)
+        except Exception:
+            params_template = None      # fall back to host-side restore
         params, opt_state, meta = checkpointing.load_checkpoint(
-            args.load, finetune=args.finetune
+            args.load, finetune=args.finetune,
+            params_template=params_template,
         )
         if params is not None:
             start_iteration = meta["iteration"]
@@ -219,7 +237,7 @@ def main():
         to_stage_major=True)
     params = sh.shard_params(params, model.param_specs(params))
 
-    def save_natural(save_dir, it_, params_, opt_state_):
+    def save_natural(save_dir, it_, params_, opt_state_, scheduler_=None):
         checkpointing.save_checkpoint(
             save_dir, it_,
             convert_params_layout(
@@ -228,6 +246,10 @@ def main():
             convert_opt_state_layout(
                 opt_state_, args.num_layers, pc.pipeline_model_parallel_size,
                 vpp, to_stage_major=False),
+            # closure fallback: `scheduler` is bound by call time, after
+            # main builds it
+            scheduler_ if scheduler_ is not None else scheduler,
+            args=checkpointing.config_to_args(getattr(model, "cfg", None)),
         )
 
     if args.fp16 or args.bf16:
@@ -239,79 +261,104 @@ def main():
     optimizer = MegatronOptimizer(
         tc, params_dtype=jax.tree_util.tree_leaves(params)[0].dtype
     )
+    scheduler = OptimizerParamScheduler(
+        max_lr=tc.lr, min_lr=tc.min_lr,
+        lr_warmup_steps=tc.lr_warmup_iters,
+        lr_decay_steps=tc.lr_decay_iters or max(tc.train_iters, 1),
+        lr_decay_style=tc.lr_decay_style,
+        # `is not None`, not `or`: explicit 0.0 means ramp from zero
+        start_wd=(tc.start_weight_decay
+                  if tc.start_weight_decay is not None else tc.weight_decay),
+        end_wd=(tc.end_weight_decay
+                if tc.end_weight_decay is not None else tc.weight_decay),
+        wd_incr_steps=max(tc.train_iters, 1),
+        wd_incr_style=tc.weight_decay_incr_style,
+    )
+    scheduler.num_steps = start_iteration
+
+    # phase-2 resume: optimizer + scheduler state (params came in phase 1;
+    # the optimizer had to exist first to provide the restore template).
+    # The template is abstract (jax.eval_shape) — materializing a real
+    # optimizer state just to read shapes would transiently double the
+    # optimizer-state footprint on exactly the large-model resumes that
+    # need direct-to-device restore.
+    if args.load and start_iteration and not args.finetune:
+        opt_template = jax.eval_shape(
+            lambda p: optimizer.init(convert_params_layout(
+                p, args.num_layers, pc.pipeline_model_parallel_size, vpp,
+                to_stage_major=False)),
+            params)
+        _, loaded_opt, _ = checkpointing.load_checkpoint(
+            args.load, load_params=False,
+            opt_state_template=opt_template, scheduler=scheduler,
+        )
+        if loaded_opt is not None:
+            staged = convert_opt_state_layout(
+                loaded_opt, args.num_layers,
+                pc.pipeline_model_parallel_size, vpp, to_stage_major=True)
+            # re-place restored leaves where a fresh init would put them:
+            # param-shaped moments/masters follow the params' shardings
+            # (zeros_like preserves sharding); scalar step / grad-scaler
+            # state replicates across the mesh
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def _replicated(t):
+                return jax.device_put(t, NamedSharding(
+                    mesh, PartitionSpec(*([None] * t.ndim))))
+
+            psh = jax.tree_util.tree_map(lambda p: p.sharding, params)
+
+            def _like_params(tree):
+                if tree is None:
+                    return None
+                return jax.tree_util.tree_map(jax.device_put, tree, psh)
+
+            opt_state = staged._replace(
+                step=_replicated(staged.step),
+                master_params=_like_params(staged.master_params),
+                exp_avg=_like_params(staged.exp_avg),
+                exp_avg_sq=_like_params(staged.exp_avg_sq),
+                grad_scaler=jax.tree_util.tree_map(
+                    _replicated, staged.grad_scaler),
+            )
+            print(" restored optimizer + scheduler state")
+
     handler = DistributedSignalHandler() if args.exit_signal_handler else None
     if handler:
         handler.install()
 
-    if pc.pipeline_model_parallel_size > 1:
+    # pp > 1 drives the pipelined engine through the same pretrain() loop
+    # (custom train_step); eval needs a forward-only program, which the
+    # pipelined step doesn't provide
+    pipelined = pc.pipeline_model_parallel_size > 1
+    custom_step = None
+    if pipelined:
         from megatron_llm_tpu.parallel.pipeline import (
             build_pipeline_train_step,
         )
-        # drive the pipelined step with the generic loop via a shim
-        from megatron_llm_tpu import training as T
-        step = build_pipeline_train_step(model, optimizer, pc, num_micro)
+        custom_step = build_pipeline_train_step(model, optimizer, pc,
+                                                num_micro)
         opt_state = opt_state or optimizer.init(params)
-        from megatron_llm_tpu.optimizer import OptimizerParamScheduler
-        sched = OptimizerParamScheduler(
-            max_lr=tc.lr, min_lr=tc.min_lr,
-            lr_warmup_steps=tc.lr_warmup_iters,
-            lr_decay_steps=tc.lr_decay_iters or max(tc.train_iters, 1),
-            lr_decay_style=tc.lr_decay_style,
-        )
-        sched.num_steps = start_iteration
-        import time
-        it = start_iteration
-        last = last0 = time.perf_counter()
-        while it < tc.train_iters:
-            batch = next(train_iter)
-            lr, wd = sched.step(1)
-            key = jax.random.fold_in(jax.random.PRNGKey(tc.seed), it)
-            params, opt_state, metrics = step(params, opt_state, batch, key,
-                                              lr, wd)
-            it += 1
-            if args.log_interval and it % args.log_interval == 0:
-                jax.block_until_ready(metrics["lm loss"])
-                now = time.perf_counter()
-                el = (now - last) / args.log_interval
-                last = now
-                T.training_log(it, tc.train_iters,
-                               {k: float(v) for k, v in metrics.items()},
-                               el, batch["tokens"].size, lr)
-            if args.save and args.save_interval and it % args.save_interval == 0:
-                save_natural(args.save, it, params, opt_state)
-            if handler and handler.signals_received():
-                if args.save:
-                    save_natural(args.save, it, params, opt_state)
-                sys.exit(0)
-            # exit flags (reference training.py:746-767), pipelined branch
-            if args.exit_interval and it % args.exit_interval == 0:
-                if args.save:
-                    save_natural(args.save, it, params, opt_state)
-                print(f" exiting program at iteration {it}", flush=True)
-                sys.exit(0)
-            if args.exit_duration_in_mins and \
-                    (time.perf_counter() - last0) / 60.0 > args.exit_duration_in_mins:
-                if args.save:
-                    save_natural(args.save, it, params, opt_state)
-                print(" exiting program on duration limit", flush=True)
-                sys.exit(0)
-    else:
-        params, opt_state, it = pretrain(
-            model, params, tc, pc, train_iter,
-            log_interval=args.log_interval,
-            save_interval=args.save_interval,
-            save_dir=args.save,
-            eval_iterator=eval_iter,
-            eval_interval=args.eval_interval if eval_iter else None,
-            eval_iters=args.eval_iters,
-            exit_signal_handler=handler,
-            start_iteration=start_iteration,
-            opt_state=opt_state,
-            skip_iters=getattr(args, "skip_iters", ()) or (),
-            exit_interval=getattr(args, "exit_interval", None),
-            exit_duration_in_mins=getattr(args, "exit_duration_in_mins",
-                                          None),
-        )
+    params, opt_state, it = pretrain(
+        model, params, tc, pc, train_iter,
+        optimizer=optimizer,
+        scheduler=scheduler,
+        train_step=custom_step,
+        save_fn=save_natural,
+        log_interval=args.log_interval,
+        save_interval=args.save_interval,
+        save_dir=args.save,
+        eval_iterator=None if pipelined else eval_iter,
+        eval_interval=(args.eval_interval
+                       if eval_iter and not pipelined else None),
+        eval_iters=args.eval_iters,
+        exit_signal_handler=handler,
+        start_iteration=start_iteration,
+        opt_state=opt_state,
+        skip_iters=getattr(args, "skip_iters", ()) or (),
+        exit_interval=getattr(args, "exit_interval", None),
+        exit_duration_in_mins=getattr(args, "exit_duration_in_mins", None),
+    )
 
     if args.save:
         save_natural(args.save, it, params, opt_state)

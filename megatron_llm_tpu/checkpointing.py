@@ -57,6 +57,27 @@ def _orbax():
     return ocp
 
 
+def config_to_args(cfg) -> dict:
+    """JSON-safe dict of a (dataclass) model config, for meta.json 'args'.
+    Enums and other rich values degrade to strings; the consumers
+    (megatron_ckpt export, model rebuild on import) read plain fields."""
+    import dataclasses
+
+    def safe(v):
+        if isinstance(v, (bool, int, float, str)) or v is None:
+            return v
+        if isinstance(v, (list, tuple)):
+            return [safe(x) for x in v]
+        name = getattr(v, "name", None)     # Enum -> member name
+        return name.lower() if isinstance(name, str) else str(v)
+
+    if dataclasses.is_dataclass(cfg):
+        return {k: safe(v) for k, v in dataclasses.asdict(cfg).items()}
+    if isinstance(cfg, dict):
+        return {k: safe(v) for k, v in cfg.items()}
+    return {}
+
+
 def save_checkpoint(
     save_dir: str,
     iteration: int,
@@ -117,6 +138,7 @@ def load_checkpoint(
     opt_state_template=None,
     scheduler=None,
     finetune: bool = False,
+    load_params: bool = True,
 ):
     """Load the latest (or given) checkpoint.
 
@@ -133,16 +155,39 @@ def load_checkpoint(
     ckpt_dir = Path(get_checkpoint_name(load_dir, iteration or 0, release)).absolute()
 
     ckptr = ocp.PyTreeCheckpointer()
-    restore_args = None
-    if params_template is not None:
-        restore_args = ocp.args.PyTreeRestore(
-            item=params_template
-        ) if hasattr(ocp.args, "PyTreeRestore") else None
-    params = ckptr.restore(ckpt_dir / "model")
+
+    def _restore_args_for(template):
+        """Orbax RestoreArgs from a template pytree (concrete arrays or
+        ShapeDtypeStructs carrying .sharding): restore goes straight to
+        device buffers laid out for the *current* mesh — load-time
+        resharding, no host round trip, and no orbax 'unsafe when
+        restoring on a different topology' warning."""
+        import jax
+
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype,
+                sharding=getattr(x, "sharding", None)),
+            template,
+        )
+        return ocp.checkpoint_utils.construct_restore_args(abstract)
+
+    if not load_params:
+        # optimizer/scheduler-only restore (second phase of a CLI resume,
+        # once the optimizer exists to provide a template)
+        params = None
+    elif params_template is not None:
+        params = ckptr.restore(
+            ckpt_dir / "model",
+            restore_args=_restore_args_for(params_template))
+    else:
+        params = ckptr.restore(ckpt_dir / "model")
 
     opt_state = None
     if not finetune and (ckpt_dir / "optim").exists() and opt_state_template is not None:
-        tree = ckptr.restore(ckpt_dir / "optim")
+        tmpl_tree = _opt_state_to_tree(opt_state_template)
+        tree = ckptr.restore(ckpt_dir / "optim",
+                             restore_args=_restore_args_for(tmpl_tree))
         opt_state = _tree_to_opt_state(tree, opt_state_template)
 
     with open(ckpt_dir / "meta.json") as f:

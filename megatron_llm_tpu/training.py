@@ -202,6 +202,8 @@ def pretrain(
     skip_iters=(),
     exit_interval: Optional[int] = None,
     exit_duration_in_mins: Optional[float] = None,
+    train_step=None,
+    save_fn=None,
 ):
     """Minimal-dependency pretrain loop (the full CLI driver lives in
     ``finetune.py`` / ``pretrain_gpt.py`` at the repo root).
@@ -223,6 +225,14 @@ def pretrain(
     optimizer total), ``save-checkpoint``, ``eval-time``.  Finer split
     timers (forward vs backward vs optimizer) do not exist because one
     XLA program runs all three fused — that is the point of the design.
+
+    ``train_step`` overrides the compiled step (same signature as
+    ``build_train_step``'s result) — how ``finetune.py`` drives the
+    pipelined engine through this one loop.  With a custom step, skipped
+    iterations have no forward-only program, so their loss logs as NaN,
+    and ``eval_iterator`` is rejected.  ``save_fn(save_dir, it, params,
+    opt_state, scheduler)`` overrides checkpoint writing (e.g. to convert
+    a VPP stage-major layout back to natural order first).
     """
     from megatron_llm_tpu import checkpointing
     from megatron_llm_tpu.timers import Timers
@@ -243,22 +253,32 @@ def pretrain(
     if opt_state is None:
         opt_state = optimizer.init(params)
     if scheduler is None:
+        # NB: `x if x is not None else y`, not `or` — an explicit 0.0
+        # start/end weight decay is a legitimate ramp-from-zero config
+        swd = train_cfg.start_weight_decay
+        ewd = train_cfg.end_weight_decay
         scheduler = OptimizerParamScheduler(
             max_lr=train_cfg.lr,
             min_lr=train_cfg.min_lr,
             lr_warmup_steps=train_cfg.lr_warmup_iters,
             lr_decay_steps=train_cfg.lr_decay_iters or max(train_cfg.train_iters, 1),
             lr_decay_style=train_cfg.lr_decay_style,
-            start_wd=train_cfg.start_weight_decay or train_cfg.weight_decay,
-            end_wd=train_cfg.end_weight_decay or train_cfg.weight_decay,
+            start_wd=swd if swd is not None else train_cfg.weight_decay,
+            end_wd=ewd if ewd is not None else train_cfg.weight_decay,
             wd_incr_steps=max(train_cfg.train_iters, 1),
             wd_incr_style=train_cfg.weight_decay_incr_style,
         )
         scheduler.num_steps = start_iteration
 
-    train_step = build_train_step(
-        model, optimizer, parallel_cfg, num_micro, loss_func
-    )
+    custom_step = train_step is not None
+    if custom_step and eval_iterator is not None:
+        raise ValueError(
+            "eval_iterator is not supported with a custom train_step "
+            "(no forward-only program exists for it)")
+    if not custom_step:
+        train_step = build_train_step(
+            model, optimizer, parallel_cfg, num_micro, loss_func
+        )
     eval_step = (
         build_train_step(model, optimizer, parallel_cfg, num_micro, loss_func,
                          forward_only=True)
@@ -275,10 +295,15 @@ def pretrain(
 
     def _save(it):
         timers("save-checkpoint", log_level=0).start()
-        checkpointing.save_checkpoint(
-            save_dir, it, params, opt_state, scheduler,
-            consumed_samples=counters.get("samples", 0),
-        )
+        if save_fn is not None:
+            save_fn(save_dir, it, params, opt_state, scheduler)
+        else:
+            checkpointing.save_checkpoint(
+                save_dir, it, params, opt_state, scheduler,
+                consumed_samples=counters.get("samples", 0),
+                args=checkpointing.config_to_args(
+                    getattr(model, "cfg", None)),
+            )
         timers("save-checkpoint").stop()
 
     while iteration < train_cfg.train_iters:
@@ -291,15 +316,22 @@ def pretrain(
             # reference training.py:397-399: forward-only, no update
             print(" IMPORTANT! skipping backprop for this iteration!",
                   flush=True)
-            if skip_step is None:
-                # eval_step is the same forward-only program; reuse its
-                # compilation when available
-                skip_step = eval_step or build_train_step(
-                    model, optimizer, parallel_cfg, num_micro, loss_func,
-                    forward_only=True)
-            metrics = dict(metrics) if iteration > start_iteration else {}
-            metrics["lm loss"] = skip_step(params, batch, step_key)
-            metrics["skipped_iter"] = 1
+            if custom_step:
+                # a custom (e.g. pipelined) step has no forward-only
+                # program; skip means "consume data, update nothing"
+                metrics = {"lm loss": jnp.float32(float("nan")),
+                           "skipped_iter": 1}
+            else:
+                if skip_step is None:
+                    # eval_step is the same forward-only program; reuse
+                    # its compilation when available
+                    skip_step = eval_step or build_train_step(
+                        model, optimizer, parallel_cfg, num_micro,
+                        loss_func, forward_only=True)
+                # fresh metrics: grad_norm/loss_scale/aux losses from the
+                # previous step must not masquerade as this iteration's
+                metrics = {"lm loss": skip_step(params, batch, step_key),
+                           "skipped_iter": 1}
         else:
             timers("train-step", log_level=1).start()
             params, opt_state, metrics = train_step(

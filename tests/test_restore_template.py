@@ -1,0 +1,48 @@
+"""Checkpoint restore with an abstract template: orbax must place leaves
+directly onto the *current* mesh's shardings, even when the checkpoint was
+saved under a different topology (kills the 'unsafe when restoring on a
+different topology' path — VERDICT r2 #5)."""
+
+import warnings
+
+import jax
+import numpy as np
+
+from megatron_llm_tpu import checkpointing
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+from megatron_llm_tpu.parallel import sharding as sh
+
+
+def test_restore_on_different_mesh(utils, tmp_path):
+    cfg = llama_config("tiny", seq_length=16, max_position_embeddings=16,
+                       padded_vocab_size=64)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+
+    # save under a tp=2 topology
+    utils.initialize_model_parallel(tp=2)
+    params = sh.shard_params(params, model.param_specs(params))
+    checkpointing.save_checkpoint(str(tmp_path), 5, params)
+    baseline = [np.asarray(l) for l in jax.tree_util.tree_leaves(params)]
+
+    # restore under tp=4 with an abstract template carrying the new mesh's
+    # shardings
+    utils.initialize_model_parallel(tp=4)
+    shardings = sh.make_shardings(model.param_specs(params))
+    template = jax.tree_util.tree_map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        params, shardings)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        loaded, _, meta = checkpointing.load_checkpoint(
+            str(tmp_path), params_template=template)
+    assert meta["iteration"] == 5
+    topo = [w for w in caught if "topology" in str(w.message)]
+    assert not topo, f"orbax topology warning still fired: {topo[0].message}"
+
+    for got, want_sharding, want_val in zip(
+            jax.tree_util.tree_leaves(loaded),
+            jax.tree_util.tree_leaves(shardings), baseline):
+        assert got.sharding.is_equivalent_to(want_sharding, got.ndim), (
+            f"restored {got.sharding} != requested {want_sharding}")
+        np.testing.assert_array_equal(np.asarray(got), want_val)

@@ -75,8 +75,13 @@ def test_checkpoint_resume_exact(utils):
         p4a, _, _ = pretrain(model, p2, tc, pc, it(), log_interval=0,
                              start_iteration=2, opt_state=o2)
 
-        # load from checkpoint and run the same 2 iters
-        pl, ol, meta = checkpointing.load_checkpoint(d, opt_state_template=o2)
+        # load from checkpoint and run the same 2 iters (abstract template:
+        # shape/dtype/sharding metadata survives donation of p2's buffers)
+        tmpl = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding), p2)
+        pl, ol, meta = checkpointing.load_checkpoint(
+            d, params_template=tmpl, opt_state_template=o2)
         assert meta["iteration"] == 2
         pl = sh.shard_params(pl, model.param_specs(pl))
         p4b, _, _ = pretrain(model, pl, tc, pc, it(), log_interval=0,
