@@ -67,6 +67,61 @@ def llama_family_state_dict(params, config):
     return sd
 
 
+def falcon_state_dict(params, config):
+    """param pytree -> HF FalconForCausalLM state dict (inverse of
+    hf_to_megatron.convert_falcon; reference writer:
+    megatron_to_hf.py:333-475)."""
+    import torch
+
+    nh = config["num_attention_heads"]
+    ng = config.get("num_attention_heads_kv") or nh
+    d = config["hidden_size"] // nh
+    qpg = nh // ng
+    L = config["num_layers"]
+    new_arch = bool(config.get("parallel_layernorm"))
+    t = lambda a: torch.tensor(np.asarray(a, np.float32))
+
+    emb = np.asarray(params["embedding"]["word"]["embedding"], np.float32)
+    sd = {
+        "transformer.word_embeddings.weight": t(emb),
+        "transformer.ln_f.weight": t(
+            params["transformer"]["final_norm"]["scale"]),
+        "transformer.ln_f.bias": t(
+            params["transformer"]["final_norm"]["bias"]),
+        "lm_head.weight": t(emb),          # falcon ties head to embeddings
+    }
+    layers = params["transformer"]["layers"]
+    for i in range(L):
+        g = lambda *path: np.asarray(_index(layers, path, i), np.float32)
+        p = f"transformer.h.{i}."
+        # grouped qkv with per-(q|k)-head rotary de-interleave, v untouched
+        w = np.ascontiguousarray(
+            g("attention", "query_key_value", "kernel").T)
+        hid = w.shape[-1]
+        w = w.reshape(ng, qpg + 2, d, hid)
+        for grp in range(ng):
+            for h in range(qpg + 1):
+                w[grp, h] = rotary_interleaved_to_hf(
+                    w[grp, h].reshape(d, hid), d).reshape(d, hid)
+        sd[p + "self_attention.query_key_value.weight"] = t(
+            w.reshape(ng * (qpg + 2) * d, hid))
+        sd[p + "self_attention.dense.weight"] = t(
+            np.ascontiguousarray(g("attention", "dense", "kernel").T))
+        sd[p + "mlp.dense_h_to_4h.weight"] = t(
+            np.ascontiguousarray(g("mlp", "dense_h_to_4h", "kernel").T))
+        sd[p + "mlp.dense_4h_to_h.weight"] = t(
+            np.ascontiguousarray(g("mlp", "dense_4h_to_h", "kernel").T))
+        if new_arch:
+            sd[p + "ln_attn.weight"] = t(g("input_norm", "scale"))
+            sd[p + "ln_attn.bias"] = t(g("input_norm", "bias"))
+            sd[p + "ln_mlp.weight"] = t(g("mlp_norm", "scale"))
+            sd[p + "ln_mlp.bias"] = t(g("mlp_norm", "bias"))
+        else:
+            sd[p + "input_layernorm.weight"] = t(g("input_norm", "scale"))
+            sd[p + "input_layernorm.bias"] = t(g("input_norm", "bias"))
+    return sd
+
+
 def _index(tree, path, i):
     for k in path:
         tree = tree[k]
@@ -104,6 +159,25 @@ def hf_config_for(model_name: str, config: dict):
             sliding_window=config.get("sliding_window_size", 4096),
             tie_word_embeddings=False,
         )
+    if model_name == "falcon":
+        from transformers import FalconConfig
+
+        ng = config.get("num_attention_heads_kv") \
+            or config["num_attention_heads"]
+        new_arch = bool(config.get("parallel_layernorm"))
+        return FalconConfig(
+            vocab_size=config["padded_vocab_size"],
+            hidden_size=config["hidden_size"],
+            num_hidden_layers=config["num_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            num_kv_heads=ng,
+            new_decoder_architecture=new_arch,
+            multi_query=(ng == 1 and not new_arch),
+            parallel_attn=bool(config.get("parallel_attn", True)),
+            bias=bool(config.get("add_bias_linear", False)),
+            layer_norm_epsilon=config.get("layernorm_epsilon", 1e-5),
+            tie_word_embeddings=True,
+        )
     raise NotImplementedError(f"HF export for {model_name!r}")
 
 
@@ -133,7 +207,9 @@ def main():
 
     hf_cfg = hf_config_for(model_name, config)
     hf = AutoModelForCausalLM.from_config(hf_cfg)
-    sd = llama_family_state_dict(params, config)
+    writer = (falcon_state_dict if model_name == "falcon"
+              else llama_family_state_dict)
+    sd = writer(params, config)
     missing, unexpected = hf.load_state_dict(sd, strict=False)
     if missing or unexpected:
         print(f" note: missing={missing} unexpected={unexpected}")
