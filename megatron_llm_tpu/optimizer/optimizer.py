@@ -258,6 +258,47 @@ class MegatronOptimizer:
                                         hysteresis_tracker=None),
         )
 
+    def verify_zero1_sharding(self, opt_state, *, dp_axis: str = "dp",
+                              min_bytes: int = 1 << 20):
+        """Assert every master/adam leaf of at least ``min_bytes`` is
+        *actually* dp-sharded on the mesh — the ``state_specs`` heuristic
+        silently leaves a tensor replicated when no axis is dp-divisible,
+        and at 70B that silent fallback is an OOM, not a preference.
+        Raises RuntimeError listing every offending leaf."""
+        bad = []
+
+        def axes_of(leaf):
+            spec = getattr(leaf.sharding, "spec", ())
+            names = set()
+            for ax in spec or ():
+                if isinstance(ax, (tuple, list)):
+                    names.update(ax)
+                elif ax is not None:
+                    names.add(ax)
+            return names
+
+        def check(name, tree):
+            if tree is None:
+                return
+            for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+                nbytes = leaf.size * leaf.dtype.itemsize
+                if nbytes < min_bytes:
+                    continue
+                if dp_axis not in axes_of(leaf):
+                    bad.append(
+                        f"{name}{jax.tree_util.keystr(path)} "
+                        f"shape={tuple(leaf.shape)} ({nbytes >> 10} KiB) "
+                        f"sharding={leaf.sharding}")
+
+        check("master_params", opt_state.master_params)
+        check("exp_avg", opt_state.exp_avg)
+        check("exp_avg_sq", opt_state.exp_avg_sq)
+        if bad:
+            raise RuntimeError(
+                "ZeRO-1: optimizer-state leaves not dp-sharded (the "
+                "state_specs dp-divisible-axis heuristic fell back to "
+                "replication):\n  " + "\n  ".join(bad))
+
 
 def get_megatron_optimizer(train_cfg: TrainConfig, params_dtype=None):
     """Reference: megatron/optimizer/__init__.py:63."""

@@ -138,3 +138,39 @@ def test_scheduler_state_roundtrip():
     s2.load_state_dict(sd)
     assert s2.num_steps == 7
     assert s2.get_lr() == s.get_lr()
+
+
+def test_zero1_realized_shardings(utils):
+    """ZeRO-1 state_specs must produce *actually* dp-sharded adam/master
+    leaves, and verify_zero1_sharding must fail loudly on a replicated
+    fallback (reference distrib_optimizer.py:63-171 semantics)."""
+    import pytest
+
+    from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+    from megatron_llm_tpu.parallel import sharding as sh
+
+    utils.initialize_model_parallel(tp=2)  # dp = 4 on the 8-device mesh
+    cfg = llama_config("tiny", seq_length=16, max_position_embeddings=16,
+                       padded_vocab_size=128)
+    model = LlamaModel(cfg)
+    params = sh.shard_params(model.init(jax.random.PRNGKey(0)),
+                             model.param_specs(model.init(
+                                 jax.random.PRNGKey(0))))
+    tc = TrainConfig(micro_batch_size=1, global_batch_size=1, lr=1e-3,
+                     bf16=True)
+    opt = MegatronOptimizer(tc, params_dtype=jnp.float32)
+    opt_state = opt.init(params)
+    specs = opt.state_specs(model.param_specs(params), params,
+                            zero1=True, dp_size=4)
+    sharded = opt_state._replace(
+        exp_avg=sh.shard_params(opt_state.exp_avg, specs.exp_avg),
+        exp_avg_sq=sh.shard_params(opt_state.exp_avg_sq, specs.exp_avg_sq),
+    )
+    # every leaf above threshold carries the dp axis
+    opt.verify_zero1_sharding(sharded, min_bytes=32 << 10)
+    big = [l for l in jax.tree_util.tree_leaves(sharded.exp_avg)
+           if l.size * 4 >= (32 << 10)]
+    assert big, "test model too small to exercise the threshold"
+    # the pre-sharding state (replicated) must fail loudly
+    with pytest.raises(RuntimeError, match="not dp-sharded"):
+        opt.verify_zero1_sharding(opt_state, min_bytes=32 << 10)
