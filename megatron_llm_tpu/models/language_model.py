@@ -178,6 +178,56 @@ def _sfl_bwd(res, g):
 scatter_free_lookup.defvjp(_sfl_fwd, _sfl_bwd)
 
 
+def vocab_parallel_lookup_manual(table: jax.Array,
+                                 tokens: jax.Array) -> jax.Array:
+    """Reference ``VocabParallelEmbedding`` semantics written out by hand
+    (``megatron/core/tensor_parallel/layers.py:128-210``): mask ids
+    outside this tp-rank's vocab range, look up in the local shard, zero
+    the masked rows, allreduce over tp — as a nested tp-manual shard_map.
+
+    For call sites already inside a pp-manual shard_map (the pipeline
+    engines), where GSPMD's gather partitioner check-fails on a
+    vocab-sharded operand (spmd_partitioner_util.cc:495).  The inner
+    region manualizes tp so no gather/scatter partitioning happens at
+    all; backward is the local one-hot einsum via
+    ``scatter_free_lookup``, sized 1/tp of a head matmul."""
+    from jax.sharding import PartitionSpec as P
+
+    from megatron_llm_tpu import topology
+
+    tp_axis = topology.TP_AXIS
+    # the call site sits inside a pp-manual shard_map: the nested region
+    # must use the *context* (abstract) mesh and re-declare every
+    # already-manual axis alongside the newly manualized tp
+    am = jax.sharding.get_abstract_mesh()
+    if am is None or not am.axis_names:
+        am = topology.get_mesh()
+    if tp_axis not in am.axis_names or am.shape[tp_axis] == 1:
+        return scatter_free_lookup(table, tokens)
+    manual = {
+        name for name, t in zip(am.axis_names, am.axis_types)
+        if "Manual" in str(t)
+    }
+
+    def local(table_l, toks):
+        vl = table_l.shape[0]
+        start = jax.lax.axis_index(tp_axis) * vl
+        ids = toks - start
+        valid = (ids >= 0) & (ids < vl)
+        h = scatter_free_lookup(table_l, jnp.clip(ids, 0, vl - 1))
+        h = jnp.where(valid[..., None], h, 0)
+        return jax.lax.psum(h, tp_axis)
+
+    return jax.shard_map(
+        local,
+        mesh=am,
+        in_specs=(P(tp_axis, None), P()),
+        out_specs=P(),
+        axis_names=manual | {tp_axis},
+        check_vma=False,
+    )(table, tokens)
+
+
 def embedding_forward(
     tokens: jax.Array,
     position_ids: Optional[jax.Array],
@@ -188,12 +238,23 @@ def embedding_forward(
     rng_key=None,
     train: bool = False,
     scatter_free: bool = False,
+    vocab_parallel_manual: bool = False,
 ) -> jax.Array:
     """Word (+position, +tokentype) embedding with dropout; under sequence
     parallelism the output is scattered along the sequence axis
     (reference: language_model.py:230-262).  ``scatter_free`` swaps the
-    word-lookup backward for the one-hot einsum (pipeline engines)."""
-    if scatter_free:
+    word-lookup backward for the one-hot einsum; ``vocab_parallel_manual``
+    additionally keeps the table vocab-sharded with a hand-written
+    masked-lookup + tp-psum (pipeline engines)."""
+    if vocab_parallel_manual:
+        h = constrain(
+            vocab_parallel_lookup_manual(
+                params["word"]["embedding"].astype(cfg.compute_jnp_dtype),
+                tokens,
+            ),
+            "batch", "seq", None,
+        )
+    elif scatter_free:
         h = constrain(
             scatter_free_lookup(
                 params["word"]["embedding"].astype(cfg.compute_jnp_dtype),

@@ -50,10 +50,12 @@ as the p2p isend/irecv replacement:
   gradient sums both uses by linearity, in the manual engine both
   contributions are accumulated per stage and summed across pp outside
   the shard_map — the reference's embedding-group all-reduce
-  (optimizer.py:203-229) has no analogue to write.  The lookup itself and
-  its backward use ``scatter_free_lookup`` (one-hot einsum transpose) on a
-  tp-replicated table: XLA's gather/scatter partitioners check-fail on a
-  vocab-sharded table under the manual submesh.
+  (optimizer.py:203-229) has no analogue to write.  The word table stays
+  **vocab-sharded over tp**: the lookup is ``vocab_parallel_lookup_manual``
+  (masked local gather + tp-psum inside a nested tp-manual shard_map, the
+  reference's VocabParallelEmbedding), with a local one-hot-einsum
+  backward — XLA's gather/scatter partitioners, which check-fail on
+  vocab-sharded operands under the manual submesh, never see it.
 
 Layer-to-stage assignment is a *sharding spec*, not code: the stacked
 layer axis [L, ...] is sharded over pp, giving each stage a contiguous
@@ -181,24 +183,30 @@ def _index_mb(arr, m):
     return lax.dynamic_index_in_dim(arr, m, 0, keepdims=False)
 
 
-def _replicate_tree(tree, mesh):
-    """Force every leaf fully replicated (vocab axis included).
+def _pipeline_embedding_layout(tree, mesh):
+    """Replicate the small aux embedding tables (learned position /
+    tokentype — their in-shard_map gathers need a replicated operand);
+    the word table keeps its vocab(tp)-sharded layout.
 
-    The pipeline computes the embedding lookup *inside* the pp-manual
-    shard_map; XLA's gather partitioner check-fails on a vocab-sharded
-    table under a manual submesh once ZeRO-1 sharding propagation kicks
-    in (spmd_partitioner_util.cc:495), so the table is all-gathered over
-    tp once per step instead — V*H replicated bytes per device, ~0.5 GB
-    for a 70B llama, and it removes a per-tick tp collective.  The LM
-    head weight stays vocab-sharded (its matmul partitions fine and
-    feeds the vocab-parallel CE).
-    """
+    The word lookup inside the pp-manual shard_map goes through
+    ``vocab_parallel_lookup_manual`` (masked local gather + tp-psum in a
+    nested tp-manual region, the reference's VocabParallelEmbedding,
+    ``layers.py:128-210``), so the GSPMD gather partitioner — which
+    check-fails on a vocab-sharded operand under a manual submesh
+    (spmd_partitioner_util.cc:495) — never sees it.  This replaces the
+    round-2 workaround of all-gathering the full table per step
+    (V*H replicated bytes per device: ~0.5 GB at 70B, plus a V*H fp32
+    grad accumulator in the 1F1B carry)."""
     from jax.sharding import NamedSharding
 
     rep = NamedSharding(mesh, P())
-    return jax.tree_util.tree_map(
-        lambda x: jax.lax.with_sharding_constraint(x, rep), tree
-    )
+    out = {
+        k: jax.tree_util.tree_map(
+            lambda x: jax.lax.with_sharding_constraint(x, rep), v)
+        for k, v in tree.items() if k != "word"
+    }
+    out["word"] = tree["word"]
+    return out
 
 
 def _fwd_rotation(S):
@@ -305,7 +313,7 @@ def build_pipeline_loss_fn(
                     rng_key=(jax.random.fold_in(emb_key0, m)
                              if use_dropout else None),
                     train=use_dropout,
-                    scatter_free=True,
+                    vocab_parallel_manual=True,
                 ).astype(cfg.compute_jnp_dtype)
                 inp = jnp.where(is_first & (v == 0), h_emb, act)
                 out = run_chunk(inp, v, m)
@@ -370,7 +378,7 @@ def build_pipeline_loss_fn(
             out_specs=(P(), P()),
             axis_names={"pp"},
             check_vma=False,
-        )(trans["layers"], _replicate_tree(emb_p, mesh), head_w,
+        )(trans["layers"], _pipeline_embedding_layout(emb_p, mesh), head_w,
           trans["final_norm"], tokens, labels, loss_mask, rng_key)
 
         loss = ce_tot / jnp.maximum(tok_tot, 1.0)
@@ -465,7 +473,7 @@ def build_pipeline_grad_fn(
                     rng_key=(jax.random.fold_in(emb_key0, m)
                              if use_dropout else None),
                     train=use_dropout,
-                    scatter_free=True,
+                    vocab_parallel_manual=True,
                 ).astype(cfg.compute_jnp_dtype)
 
             def head_ce(out, head_w_in, fnorm_in, m):
@@ -599,7 +607,7 @@ def build_pipeline_grad_fn(
                        P(), P()),
             axis_names={"pp"},
             check_vma=False,
-        )(trans["layers"], _replicate_tree(emb_p, mesh), head_w,
+        )(trans["layers"], _pipeline_embedding_layout(emb_p, mesh), head_w,
           trans["final_norm"], tokens, labels, loss_mask, rng_key, seed)
         sum_pp = lambda t: jax.tree_util.tree_map(  # noqa: E731
             lambda g: jnp.sum(g, axis=0), t)

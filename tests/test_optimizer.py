@@ -153,9 +153,8 @@ def test_zero1_realized_shardings(utils):
     cfg = llama_config("tiny", seq_length=16, max_position_embeddings=16,
                        padded_vocab_size=128)
     model = LlamaModel(cfg)
-    params = sh.shard_params(model.init(jax.random.PRNGKey(0)),
-                             model.param_specs(model.init(
-                                 jax.random.PRNGKey(0))))
+    p0 = model.init(jax.random.PRNGKey(0))
+    params = sh.shard_params(p0, model.param_specs(p0))
     tc = TrainConfig(micro_batch_size=1, global_batch_size=1, lr=1e-3,
                      bf16=True)
     opt = MegatronOptimizer(tc, params_dtype=jnp.float32)
