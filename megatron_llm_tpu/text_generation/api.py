@@ -44,8 +44,13 @@ def generate(
     greedy: bool = False,
     seed: int = 0,
     return_log_probs: bool = False,
+    batch_times_seqlen_threshold: int = 512,
 ):
-    """Returns (texts, token_lists, log_probs or None)."""
+    """Returns (texts, token_lists, log_probs or None).
+
+    ``batch_times_seqlen_threshold``: micro-batch the prefill forward
+    above this batch*seqlen (reference
+    ``--inference_batch_times_seqlen_threshold``, default 512)."""
     pad = getattr(tokenizer, "pad", 0) or 0
     eod = getattr(tokenizer, "eod", None)
     toks, lens = _tokenize_prompts(tokenizer, prompts, pad)
@@ -55,6 +60,7 @@ def generate(
         min_prompt_len=int(lens.min()),
         top_k=top_k, top_p=top_p, temperature=temperature, greedy=greedy,
         eod_id=eod, return_log_probs=return_log_probs,
+        batch_times_seqlen_threshold=batch_times_seqlen_threshold,
     )
     out_tokens = np.asarray(out_tokens)
     texts, token_lists = [], []
@@ -78,6 +84,7 @@ def generate_and_post_process(
     top_p_sampling: float = 0.0,
     temperature: float = 1.0,
     random_seed: int = 0,
+    batch_times_seqlen_threshold: int = 512,
     **_unused,
 ):
     """Reference signature compatibility (api.py:19-69)."""
@@ -86,6 +93,7 @@ def generate_and_post_process(
         top_k=top_k_sampling, top_p=top_p_sampling, temperature=temperature,
         greedy=(top_k_sampling == 1), seed=random_seed,
         return_log_probs=return_output_log_probs,
+        batch_times_seqlen_threshold=batch_times_seqlen_threshold,
     )
     segments = [[tokenizer.detokenize([t]) for t in row] for row in tokens]
     return texts, segments, log_probs, tokens

@@ -56,11 +56,24 @@ def _forward_with_cache(model, params, tokens, caches, start_pos):
     return logits, new_caches
 
 
+def _prefill_chunks(b: int, n: int, threshold: Optional[int]) -> int:
+    """Micro-batch count for the prefill forward: smallest divisor C of b
+    with (b/C)*n <= threshold.  Reference ``_with_pipelining_forward_step``
+    (text_generation/forward_step.py:17-204) splits exactly these
+    over-threshold batch*seqlen forwards into micro batches."""
+    if threshold is None or b * n <= threshold or b <= 1:
+        return 1
+    for c in range(2, b + 1):
+        if b % c == 0 and (b // c) * n <= threshold:
+            return c
+    return b
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "min_prompt_len", "top_k",
                      "top_p", "temperature", "greedy", "eod_id",
-                     "return_log_probs"),
+                     "return_log_probs", "batch_times_seqlen_threshold"),
 )
 def generate_tokens(
     model,
@@ -77,8 +90,14 @@ def generate_tokens(
     greedy: bool = False,
     eod_id: Optional[int] = None,
     return_log_probs: bool = False,
+    batch_times_seqlen_threshold: Optional[int] = None,
 ):
-    """Returns (tokens [b, total], gen_lengths [b], log_probs [b, total])."""
+    """Returns (tokens [b, total], gen_lengths [b], log_probs [b, total]).
+
+    ``batch_times_seqlen_threshold``: prefill forwards whose batch*seqlen
+    exceeds it run micro-batched (sequential ``lax.map`` chunks), so the
+    [b, n, vocab] prefill logits are never materialized at once —
+    the reference's ``--inference_batch_times_seqlen_threshold``."""
     cfg = model.cfg
     b, max_prompt = prompt_tokens.shape
     total = max_prompt + max_new_tokens
@@ -92,20 +111,58 @@ def generate_tokens(
 
     # ---- prefill up to the shortest prompt --------------------------------
     prefill = max(min_prompt_len, 1)
-    logits, caches = _forward_with_cache(
-        model, params, tokens[:, :prefill], caches, 0
-    )
-    if return_log_probs:
-        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        # log_probs[i, t] = logp of tokens[i, t] given prefix (t >= 1)
-        picked = jnp.take_along_axis(
-            lp[:, :-1], tokens[:, 1:prefill, None].astype(jnp.int32), axis=-1
-        )[..., 0]
-        log_probs = jax.lax.dynamic_update_slice(
-            log_probs, picked, (0, 1)
+    C = _prefill_chunks(b, prefill, batch_times_seqlen_threshold)
+    if C == 1:
+        logits, caches = _forward_with_cache(
+            model, params, tokens[:, :prefill], caches, 0
         )
+        last_logits = logits[:, -1]
+        if return_log_probs:
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            # log_probs[i, t] = logp of tokens[i, t] given prefix (t >= 1)
+            picked = jnp.take_along_axis(
+                lp[:, :-1], tokens[:, 1:prefill, None].astype(jnp.int32),
+                axis=-1,
+            )[..., 0]
+            log_probs = jax.lax.dynamic_update_slice(log_probs, picked,
+                                                     (0, 1))
+    else:
+        # micro-batched prefill: per-chunk forward reduces its own logits
+        # to (last_logits, picked log-probs) so the full [b, n, vocab]
+        # tensor never exists
+        bc = b // C
+        toks_c = tokens[:, :prefill].reshape(C, bc, prefill)
+        caches_c = [
+            {"k": c["k"].reshape(C, bc, *c["k"].shape[1:]),
+             "v": c["v"].reshape(C, bc, *c["v"].shape[1:]),
+             "index": jnp.broadcast_to(c["index"], (C,))}
+            for c in caches
+        ]
 
-    last_logits = logits[:, -1]
+        def one(chunk):
+            toks_i, caches_i = chunk
+            logits_i, caches_i = _forward_with_cache(
+                model, params, toks_i, caches_i, 0)
+            if return_log_probs:
+                lp_i = jax.nn.log_softmax(logits_i.astype(jnp.float32), -1)
+                picked_i = jnp.take_along_axis(
+                    lp_i[:, :-1], toks_i[:, 1:, None].astype(jnp.int32),
+                    axis=-1)[..., 0]
+            else:
+                picked_i = jnp.zeros((bc, prefill - 1), jnp.float32)
+            return logits_i[:, -1], picked_i, caches_i
+
+        last_c, picked_c, caches_out = jax.lax.map(one, (toks_c, caches_c))
+        last_logits = last_c.reshape(b, -1)
+        if return_log_probs:
+            log_probs = jax.lax.dynamic_update_slice(
+                log_probs, picked_c.reshape(b, prefill - 1), (0, 1))
+        caches = [
+            {"k": c["k"].reshape(b, *c["k"].shape[2:]),
+             "v": c["v"].reshape(b, *c["v"].shape[2:]),
+             "index": c["index"][0]}
+            for c in caches_out
+        ]
 
     # ---- decode loop ------------------------------------------------------
     def cond(state):

@@ -182,3 +182,25 @@ def test_server_contract(model_and_params):
     except urllib.error.HTTPError as e:
         assert e.code == 400
     httpd_holder["srv"].shutdown()
+
+
+def test_microbatched_prefill_matches_monolithic(model_and_params):
+    """batch_times_seqlen_threshold splits the prefill forward into
+    micro-batches (reference forward_step.py:17-204); the generated
+    tokens and log-probs must be identical to the monolithic path."""
+    model, params = model_and_params
+    rng = np.random.RandomState(1)
+    toks = jnp.asarray(rng.randint(1, 64, (4, 8)))
+    lens = jnp.asarray([8, 8, 8, 8], jnp.int32)
+    kw = dict(max_new_tokens=6, min_prompt_len=8, greedy=True,
+              return_log_probs=True)
+    out_a, len_a, lp_a = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(0), **kw)
+    # 4*8=32 > 8 -> 4 chunks of batch 1
+    out_b, len_b, lp_b = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(0),
+        batch_times_seqlen_threshold=8, **kw)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+    np.testing.assert_array_equal(np.asarray(len_a), np.asarray(len_b))
+    np.testing.assert_allclose(np.asarray(lp_a), np.asarray(lp_b),
+                               atol=2e-5)
