@@ -108,15 +108,15 @@ def child_main():
         from megatron_llm_tpu.ops.pallas.rmsnorm import fused_rms_norm
 
         # smoke shapes must match what the bench model will actually
-        # compile (head_dim 80 = 1280/16, seq 2048 -> full-size default
-        # blocks, hidden 1280): a failure specific to those tilings has to
+        # compile (head_dim 128 = 2048/16, seq 2048 -> full-size default
+        # blocks, hidden 2048): a failure specific to those tilings has to
         # surface HERE, where it degrades one kernel, not at model build
         k0 = jax.random.PRNGKey(0)
-        q = jax.random.normal(k0, (1, 2048, 4, 80), jnp.bfloat16)
+        q = jax.random.normal(k0, (1, 2048, 4, 128), jnp.bfloat16)
         smoke("flash_attention", lambda: jax.grad(
             lambda q: flash_attention(q, q, q, causal=True).sum())(q))
-        x = jax.random.normal(k0, (2048, 1280), jnp.bfloat16)
-        s = jnp.ones((1280,), jnp.bfloat16)
+        x = jax.random.normal(k0, (2048, 2048), jnp.bfloat16)
+        s = jnp.ones((2048,), jnp.bfloat16)
         smoke("fused_rmsnorm", lambda: jax.grad(
             lambda x: fused_rms_norm(x, s).sum())(x))
         timers("kernel-smoke").stop()
@@ -132,21 +132,23 @@ def child_main():
     from megatron_llm_tpu.training import build_train_step
 
     if on_tpu:
-        # ~300M llama: big enough for meaningful MFU, small enough that
-        # compile + 1 step completes well inside the parent deadline.
+        # ~650M llama, MXU-aligned head_dim=128: the round-3 shape sweep
+        # (docs/perf_tpu.md) measured 0.41 MFU at h1280/d80 vs 0.516 at
+        # h2048/d128/L10 — head_dim 80 wastes 3/8 of the 128-wide MXU
+        # lanes.  Big enough for meaningful MFU, small enough that
+        # compile + warmup completes well inside the parent deadline.
         cfg = llama_config(
             "tiny",
-            num_layers=16, hidden_size=1280, num_attention_heads=16,
-            ffn_hidden_size=3584, padded_vocab_size=32000,
+            num_layers=10, hidden_size=2048, num_attention_heads=16,
+            ffn_hidden_size=5632, padded_vocab_size=32000,
             seq_length=2048, max_position_embeddings=2048,
             params_dtype="bf16", compute_dtype="bf16",
             recompute_granularity="selective",
             use_flash_attn=use_flash, use_fused_rmsnorm=use_fused_rms,
         )
-        # mb=4 measured best on v5e (0.41 MFU vs 0.39 at mb=8 with the
-        # tuned 1024-block flash kernel; docs/perf_tpu.md)
+        # mb=4 measured best on v5e (mb8 fails remote-compile, mb2 -9%)
         micro_batch, num_micro = 4, 1
-        model_name = "llama-300M"
+        model_name = "llama-650M"
     else:
         cfg = llama_config(
             "tiny",

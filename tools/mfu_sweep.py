@@ -12,11 +12,13 @@ import os
 import sys
 import time
 
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
 import jax, jax.numpy as jnp, numpy as np
 
 jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__))), ".jax_cache"))
+                  os.path.join(ROOT, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 from megatron_llm_tpu.config import ParallelConfig, TrainConfig
@@ -95,6 +97,17 @@ GROUPS = {
         dict(label="seq4096 mb4 xla", seq=4096, mb=4, flash=False),
     ],
 }
+GROUPS["shape"] = [
+    dict(label="h1280 nh16 d80", mb=4),
+    dict(label="h1280 nh10 d128", mb=4, heads=10),
+    dict(label="h2048 nh16 d128 L10 (bench)", mb=4, h=2048, heads=16, ffn=5632, L=10),
+    dict(label="h2048 nh16 d128 L10 mb2", mb=2, h=2048, heads=16, ffn=5632, L=10),
+]
+GROUPS["shape2"] = [
+    dict(label="h2048 L10 mb8", mb=8, h=2048, heads=16, ffn=5632, L=10),
+    dict(label="h2048 L12 mb4", mb=4, h=2048, heads=16, ffn=5632, L=12),
+    dict(label="h2560 nh20 L8 mb4", mb=4, h=2560, heads=20, ffn=6912, L=8),
+]
 GROUPS["all"] = GROUPS["baseline"] + GROUPS["blocks"]
 
 if __name__ == "__main__":
