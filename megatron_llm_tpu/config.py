@@ -153,6 +153,7 @@ class TransformerConfig:
     # divide qk^T by sqrt(head_dim) (standard)
     use_flash_attn: bool = True         # Pallas flash-attention kernel
     use_fused_rmsnorm: bool = True      # Pallas fused RMSNorm kernel
+    use_fused_layernorm: bool = True    # Pallas fused LayerNorm kernel
 
     # --- recompute (reference: transformer.py:1110-1176) ---
     # None | 'uniform' | 'block' | 'selective'

@@ -492,7 +492,10 @@ def transformer_layer(
     norm = lambda h, p: apply_norm(
         h, p, cfg.normalization, eps=cfg.layernorm_epsilon,
         fp32_compute=cfg.norm_in_fp32,
-        use_pallas=cfg.use_fused_rmsnorm and cfg.normalization == "rmsnorm",
+        use_pallas=(
+            (cfg.use_fused_rmsnorm and cfg.normalization == "rmsnorm")
+            or (cfg.use_fused_layernorm and cfg.normalization == "layernorm")
+        ),
     )
 
     residual = x

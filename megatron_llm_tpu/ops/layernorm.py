@@ -84,6 +84,14 @@ def apply_norm(
             return fused_rms_norm(x, params["scale"], eps=eps)
         return rms_norm(x, params["scale"], eps=eps, fp32_compute=fp32_compute)
     elif normalization == "layernorm":
+        # the fused kernel always accumulates in fp32, so it only stands
+        # in for the fp32_compute path (norm_in_fp32=False keeps the jnp
+        # implementation to preserve its numerics)
+        if use_pallas and fp32_compute and params.get("bias") is not None:
+            from megatron_llm_tpu.ops.pallas.layernorm import fused_layer_norm
+
+            return fused_layer_norm(x, params["scale"], params["bias"],
+                                    eps=eps)
         return layer_norm(
             x, params["scale"], params.get("bias"), eps=eps, fp32_compute=fp32_compute
         )

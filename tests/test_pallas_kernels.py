@@ -11,17 +11,20 @@ import numpy as np
 import pytest
 
 import megatron_llm_tpu.ops.pallas.flash_attention as F
+import megatron_llm_tpu.ops.pallas.layernorm as LN
 import megatron_llm_tpu.ops.pallas.rmsnorm as R
-from megatron_llm_tpu.ops.layernorm import rms_norm
+from megatron_llm_tpu.ops.layernorm import layer_norm, rms_norm
 
 
 @pytest.fixture(autouse=True)
 def _interpret():
     F._INTERPRET = True
     R._INTERPRET = True
+    LN._INTERPRET = True
     yield
     F._INTERPRET = False
     R._INTERPRET = False
+    LN._INTERPRET = False
 
 
 def _qkv(b=2, s=128, nh=4, ng=2, d=64, seed=0):
@@ -113,3 +116,25 @@ def test_fused_rmsnorm_bf16_io():
         np.asarray(out, np.float32),
         np.asarray(rms_norm(x, s), np.float32), atol=2e-2,
     )
+
+
+@pytest.mark.parametrize("n,h", [(256, 128), (100, 256)])
+def test_fused_layer_norm_fwd_bwd(n, h):
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(n, h).astype(np.float32))
+    s = jnp.asarray(1.0 + 0.1 * rng.randn(h).astype(np.float32))
+    b = jnp.asarray(0.1 * rng.randn(h).astype(np.float32))
+    g = jnp.asarray(rng.randn(n, h).astype(np.float32))
+
+    y = LN.fused_layer_norm(x, s, b)
+    ref = layer_norm(x, s, b, eps=1e-5, fp32_compute=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+    gp = jax.grad(lambda x, s, b: (LN.fused_layer_norm(x, s, b) * g).sum(),
+                  argnums=(0, 1, 2))(x, s, b)
+    gr = jax.grad(
+        lambda x, s, b: (layer_norm(x, s, b, eps=1e-5,
+                                    fp32_compute=True) * g).sum(),
+        argnums=(0, 1, 2))(x, s, b)
+    for a, r in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r), atol=2e-4)

@@ -1,0 +1,91 @@
+"""Scale-mask-softmax semantics vs the reference CUDA kernel families
+(megatron/fused_kernels/tests/test_fused_kernels.py): padding masks,
+causal (upper-triangular) masks, all-masked rows, dtype round trip."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from megatron_llm_tpu.ops.softmax import (
+    NEG_INF,
+    causal_mask,
+    fused_scale_mask_softmax,
+    sliding_window_mask,
+)
+
+
+def _ref_softmax(scores, mask, scale):
+    s = scores.astype(np.float32)
+    if scale is not None:
+        s = s * scale
+    if mask is not None:
+        s = np.where(mask, NEG_INF, s)
+    s = s - s.max(axis=-1, keepdims=True)
+    e = np.exp(s)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def test_padding_mask_parity():
+    rng = np.random.RandomState(0)
+    scores = rng.randn(2, 4, 8, 8).astype(np.float32)
+    # padding mask: keys 5.. masked for batch 0 (True = masked away)
+    mask = np.zeros((2, 1, 1, 8), bool)
+    mask[0, ..., 5:] = True
+    out = np.asarray(fused_scale_mask_softmax(
+        jnp.asarray(scores, jnp.bfloat16), jnp.asarray(mask), scale=0.5))
+    ref = _ref_softmax(scores, mask, 0.5)
+    assert np.abs(out.astype(np.float32) - ref).max() < 1e-2  # bf16 I/O
+    # masked keys get (numerically) zero probability
+    assert out[0, ..., 5:].max() < 1e-3
+    np.testing.assert_allclose(out.astype(np.float32).sum(-1), 1.0,
+                               atol=2e-2)
+
+
+def test_upper_triangular_parity():
+    rng = np.random.RandomState(1)
+    scores = rng.randn(2, 4, 16, 16).astype(np.float32)
+    mask = np.asarray(causal_mask(16, 16)).astype(bool)
+    out = np.asarray(fused_scale_mask_softmax(
+        jnp.asarray(scores), jnp.asarray(mask)[None, None]))
+    ref = _ref_softmax(scores, mask[None, None], None)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+    # strictly causal: no probability above the diagonal
+    assert out[..., np.triu_indices(16, 1)[0], np.triu_indices(16, 1)[1]] \
+        .max() == 0.0 or np.abs(
+        out * mask[None, None]).max() < 1e-7
+
+
+def test_all_masked_row_is_finite():
+    """The reference kernels emit a uniform distribution for a fully
+    masked row (softmax over all -10000s), never NaN — e.g. the first
+    row under a causal mask with sk > sq history, or a fully padded
+    sample in a batch."""
+    scores = jnp.ones((1, 1, 2, 4), jnp.float32)
+    mask = jnp.ones((1, 1, 2, 4), bool)       # everything masked
+    out = np.asarray(fused_scale_mask_softmax(scores, mask))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, 0.25, atol=1e-6)  # uniform
+
+
+def test_causal_mask_offset_history():
+    """sq < sk: the mask must align the q rows to the END of the key
+    history (incremental decode with a KV cache)."""
+    m = np.asarray(causal_mask(2, 6)).astype(bool)
+    # row 0 attends keys 0..4, row 1 attends keys 0..5
+    assert not m[0, :5].any() and m[0, 5]
+    assert not m[1, :].any()
+
+
+def test_sliding_window_mask():
+    m = np.asarray(sliding_window_mask(8, 8, window=3)).astype(bool)
+    for i in range(8):
+        visible = [j for j in range(8) if not m[i, j]]
+        assert visible == list(range(max(0, i - 2), i + 1))
+
+
+def test_dtype_round_trip():
+    scores = jnp.asarray(np.random.RandomState(2).randn(2, 2, 4, 4),
+                         jnp.bfloat16)
+    out = fused_scale_mask_softmax(scores, None, softmax_in_fp32=True)
+    assert out.dtype == jnp.bfloat16
+    out32 = fused_scale_mask_softmax(scores.astype(jnp.float32), None)
+    assert out32.dtype == jnp.float32
