@@ -171,9 +171,12 @@ def _apply_model_defaults(args, argv):
     (reference: finetune.py passes args_defaults + the model classes
     assert; here the presets make the CLI self-sufficient)."""
     for k, v in MODEL_DEFAULTS[args.model_name].items():
-        flag = _INVERTED_FLAGS.get(k, f"--{k}")
+        flags = [f"--{k}"]
+        if k in _INVERTED_FLAGS:
+            flags.append(_INVERTED_FLAGS[k])
         explicitly_set = any(
-            a == flag or a.startswith(flag + "=") for a in argv
+            a == flag or a.startswith(flag + "=")
+            for a in argv for flag in flags
         )
         if not explicitly_set:
             setattr(args, k, v)
@@ -214,6 +217,7 @@ def main():
             params_template = None      # fall back to host-side restore
         params, opt_state, meta = checkpointing.load_checkpoint(
             args.load, finetune=args.finetune,
+            iteration=getattr(args, "load_iters", None),
             params_template=params_template,
         )
         if params is not None:
@@ -339,12 +343,35 @@ def main():
         custom_step = build_pipeline_train_step(model, optimizer, pc,
                                                 num_micro)
         opt_state = opt_state or optimizer.init(params)
+    from megatron_llm_tpu.timers import Timers
+
+    if args.eval_only:
+        # reference --eval_only: no training, one evaluation pass
+        if pipelined:
+            raise SystemExit(
+                "--eval_only is not supported with pipeline parallelism "
+                "(no forward-only program for the pipelined engine)")
+        if eval_iter is None:
+            raise SystemExit("--eval_only requires validation data")
+        from megatron_llm_tpu.training import build_train_step
+        eval_step = build_train_step(model, optimizer, pc, num_micro,
+                                     forward_only=True)
+        losses = [float(eval_step(params, next(eval_iter), None))
+                  for _ in range(args.eval_iters)]
+        print(f" eval_only: validation loss "
+              f"{sum(losses) / len(losses):.6E}")
+        return
+
     params, opt_state, it = pretrain(
         model, params, tc, pc, train_iter,
         optimizer=optimizer,
         scheduler=scheduler,
         train_step=custom_step,
         save_fn=save_natural,
+        timers=Timers(log_level=args.timing_log_level,
+                      log_option=args.timing_log_option),
+        log_params_norm=args.log_params_norm,
+        log_num_zeros_in_grad=args.log_num_zeros_in_grad,
         log_interval=args.log_interval,
         save_interval=args.save_interval,
         save_dir=args.save,

@@ -69,6 +69,11 @@ def parse_args(
 def _add_network_size_args(parser):
     g = parser.add_argument_group("network size")
     g.add_argument("--num_layers", type=int, default=None)
+    # encoder/decoder split names (reference: arguments.py encoder_num_layers
+    # et al.; num_layers/seq_length fall back to the encoder_* values)
+    g.add_argument("--encoder_num_layers", type=int, default=None)
+    g.add_argument("--decoder_num_layers", type=int, default=None)
+    g.add_argument("--encoder_seq_length", type=int, default=None)
     g.add_argument("--hidden_size", type=int, default=None)
     g.add_argument("--ffn_hidden_size", type=int, default=None)
     g.add_argument("--num_attention_heads", type=int, default=None)
@@ -91,6 +96,10 @@ def _add_network_size_args(parser):
     g.add_argument("--glu_activation", type=str, default=None,
                    choices=[None, "liglu", "geglu", "reglu", "swiglu"])
     g.add_argument("--no_bias", action="store_false", dest="use_bias")
+    g.add_argument("--use_bias", action="store_true", dest="use_bias")
+    g.add_argument("--apply_residual_connection_post_layernorm",
+                   action="store_true", dest="use_post_ln")
+    g.add_argument("--init_method_xavier_uniform", action="store_true")
     g.add_argument("--parallel_attn", action="store_true")
     g.add_argument("--parallel_layernorm", action="store_true")
     g.add_argument("--sliding_window_size", type=int, default=None)
@@ -132,6 +141,12 @@ def _add_training_args(parser):
     g.add_argument("--recompute_granularity", default=None,
                    choices=[None, "full", "uniform", "block", "selective"])
     g.add_argument("--recompute_num_layers", type=int, default=1)
+    # reference spellings: --recompute_activations == selective granularity,
+    # --recompute_method picks the full-layer schedule (validate_args maps)
+    g.add_argument("--recompute_activations", action="store_true")
+    g.add_argument("--recompute_method", default=None,
+                   choices=[None, "uniform", "block"])
+    g.add_argument("--eval_only", action="store_true")
     g.add_argument("--skip_iters", type=int, nargs="*", default=[])
     g.add_argument("--use_flash_attn", action="store_true", default=True)
     g.add_argument("--no_flash_attn", action="store_false",
@@ -168,6 +183,8 @@ def _add_checkpointing_args(parser):
     g.add_argument("--no_save_optim", action="store_true")
     g.add_argument("--no_save_rng", action="store_true")
     g.add_argument("--load", type=str, default=None)
+    g.add_argument("--load_iters", type=int, default=None,
+                   help="load this iteration instead of the tracker's latest")
     g.add_argument("--no_load_optim", action="store_true")
     g.add_argument("--no_load_rng", action="store_true")
     g.add_argument("--finetune", action="store_true")
@@ -185,6 +202,11 @@ def _add_mixed_precision_args(parser):
     g.add_argument("--hysteresis", type=int, default=2)
     g.add_argument("--accumulate_allreduce_grads_in_fp32",
                    action="store_true", default=True)
+    g.add_argument("--attention_softmax_in_fp32", action="store_true",
+                   default=True)
+    g.add_argument("--no_attention_softmax_in_fp32", action="store_false",
+                   dest="attention_softmax_in_fp32")
+
 
 
 def _add_distributed_args(parser):
@@ -224,6 +246,12 @@ def _add_data_args(parser):
     g.add_argument("--vocab_file", type=str, default=None)
     g.add_argument("--merge_file", type=str, default=None)
     g.add_argument("--tokenizer_path", type=str, default=None)
+    # SentencePiece .model file (reference --tokenizer_model; takes
+    # precedence over --vocab_file for SentencePieceTokenizer)
+    g.add_argument("--tokenizer_model", type=str, default=None)
+    g.add_argument("--vocab_extra_ids_list", type=str, default=None,
+                   help="comma-separated literal tokens appended to the "
+                        "vocab as additional special tokens")
     g.add_argument("--vocab_size", type=int, default=None)
     g.add_argument("--vocab_extra_ids", type=int, default=0)
     g.add_argument("--no_new_tokens", action="store_false", dest="new_tokens")
@@ -239,7 +267,22 @@ def _add_logging_args(parser):
     g = parser.add_argument_group("logging")
     g.add_argument("--log_interval", type=int, default=100)
     g.add_argument("--log_timers_to_tensorboard", action="store_true")
-    g.add_argument("--timing_log_level", type=int, default=0, choices=[0, 1, 2])
+    g.add_argument("--timing_log_level", type=int, default=2,
+                   choices=[0, 1, 2],
+                   help="default 2 (reference: 0) — per-phase timers are "
+                        "dispatch-side and effectively free under jit")
+    g.add_argument("--timing_log_option", default="minmax",
+                   choices=["max", "minmax", "all"])
+    g.add_argument("--log_params_norm", action="store_true")
+    g.add_argument("--log_num_zeros_in_grad", action="store_true")
+    g.add_argument("--log_batch_size_to_tensorboard", action="store_true")
+    g.add_argument("--log_memory_to_tensorboard", action="store_true")
+    g.add_argument("--log_world_size_to_tensorboard", action="store_true")
+    g.add_argument("--log_validation_ppl_to_tensorboard",
+                   action="store_true")
+    g.add_argument("--tensorboard_log_interval", type=int, default=1)
+    g.add_argument("--tensorboard_queue_size", type=int, default=1000)
+    g.add_argument("--wandb_resume", action="store_true")
     g.add_argument("--tensorboard_dir", type=str, default=None)
     g.add_argument("--wandb_logger", action="store_true")
     g.add_argument("--wandb_project", type=str, default=None)
@@ -276,6 +319,43 @@ def _add_compat_noop_args(parser):
     g.add_argument("--transformer_impl", default="local")
     g.add_argument("--fp8_e4m3", action="store_true")
     g.add_argument("--fp8_hybrid", action="store_true")
+    g.add_argument("--fp8_margin", type=int, default=0)
+    g.add_argument("--fp8_interval", type=int, default=1)
+    g.add_argument("--fp8_amax_history_len", type=int, default=1)
+    g.add_argument("--fp8_amax_compute_algo", default="most_recent")
+    g.add_argument("--no_fp8_wgrad", action="store_false", dest="fp8_wgrad")
+    g.add_argument("--barrier_with_L1_time", action="store_true",
+                   default=True)
+    g.add_argument("--no_async_tensor_model_parallel_allreduce",
+                   action="store_true")
+    g.add_argument("--no_contiguous_buffers_in_local_ddp",
+                   action="store_false",
+                   dest="use_contiguous_buffers_in_local_ddp")
+    g.add_argument("--no_gradient_accumulation_fusion",
+                   action="store_false", dest="gradient_accumulation_fusion")
+    g.add_argument("--no_persist_layer_norm", action="store_true")
+    g.add_argument("--no_scatter_gather_tensors_in_pipeline",
+                   action="store_true")
+    g.add_argument("--distribute_saved_activations", action="store_true")
+    g.add_argument("--no_data_sharding", action="store_true")
+    g.add_argument("--no_initialization", action="store_false",
+                   dest="perform_initialization")
+    g.add_argument("--use_cpu_initialization", action="store_true")
+    g.add_argument("--standalone_embedding_stage", action="store_true")
+    g.add_argument("--pipeline_model_parallel_split_rank", type=int,
+                   default=None)
+    g.add_argument("--adlr_autoresume", action="store_true")
+    g.add_argument("--adlr_autoresume_interval", type=int, default=1000)
+    # fp32_residual_connection / fp16_lm_cross_entropy: this framework
+    # always keeps the residual stream in the compute dtype and computes
+    # cross entropy in fp32 (better numerics; deliberate deviation)
+    g.add_argument("--fp32_residual_connection", action="store_true")
+    g.add_argument("--fp16_lm_cross_entropy", action="store_true")
+    # query-key layer scaling is an fp16-overflow workaround (divide scores
+    # by layer number, multiply back inside the fused softmax — net
+    # mathematically neutral); softmax here always accumulates in fp32
+    # unless --no_attention_softmax_in_fp32, so the trick has nothing to fix
+    g.add_argument("--no_query_key_layer_scaling", action="store_true")
 
 
 # ---------------------------------------------------------------------------
@@ -314,6 +394,24 @@ def validate_args(args, world_size: Optional[int] = None):
         )
     else:
         args.virtual_pipeline_model_parallel_size = None
+
+    # encoder/decoder spellings fall back onto the canonical names
+    # (reference: arguments.py encoder_num_layers/encoder_seq_length)
+    if args.num_layers is None and args.encoder_num_layers is not None:
+        args.num_layers = args.encoder_num_layers
+    if args.encoder_num_layers is None:
+        args.encoder_num_layers = args.num_layers
+    if args.seq_length is None and args.encoder_seq_length is not None:
+        args.seq_length = args.encoder_seq_length
+    if args.encoder_seq_length is None:
+        args.encoder_seq_length = args.seq_length
+
+    # recompute spellings (reference: --recompute_activations is the
+    # selective policy; --recompute_method schedules full-layer recompute)
+    if args.recompute_activations and args.recompute_granularity is None:
+        args.recompute_granularity = "selective"
+    if args.recompute_method and args.recompute_granularity in (None, "full"):
+        args.recompute_granularity = args.recompute_method
 
     # dtype policy (reference: arguments.py:134-148)
     assert not (args.fp16 and args.bf16)
@@ -379,6 +477,8 @@ def transformer_config_from_args(args, model_name: Optional[str] = None
         hidden_dropout=args.hidden_dropout,
         attention_dropout=args.attention_dropout,
         init_method_std=args.init_method_std,
+        init_method_xavier_uniform=args.init_method_xavier_uniform,
+        attention_softmax_in_fp32=args.attention_softmax_in_fp32,
         params_dtype=args.params_dtype,
         compute_dtype="bf16" if args.bf16 else "fp16" if args.fp16 else "fp32",
         recompute_granularity=args.recompute_granularity,

@@ -134,6 +134,8 @@ class TransformerConfig:
     hidden_dropout: float = 0.1
     attention_dropout: float = 0.1
     init_method_std: float = 0.02
+    # reference --init_method_xavier_uniform: glorot-uniform linear init
+    init_method_xavier_uniform: bool = False
     # divide output-layer init by sqrt(2*num_layers)
     # (reference: --init_method_xavier_uniform absent; scaled init in layers)
     use_scaled_init_method: bool = True

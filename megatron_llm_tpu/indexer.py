@@ -26,14 +26,18 @@ class IndexBuilder:
     def __init__(self, model: BiEncoderModel, params,
                  dataset, embedding_path: str,
                  batch_size: int = 128,
-                 rank: int = 0, world_size: int = 1):
-        """dataset: ICTDataset (uses .samples_mapping + .get_block)."""
+                 rank: int = 0, world_size: int = 1,
+                 log_interval: int = 0):
+        """dataset: ICTDataset (uses .samples_mapping + .get_block).
+        ``log_interval``: progress print every N blocks (reference
+        --indexer_log_interval); 0 disables."""
         self.model = model
         self.params = params
         self.dataset = dataset
         self.batch_size = batch_size
         self.rank = rank
         self.world_size = world_size
+        self.log_interval = log_interval
         self.store = OpenRetrievalDataStore(
             embedding_path, load_from_path=False, rank=rank)
 
@@ -67,6 +71,9 @@ class IndexBuilder:
             ids.append(block_id)
             if len(toks) == self.batch_size:
                 flush()
+            if self.log_interval and (i - lo) % self.log_interval == 0:
+                print(f" > indexer rank {self.rank}: block {i - lo}/"
+                      f"{hi - lo}", flush=True)
         flush()
         self.store.save_shard()
         self.store.clear()  # shard is on disk; merge re-reads every shard

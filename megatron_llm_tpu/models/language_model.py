@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from megatron_llm_tpu.config import TransformerConfig, PositionEmbeddingType
 from megatron_llm_tpu.parallel.layers import (
     init_embedding_params,
+    init_method_for,
     init_method_normal,
     parallel_lm_logits,
     vocab_parallel_embedding,
@@ -43,7 +44,7 @@ def init_language_model_params(key, cfg: TransformerConfig, dtype=None):
     """
     dtype = dtype or cfg.params_jnp_dtype
     k_emb, k_pos, k_stack, k_head = jax.random.split(key, 4)
-    init = init_method_normal(cfg.init_method_std)
+    init = init_method_for(cfg)
     params = {
         "embedding": {
             "word": init_embedding_params(

@@ -42,6 +42,7 @@ from megatron_llm_tpu.ops.softmax import (
 from megatron_llm_tpu.parallel.layers import (
     column_parallel_linear,
     init_linear_params,
+    init_method_for,
     init_method_normal,
     row_parallel_linear,
     scaled_init_method_normal,
@@ -61,7 +62,7 @@ def _qkv_out_dim(cfg: TransformerConfig) -> int:
 
 def init_attention_params(key, cfg: TransformerConfig, dtype):
     k1, k2 = jax.random.split(key)
-    init = init_method_normal(cfg.init_method_std)
+    init = init_method_for(cfg)
     out_init = (
         scaled_init_method_normal(cfg.init_method_std, cfg.num_layers)
         if cfg.use_scaled_init_method
@@ -114,7 +115,7 @@ def init_cross_attention_params(key, cfg: TransformerConfig, dtype):
 
 def init_mlp_params(key, cfg: TransformerConfig, dtype):
     k1, k2 = jax.random.split(key)
-    init = init_method_normal(cfg.init_method_std)
+    init = init_method_for(cfg)
     out_init = (
         scaled_init_method_normal(cfg.init_method_std, cfg.num_layers)
         if cfg.use_scaled_init_method

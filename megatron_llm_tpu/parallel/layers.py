@@ -54,6 +54,21 @@ def init_method_normal(std: float):
     return init
 
 
+def init_method_for(cfg):
+    """Trunk weight init from config: xavier-uniform when the reference's
+    ``--init_method_xavier_uniform`` is set, else normal(std)."""
+    if getattr(cfg, "init_method_xavier_uniform", False):
+        glorot = jax.nn.initializers.glorot_uniform()
+
+        def init(key, shape, dtype=jnp.float32):
+            if len(shape) >= 2:
+                return glorot(key, shape, dtype)
+            return jnp.zeros(shape, dtype)
+
+        return init
+    return init_method_normal(cfg.init_method_std)
+
+
 def scaled_init_method_normal(std: float, num_layers: int):
     scaled = std / math.sqrt(2.0 * num_layers)
     return init_method_normal(scaled)

@@ -32,8 +32,10 @@ def build_tokenizer(args):
             vocab_extra_ids=getattr(args, "vocab_extra_ids", 0),
         )
     elif t == "SentencePieceTokenizer":
+        # reference flag is --tokenizer_model (the .model file); accept
+        # --vocab_file as a fallback spelling
         tokenizer = _SentencePieceTokenizer(
-            args.vocab_file,
+            getattr(args, "tokenizer_model", None) or args.vocab_file,
             vocab_extra_ids=getattr(args, "vocab_extra_ids", 0),
             new_tokens=getattr(args, "new_tokens", True),
         )
@@ -45,6 +47,21 @@ def build_tokenizer(args):
         tokenizer = _NullTokenizer(args.vocab_size)
     else:
         raise NotImplementedError(f"tokenizer type {t!r}")
+
+    extra_list = getattr(args, "vocab_extra_ids_list", None)
+    if extra_list:
+        # reference --vocab_extra_ids_list: literal tokens appended as
+        # additional special tokens (HF-backed tokenizers only)
+        tokens = [s for s in extra_list.split(",") if s]
+        hf = getattr(tokenizer, "_tok", None) or getattr(
+            tokenizer, "_sp", None)
+        if hf is not None and hasattr(hf, "add_special_tokens"):
+            hf.add_special_tokens({"additional_special_tokens": tokens})
+            tokenizer._inv_vocab_cache = None
+        else:
+            raise NotImplementedError(
+                f"--vocab_extra_ids_list is not supported for "
+                f"tokenizer type {t!r}")
 
     args.padded_vocab_size = _vocab_size_with_padding(tokenizer.vocab_size, args)
     return tokenizer

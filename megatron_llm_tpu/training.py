@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from megatron_llm_tpu.config import TrainConfig, TransformerConfig, ParallelConfig
 from megatron_llm_tpu.optimizer import MegatronOptimizer, OptimizerParamScheduler
+from megatron_llm_tpu.optimizer.optimizer import global_grad_norm
 from megatron_llm_tpu import random as mrandom
 from megatron_llm_tpu.global_vars import get_counters
 
@@ -52,6 +53,7 @@ def build_train_step(
     num_microbatches: int,
     loss_func: Callable = default_loss_func,
     forward_only: bool = False,
+    log_num_zeros_in_grad: bool = False,
 ):
     """Compile one global training step.
 
@@ -129,6 +131,11 @@ def build_train_step(
             "loss_scale": stats["loss_scale"],
             "skipped_iter": stats["found_inf"].astype(jnp.int32),
         }
+        if log_num_zeros_in_grad:   # reference --log_num_zeros_in_grad
+            metrics["num zeros"] = sum(
+                jnp.sum(g == 0.0)
+                for g in jax.tree_util.tree_leaves(grads)
+            ).astype(jnp.int32)
         # component losses reported by the loss_func override the total
         # under their own names ("lm loss" stays the true MLM loss for BERT)
         metrics.update({k: jnp.mean(v) for k, v in auxes.items()})
@@ -204,6 +211,8 @@ def pretrain(
     exit_duration_in_mins: Optional[float] = None,
     train_step=None,
     save_fn=None,
+    log_params_norm: bool = False,
+    log_num_zeros_in_grad: bool = False,
 ):
     """Minimal-dependency pretrain loop (the full CLI driver lives in
     ``finetune.py`` / ``pretrain_gpt.py`` at the repo root).
@@ -277,7 +286,8 @@ def pretrain(
             "(no forward-only program exists for it)")
     if not custom_step:
         train_step = build_train_step(
-            model, optimizer, parallel_cfg, num_micro, loss_func
+            model, optimizer, parallel_cfg, num_micro, loss_func,
+            log_num_zeros_in_grad=log_num_zeros_in_grad,
         )
     eval_step = (
         build_train_step(model, optimizer, parallel_cfg, num_micro, loss_func,
@@ -343,6 +353,9 @@ def pretrain(
         counters["tokens"] += tokens
 
         if log_interval and iteration % log_interval == 0:
+            if log_params_norm:     # reference --log_params_norm
+                metrics = dict(metrics)
+                metrics["params norm"] = global_grad_norm(params)
             timers("train-step-sync", log_level=1).start()
             jax.block_until_ready(metrics["lm loss"])
             timers("train-step-sync").stop()

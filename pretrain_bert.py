@@ -34,7 +34,8 @@ def extra_args(parser):
     g = parser.add_argument_group("bert")
     g.add_argument("--bert_no_binary_head", action="store_true",
                    help="disable the sentence-order binary head")
-    g.add_argument("--masked_lm_prob", type=float, default=0.15)
+    g.add_argument("--masked_lm_prob", "--mask_prob",
+                   dest="masked_lm_prob", type=float, default=0.15)
     g.add_argument("--short_seq_prob", type=float, default=0.1)
     return parser
 
@@ -142,7 +143,8 @@ def main():
     opt_state = None
     if args.load:
         params, opt_state, meta = checkpointing.load_checkpoint(
-            args.load, finetune=args.finetune
+            args.load, finetune=args.finetune,
+            iteration=getattr(args, "load_iters", None),
         )
         if params is not None:
             start_iteration = meta["iteration"]
@@ -154,6 +156,21 @@ def main():
         params = jax.tree_util.tree_map(lambda p: p.astype(dt), params)
 
     train_iter = build_data_iterator(args, mesh, num_micro)
+    if getattr(args, "eval_only", False):
+        # reference --eval_only: forward-only pass over the data, no update
+        from megatron_llm_tpu.optimizer import MegatronOptimizer
+        from megatron_llm_tpu.training import build_train_step
+
+        opt = MegatronOptimizer(
+            tc, params_dtype=jax.tree_util.tree_leaves(params)[0].dtype)
+        step = build_train_step(model, opt, pc, num_micro, bert_loss_func,
+                                forward_only=True)
+        losses = [float(step(params, next(train_iter), None))
+                  for _ in range(args.eval_iters)]
+        print(f" eval_only: loss {sum(losses) / len(losses):.6E} over "
+              f"{len(losses)} batches")
+        return
+
     params, opt_state, it = pretrain(
         model, params, tc, pc, train_iter,
         loss_func=bert_loss_func,

@@ -199,7 +199,8 @@ def main():
     opt_state = None
     if args.load:
         params, opt_state, meta = checkpointing.load_checkpoint(
-            args.load, finetune=args.finetune
+            args.load, finetune=args.finetune,
+            iteration=getattr(args, "load_iters", None),
         )
         if params is not None:
             start_iteration = meta["iteration"]
@@ -211,6 +212,21 @@ def main():
         params = jax.tree_util.tree_map(lambda p: p.astype(dt), params)
 
     train_iter = build_data_iterator(args, mesh, num_micro)
+    if getattr(args, "eval_only", False):
+        # reference --eval_only: forward-only pass over the data, no update
+        from megatron_llm_tpu.optimizer import MegatronOptimizer
+        from megatron_llm_tpu.training import build_train_step
+
+        opt = MegatronOptimizer(
+            tc, params_dtype=jax.tree_util.tree_leaves(params)[0].dtype)
+        step = build_train_step(model, opt, pc, num_micro, ict_loss_func,
+                                forward_only=True)
+        losses = [float(step(params, next(train_iter), None))
+                  for _ in range(args.eval_iters)]
+        print(f" eval_only: loss {sum(losses) / len(losses):.6E} over "
+              f"{len(losses)} batches")
+        return
+
     params, opt_state, it = pretrain(
         model, params, tc, pc, train_iter,
         loss_func=ict_loss_func,

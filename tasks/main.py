@@ -35,14 +35,26 @@ def get_tasks_args(parser):
     g.add_argument("--strict_lambada", action="store_true")
     g.add_argument("--qa_data_dev", default=None)
     g.add_argument("--qa_data_test", default=None)
-    g.add_argument("--embedding_path", default=None)
+    g.add_argument("--embedding_path", "--block_data_path",
+                   dest="embedding_path", default=None)
+    g.add_argument("--evidence_data_path", default=None,
+                   help="evidence blocks for retrieval (falls back to "
+                        "--data_path)")
+    g.add_argument("--retriever_seq_length", type=int, default=None,
+                   help="block seq length for retrieval (default: "
+                        "--seq_length)")
+    g.add_argument("--bert_load", default=None)
+    g.add_argument("--ict_load", default=None)
+    g.add_argument("--indexer_batch_size", type=int, default=128)
+    g.add_argument("--indexer_log_interval", type=int, default=1000)
     g.add_argument("--faiss_match", default="string",
                    choices=["regex", "string"])
     g.add_argument("--faiss_topk_retrievals", type=int, default=100)
     g.add_argument("--eval_micro_batch_size", type=int, default=None)
     g.add_argument("--titles_data_path", default=None)
     g.add_argument("--use_one_sent_docs", action="store_true")
-    g.add_argument("--biencoder_projection_dim", type=int, default=0)
+    g.add_argument("--biencoder_projection_dim", "--ict_head_size",
+                   dest="biencoder_projection_dim", type=int, default=0)
     g.add_argument("--biencoder_shared_query_context_model",
                    action="store_true")
     g.add_argument("--retriever_report_topk_accuracies", nargs="*",

@@ -142,8 +142,10 @@ def main():
             args, "biencoder_shared_query_context_model", False),
     )
     params = None
-    if args.load:
-        params, _, _ = checkpointing.load_checkpoint(args.load,
+    load_dir = args.load or getattr(args, "ict_load", None) \
+        or getattr(args, "bert_load", None)
+    if load_dir:
+        params, _, _ = checkpointing.load_checkpoint(load_dir,
                                                      finetune=True)
     if params is None:
         print(" > WARNING: evaluating a randomly initialized retriever",
@@ -154,16 +156,18 @@ def main():
     from megatron_llm_tpu.data.dataset_utils import get_indexed_dataset_
     from megatron_llm_tpu.data.ict_dataset import ICTDataset
 
-    blocks = get_indexed_dataset_(args.data_path[0]
-                                  if isinstance(args.data_path, list)
-                                  else args.data_path)
+    evidence = getattr(args, "evidence_data_path", None) or (
+        args.data_path[0] if isinstance(args.data_path, list)
+        else args.data_path)
+    blocks = get_indexed_dataset_(evidence)
     titles = get_indexed_dataset_(args.titles_data_path)
     ict = ICTDataset(
         name="full", block_dataset=blocks, title_dataset=titles,
-        data_prefix=(args.data_path[0] if isinstance(args.data_path, list)
-                     else args.data_path),
+        data_prefix=evidence,
         num_epochs=1, max_num_samples=None,
-        max_seq_length=args.seq_length, query_in_block_prob=1.0,
+        max_seq_length=(getattr(args, "retriever_seq_length", None)
+                        or args.seq_length),
+        query_in_block_prob=1.0,
         seed=1, tokenizer=tokenizer,
         use_one_sent_docs=getattr(args, "use_one_sent_docs", False))
 

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Build the REALM/ORQA evidence-block embedding index.
+
+Reference: ``megatron/indexer.py`` IndexBuilder driven with the reference
+flag names (``--ict_load``, ``--indexer_batch_size``,
+``--indexer_log_interval``, ``--block_data_path`` /
+``--embedding_path``, ``--evidence_data_path``): embed every evidence
+block with the context tower of a trained BiEncoder and write the
+embeddings store consumed by ``tasks/main.py --task=ORQA``.
+
+Usage:
+    python tools/create_doc_index.py --model_name=bert \\
+        --evidence_data_path=/data/wiki_blocks \\
+        --titles_data_path=/data/wiki_titles \\
+        --ict_load=/ckpts/ict --embedding_path=/data/block_emb.pkl \\
+        --tokenizer_type=BertWordPieceLowerCase --vocab_file=vocab.txt \\
+        --num_layers=12 --hidden_size=768 --num_attention_heads=12 \\
+        --seq_length=256 --max_position_embeddings=512
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def extra_args(parser):
+    g = parser.add_argument_group("indexer")
+    g.add_argument("--evidence_data_path", default=None,
+                   help="indexed dataset of evidence blocks (falls back "
+                        "to --data_path)")
+    g.add_argument("--titles_data_path", required=True)
+    g.add_argument("--embedding_path", "--block_data_path",
+                   dest="embedding_path", required=True,
+                   help="output embeddings store (reference spells this "
+                        "--block_data_path)")
+    g.add_argument("--ict_load", default=None,
+                   help="ICT/biencoder checkpoint (falls back to --load)")
+    g.add_argument("--bert_load", default=None,
+                   help="pretrained BERT trunk when no biencoder ckpt")
+    g.add_argument("--indexer_batch_size", type=int, default=128)
+    g.add_argument("--indexer_log_interval", type=int, default=1000)
+    g.add_argument("--retriever_seq_length", type=int, default=256)
+    g.add_argument("--ict_head_size", "--biencoder_projection_dim",
+                   dest="biencoder_projection_dim", type=int, default=0)
+    g.add_argument("--biencoder_shared_query_context_model",
+                   action="store_true")
+    g.add_argument("--use_one_sent_docs", action="store_true")
+    g.add_argument("--model_name", default="bert")  # config preset only
+    return parser
+
+
+def main():
+    import jax
+
+    from megatron_llm_tpu import checkpointing
+    from megatron_llm_tpu.arguments import transformer_config_from_args
+    from megatron_llm_tpu.data.dataset_utils import get_indexed_dataset_
+    from megatron_llm_tpu.data.ict_dataset import ICTDataset
+    from megatron_llm_tpu.global_vars import get_tokenizer
+    from megatron_llm_tpu.indexer import IndexBuilder
+    from megatron_llm_tpu.initialize import initialize_megatron
+    from megatron_llm_tpu.models.biencoder import BiEncoderModel
+
+    args = initialize_megatron(extra_args_provider=extra_args)
+    tokenizer = get_tokenizer()
+
+    cfg = transformer_config_from_args(args)
+    model = BiEncoderModel(
+        cfg,
+        projection_dim=args.biencoder_projection_dim,
+        shared_query_context=args.biencoder_shared_query_context_model,
+    )
+    load_dir = args.ict_load or args.load or args.bert_load
+    params = None
+    if load_dir:
+        params, _, _ = checkpointing.load_checkpoint(load_dir, finetune=True)
+    if params is None:
+        print(" > WARNING: indexing with a randomly initialized biencoder",
+              flush=True)
+        params = model.init(jax.random.PRNGKey(args.seed))
+
+    evidence = args.evidence_data_path or (
+        args.data_path[0] if args.data_path else None)
+    if evidence is None:
+        raise SystemExit("need --evidence_data_path or --data_path")
+    blocks = get_indexed_dataset_(evidence)
+    titles = get_indexed_dataset_(args.titles_data_path)
+    ict = ICTDataset(
+        name="index", block_dataset=blocks, title_dataset=titles,
+        data_prefix=evidence, num_epochs=1, max_num_samples=None,
+        max_seq_length=args.retriever_seq_length, query_in_block_prob=1.0,
+        seed=1, tokenizer=tokenizer,
+        use_one_sent_docs=args.use_one_sent_docs,
+    )
+    builder = IndexBuilder(
+        model, params, ict, args.embedding_path,
+        batch_size=args.indexer_batch_size,
+        log_interval=args.indexer_log_interval,
+    )
+    builder.build_and_save_index()
+    print(f" > wrote block embeddings to {args.embedding_path}")
+
+
+if __name__ == "__main__":
+    main()
