@@ -108,6 +108,13 @@ GROUPS["shape2"] = [
     dict(label="h2048 L12 mb4", mb=4, h=2048, heads=16, ffn=5632, L=12),
     dict(label="h2560 nh20 L8 mb4", mb=4, h=2560, heads=20, ffn=6912, L=8),
 ]
+GROUPS["tune650"] = [
+    dict(label="650M bq1024 bk1024 (bench)", mb=4, h=2048, heads=16, ffn=5632, L=10),
+    dict(label="650M bq512 bk1024", mb=4, h=2048, heads=16, ffn=5632, L=10, bq=512, bk=1024),
+    dict(label="650M bq1024 bk512", mb=4, h=2048, heads=16, ffn=5632, L=10, bq=1024, bk=512),
+    dict(label="650M remat full", mb=4, h=2048, heads=16, ffn=5632, L=10, remat="full"),
+    dict(label="650M mb6", mb=6, h=2048, heads=16, ffn=5632, L=10),
+]
 GROUPS["all"] = GROUPS["baseline"] + GROUPS["blocks"]
 
 if __name__ == "__main__":
