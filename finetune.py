@@ -18,6 +18,7 @@ Usage mirrors the reference (``docs/guide/getting_started.md``):
 
 from __future__ import annotations
 
+import os
 import sys
 
 import jax
@@ -345,6 +346,24 @@ def main():
         opt_state = opt_state or optimizer.init(params)
     from megatron_llm_tpu.timers import Timers
 
+    # metrics writer: wandb (or its JSONL offline fallback) and/or a
+    # tensorboard-dir JSONL stream — one add_scalar code path either way
+    writer = None
+    if args.wandb_logger or args.tensorboard_dir:
+        from megatron_llm_tpu.wandb_logger import WandbTBShim
+
+        fallback = (os.path.join(args.tensorboard_dir, "metrics.jsonl")
+                    if args.tensorboard_dir else "wandb_offline.jsonl")
+        if args.tensorboard_dir:
+            os.makedirs(args.tensorboard_dir, exist_ok=True)
+        writer = WandbTBShim(
+            config=checkpointing.config_to_args(getattr(model, "cfg", None)),
+            project=args.wandb_project, entity=args.wandb_entity,
+            name=args.wandb_name, run_id=args.wandb_id,
+            api_key=args.wandb_api_key, fallback_path=fallback,
+            resume="must" if args.wandb_resume else "allow",
+            force_offline=not args.wandb_logger)
+
     if args.eval_only:
         # reference --eval_only: no training, one evaluation pass
         if pipelined:
@@ -372,6 +391,12 @@ def main():
                       log_option=args.timing_log_option),
         log_params_norm=args.log_params_norm,
         log_num_zeros_in_grad=args.log_num_zeros_in_grad,
+        writer=writer,
+        tensorboard_log_interval=args.tensorboard_log_interval,
+        log_memory=args.log_memory_to_tensorboard,
+        log_batch_size=args.log_batch_size_to_tensorboard,
+        log_world_size=args.log_world_size_to_tensorboard,
+        log_validation_ppl=args.log_validation_ppl_to_tensorboard,
         log_interval=args.log_interval,
         save_interval=args.save_interval,
         save_dir=args.save,

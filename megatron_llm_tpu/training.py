@@ -213,6 +213,12 @@ def pretrain(
     save_fn=None,
     log_params_norm: bool = False,
     log_num_zeros_in_grad: bool = False,
+    writer=None,
+    tensorboard_log_interval: int = 1,
+    log_memory: bool = False,
+    log_batch_size: bool = False,
+    log_world_size: bool = False,
+    log_validation_ppl: bool = False,
 ):
     """Minimal-dependency pretrain loop (the full CLI driver lives in
     ``finetune.py`` / ``pretrain_gpt.py`` at the repo root).
@@ -362,12 +368,40 @@ def pretrain(
             now = time.perf_counter()
             elapsed = (now - last_time) / log_interval
             last_time = now
+            # --tensorboard_log_interval is an absolute iteration
+            # interval (reference semantics); metrics only exist at log
+            # boundaries, so the effective cadence is their intersection
+            use_writer = (writer if writer is not None
+                          and iteration % max(tensorboard_log_interval, 1)
+                          == 0 else None)
+            if use_writer is not None:
+                # reference --log_*_to_tensorboard extras
+                # (training.py:509-589)
+                if log_batch_size:
+                    use_writer.add_scalar("batch-size",
+                                          train_cfg.global_batch_size,
+                                          iteration)
+                if log_world_size:
+                    use_writer.add_scalar("world-size",
+                                          jax.device_count(), iteration)
+                if log_memory:
+                    stats = jax.local_devices()[0].memory_stats() or {}
+                    use_writer.add_scalar(
+                        "mem-bytes-in-use",
+                        stats.get("bytes_in_use", 0), iteration)
             training_log(
                 iteration, train_cfg.train_iters,
                 {k: float(v) for k, v in metrics.items()},
                 elapsed, tokens, lr,
+                writer=use_writer,
             )
+            if use_writer is not None:
+                # write() before log(): log() resets the accumulators
+                timers.write(timers.names(), use_writer, iteration,
+                             normalizer=log_interval)
             timers.log(normalizer=log_interval)
+            if use_writer is not None and hasattr(use_writer, "flush"):
+                use_writer.flush()
             if on_metrics is not None:
                 on_metrics(iteration, metrics)
 
@@ -378,8 +412,16 @@ def pretrain(
                 eval_batch = next(eval_iterator)
                 losses.append(float(eval_step(params, eval_batch, None)))
             timers("eval-time").stop()
-            print(f" validation loss at iteration {iteration}: "
-                  f"{sum(losses) / len(losses):.6E}")
+            val = sum(losses) / len(losses)
+            print(f" validation loss at iteration {iteration}: {val:.6E}")
+            if writer is not None:
+                writer.add_scalar("validation loss", val, iteration)
+                if log_validation_ppl:   # reference --log_validation_ppl...
+                    import math
+                    writer.add_scalar("validation ppl", math.exp(min(val, 20.0)),
+                                      iteration)
+                if hasattr(writer, "flush"):
+                    writer.flush()
 
         saved = False
         if save_interval and save_dir and iteration % save_interval == 0:

@@ -23,18 +23,31 @@ class WandbTBShim:
                  entity: Optional[str] = None, name: Optional[str] = None,
                  run_id: Optional[str] = None,
                  api_key: Optional[str] = None,
-                 fallback_path: str = "wandb_offline.jsonl"):
+                 fallback_path: str = "wandb_offline.jsonl",
+                 resume: str = "allow",
+                 force_offline: bool = False):
+        # force_offline: write the JSONL stream directly without trying
+        # wandb (--tensorboard_dir without --wandb_logger must produce the
+        # file the user asked for, not a surprise wandb run)
         self._wandb = None
         self._file = None
-        try:
-            import wandb  # noqa: F401
+        if not force_offline:
+            try:
+                import wandb  # noqa: F401
 
-            if api_key:
-                os.environ.setdefault("WANDB_API_KEY", api_key)
-            self._wandb = wandb
-            self._run = wandb.init(project=project, entity=entity, name=name,
-                                   id=run_id, resume="allow", config=config)
-        except Exception:
+                if api_key:
+                    os.environ.setdefault("WANDB_API_KEY", api_key)
+                self._wandb = wandb
+                self._run = wandb.init(project=project, entity=entity,
+                                       name=name, id=run_id, resume=resume,
+                                       config=config)
+            except Exception:
+                if resume == "must":
+                    # --wandb_resume explicitly demanded that run; silently
+                    # degrading to the offline file would fake a resume
+                    raise
+                self._wandb = None
+        if self._wandb is None:
             self._file = open(fallback_path, "a", buffering=1)
             self._file.write(json.dumps({"event": "init", "config": config,
                                          "time": time.time()}) + "\n")

@@ -111,3 +111,31 @@ def test_timers_logged_at_log_interval(utils, capsys):
     out = capsys.readouterr().out
     assert "time (ms)" in out
     assert "train-step" in out
+
+
+def test_writer_receives_metrics_and_extras(utils):
+    """The tensorboard/wandb writer path (reference training.py:509-589):
+    per-iteration scalars, the --log_*_to_tensorboard extras, and timer
+    values (written before the log-reset) all reach add_scalar."""
+    model, params, it = _setup(utils)
+    pc = ParallelConfig()
+
+    class FakeWriter:
+        def __init__(self):
+            self.rows = {}
+
+        def add_scalar(self, key, value, iteration):
+            self.rows.setdefault(iteration, {})[key] = float(value)
+
+        def flush(self):
+            pass
+
+    w = FakeWriter()
+    pretrain(model, params, _tc(2), pc, it(), log_interval=1, writer=w,
+             log_batch_size=True, log_world_size=True, log_memory=True)
+    assert set(w.rows) == {1, 2}
+    row = w.rows[1]
+    assert row["batch-size"] == 8.0
+    assert "world-size" in row and "mem-bytes-in-use" in row
+    assert "lm loss" in row and "learning_rate" in row
+    assert row.get("train-step-time", 0) > 0   # written before the reset
