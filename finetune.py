@@ -183,9 +183,62 @@ def _apply_model_defaults(args, argv):
             setattr(args, k, v)
 
 
+# checkpoint-args field -> CLI args attribute (reference checkpointing.py
+# _set_arg list; config_to_args writes the config-field spellings)
+_CKPT_ARG_MAP = {
+    "num_layers": "num_layers",
+    "hidden_size": "hidden_size",
+    "ffn_hidden_size": "ffn_hidden_size",
+    "num_attention_heads": "num_attention_heads",
+    "num_attention_heads_kv": "num_attention_heads_kv",
+    "kv_channels": "kv_channels",
+    "seq_length": "seq_length",
+    "max_position_embeddings": "max_position_embeddings",
+    "padded_vocab_size": "padded_vocab_size",
+    "position_embedding_type": "position_embedding_type",
+    "glu_activation": "glu_activation",
+    "tie_embed_logits": "tie_embed_logits",
+    "add_bias_linear": "use_bias",
+    "use_post_ln": "use_post_ln",
+    "parallel_attn": "parallel_attn",
+    "parallel_layernorm": "parallel_layernorm",
+    "sliding_window_size": "sliding_window_size",
+    "layernorm_epsilon": "layernorm_epsilon",
+    "rope_theta": "rope_theta",
+    "rope_scaling_factor": "rope_scaling_factor",
+}
+
+
+def _apply_checkpoint_args(args):
+    """--use_checkpoint_args: the architecture recorded in the checkpoint
+    overrides the CLI (reference checkpointing.py:520-560)."""
+    ckpt_args = checkpointing.load_checkpoint_args(
+        args.load, getattr(args, "load_iters", None))
+    if not ckpt_args:
+        print(" > WARNING: --use_checkpoint_args but the checkpoint "
+              "records no args", flush=True)
+        return
+    for src, dst in _CKPT_ARG_MAP.items():
+        # no is-not-None filter: a recorded null is a real override
+        # (e.g. glu_activation=None must clear a model preset's swiglu,
+        # or the restored MLP shapes mismatch the checkpoint)
+        if src in ckpt_args:
+            setattr(args, dst, ckpt_args[src])
+    if ckpt_args.get("normalization") is not None:
+        args.use_rms_norm = ckpt_args["normalization"] == "rmsnorm"
+    print(" > using architecture args from the checkpoint", flush=True)
+
+
 def main():
     args = initialize_megatron(extra_args_provider=extra_args)
     _apply_model_defaults(args, sys.argv[1:])
+    if args.use_checkpoint_args and args.load:
+        _apply_checkpoint_args(args)
+        # re-derive and re-assert everything validate_args computed from
+        # the CLI architecture (vpp divisibility, encoder_* backfills...)
+        # against the overridden values
+        from megatron_llm_tpu.arguments import validate_args
+        validate_args(args)
     if args.padded_vocab_size is None:
         raise SystemExit("need --vocab_size/--padded_vocab_size or a tokenizer")
 

@@ -117,6 +117,24 @@ def save_checkpoint(
     return str(ckpt_dir)
 
 
+def load_checkpoint_args(load_dir: str,
+                         iteration: Optional[int] = None) -> dict:
+    """The 'args' dict recorded in a checkpoint's meta.json, without
+    loading any tensors (reference --use_checkpoint_args,
+    checkpointing.py:520-560 reads args from the state dict)."""
+    release = False
+    if iteration is None:
+        iteration, release = read_tracker(load_dir)
+        if iteration is None and not release:
+            return {}
+    ckpt_dir = Path(get_checkpoint_name(load_dir, iteration or 0, release))
+    meta_path = ckpt_dir / "meta.json"
+    if not meta_path.exists():
+        return {}
+    with open(meta_path) as f:
+        return json.load(f).get("args") or {}
+
+
 def read_tracker(load_dir: str) -> Tuple[Optional[int], bool]:
     # reference: checkpointing.py:570-607
     tracker = get_checkpoint_tracker_filename(load_dir)

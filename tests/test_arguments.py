@@ -95,3 +95,34 @@ def test_reference_launch_flags_accepted():
     assert a.log_params_norm and a.log_num_zeros_in_grad
     assert a.load_iters == 7 and a.eval_only
     assert a.timing_log_option == "max"
+
+
+def test_use_checkpoint_args_overrides_cli(tmp_path):
+    """--use_checkpoint_args: architecture recorded in the checkpoint wins
+    over the CLI (reference checkpointing.py:520-560)."""
+    import jax
+
+    from finetune import _apply_checkpoint_args
+    from megatron_llm_tpu import checkpointing
+    from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+
+    cfg = llama_config("tiny", num_layers=2, hidden_size=64,
+                       num_attention_heads=4, ffn_hidden_size=96,
+                       padded_vocab_size=128, seq_length=32,
+                       max_position_embeddings=32)
+    model = LlamaModel(cfg)
+    checkpointing.save_checkpoint(
+        str(tmp_path), 3, model.init(jax.random.PRNGKey(0)),
+        args=checkpointing.config_to_args(cfg))
+
+    a = _args("--num_layers=6", "--hidden_size=32",
+              "--num_attention_heads=2", "--seq_length=16",
+              "--micro_batch_size=1")
+    a.load = str(tmp_path)
+    a.load_iters = None
+    _apply_checkpoint_args(a)
+    assert a.num_layers == 2
+    assert a.hidden_size == 64
+    assert a.num_attention_heads == 4
+    assert a.use_rms_norm is True
+    assert a.use_bias is False
