@@ -98,3 +98,36 @@ def test_model_cp_with_tp(utils):
         ps, jax.device_put(tokens, dsh), jax.device_put(labels, dsh)
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(base), atol=3e-5)
+
+
+@pytest.mark.parametrize("window", [None, 48])
+def test_ring_q_chunked_matches_full(utils, window):
+    """q_chunk_size < s_local (the long-context memory mode: per-step
+    scores shrink from [s,s] to [qc,s]) is bit-for-math identical."""
+    utils.initialize_model_parallel(tp=1, pp=1, cp=4)
+    q, k, v = _qkv()                                  # local s = 32
+    ref = _reference_attention(q, k, v, True, window, 0.125)
+    out = jax.jit(
+        lambda q, k, v: context_parallel_attention(
+            q, k, v, causal=True, sliding_window=window,
+            softmax_scale=0.125, q_chunk_size=8)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_q_chunked_gradients(utils):
+    utils.initialize_model_parallel(tp=1, pp=1, cp=4)
+    q, k, v = _qkv(s=64)                              # local s = 16
+
+    def loss_ref(q, k, v):
+        return (_reference_attention(q, k, v, True, None, 0.125) ** 2).sum()
+
+    def loss_ring(q, k, v):
+        return (context_parallel_attention(
+            q, k, v, causal=True, softmax_scale=0.125,
+            q_chunk_size=4) ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
