@@ -151,6 +151,10 @@ def _add_training_args(parser):
     g.add_argument("--use_flash_attn", action="store_true", default=True)
     g.add_argument("--no_flash_attn", action="store_false",
                    dest="use_flash_attn")
+    # opt-in chunked head+CE for very large vocabularies (docs/perf_tpu.md
+    # records why it is off by default at 32k)
+    g.add_argument("--fused_lm_cross_entropy", action="store_true")
+    g.add_argument("--fused_ce_chunk_size", type=int, default=8192)
 
 
 def _add_initialization_args(parser):
@@ -485,6 +489,8 @@ def transformer_config_from_args(args, model_name: Optional[str] = None
         recompute_num_layers=args.recompute_num_layers,
         lima_dropout=args.lima_dropout,
         use_flash_attn=args.use_flash_attn,
+        fused_lm_cross_entropy=args.fused_lm_cross_entropy,
+        fused_ce_chunk_size=args.fused_ce_chunk_size,
     )
 
 

@@ -20,7 +20,21 @@ from megatron_llm_tpu.models.language_model import (
     language_model_param_specs,
     flops_per_token,
 )
-from megatron_llm_tpu.ops.cross_entropy import vocab_parallel_cross_entropy
+from megatron_llm_tpu.ops.cross_entropy import (
+    fused_linear_cross_entropy,
+    vocab_parallel_cross_entropy,
+)
+
+
+def _vocab_unsharded() -> bool:
+    """True when the head is not vocab-sharded (no tp axis in play), so
+    the fused chunked CE can slice the full weight locally."""
+    from megatron_llm_tpu import topology
+
+    try:
+        return topology.get_tensor_model_parallel_world_size() == 1
+    except RuntimeError:                  # mesh not initialized:
+        return True                       # single-device path
 
 
 class GPTModel:
@@ -59,6 +73,25 @@ class GPTModel:
     ):
         """Returns per-token loss [b, s] when labels given, else logits
         [b, s, V] (reference: gpt_model.py:82-100)."""
+        cfg = self.cfg
+        if (labels is not None and kv_caches is None
+                and cfg.fused_lm_cross_entropy and _vocab_unsharded()):
+            # fused head+CE over vocab chunks: the [b, s, V] logits are
+            # never materialized (ops/cross_entropy.py)
+            h = language_model_forward(
+                params, tokens, position_ids, attention_mask, cfg,
+                rng_key=rng_key, train=train,
+                sequence_parallel=sequence_parallel,
+                compute_logits=False,
+            )
+            head = (
+                params["lm_head"]["weight"] if "lm_head" in params
+                else params["embedding"]["word"]["embedding"]
+            )
+            return fused_linear_cross_entropy(
+                h, head.astype(cfg.compute_jnp_dtype), labels,
+                chunk_size=cfg.fused_ce_chunk_size,
+            )
         out = language_model_forward(
             params, tokens, position_ids, attention_mask, self.cfg,
             rng_key=rng_key, train=train, sequence_parallel=sequence_parallel,
