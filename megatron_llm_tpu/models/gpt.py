@@ -19,6 +19,7 @@ from megatron_llm_tpu.models.language_model import (
     language_model_forward,
     language_model_param_specs,
     flops_per_token,
+    lm_head_weight,
 )
 from megatron_llm_tpu.ops.cross_entropy import (
     fused_linear_cross_entropy,
@@ -84,10 +85,7 @@ class GPTModel:
                 sequence_parallel=sequence_parallel,
                 compute_logits=False,
             )
-            head = (
-                params["lm_head"]["weight"] if "lm_head" in params
-                else params["embedding"]["word"]["embedding"]
-            )
+            head = lm_head_weight(params)
             return fused_linear_cross_entropy(
                 h, head.astype(cfg.compute_jnp_dtype), labels,
                 chunk_size=cfg.fused_ce_chunk_size,

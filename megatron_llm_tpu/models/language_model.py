@@ -286,6 +286,15 @@ def embedding_forward(
     return h
 
 
+def lm_head_weight(params) -> jax.Array:
+    """[V, H] logits weight: the untied ``lm_head`` when present, else the
+    tied word-embedding table (reference: language_model.py:24-53 picks the
+    same way inside parallel_lm_logits' callers)."""
+    if "lm_head" in params:
+        return params["lm_head"]["weight"]
+    return params["embedding"]["word"]["embedding"]
+
+
 def language_model_forward(
     params,
     tokens: jax.Array,
@@ -338,11 +347,7 @@ def language_model_forward(
     if not compute_logits:
         return (h, new_caches) if kv_caches is not None else h
 
-    head = (
-        params["lm_head"]["weight"]
-        if "lm_head" in params
-        else params["embedding"]["word"]["embedding"]
-    )
+    head = lm_head_weight(params)
     logits = parallel_lm_logits(
         h, head,
         sequence_parallel=sequence_parallel,

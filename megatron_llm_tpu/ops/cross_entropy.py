@@ -157,9 +157,24 @@ def shard_vocab_parallel_max_indices(
 # ---------------------------------------------------------------------------
 
 def _flce_pick_chunk(v: int, chunk: int) -> int:
+    """Largest divisor of ``v`` that is <= ``chunk``.
+
+    Guards against flag misuse: ``chunk`` must be positive, and if the
+    vocab has no divisor anywhere near the request (e.g. an unpadded
+    prime-ish vocab whose best divisor is tiny) the scan would silently
+    serialize into thousands of micro-matmuls — refuse instead and tell
+    the user to pad the vocab (``--make_vocab_size_divisible_by`` already
+    pads to a 128 multiple on the normal path)."""
+    if chunk < 1:
+        raise ValueError(f"fused_ce_chunk_size must be >= 1, got {chunk}")
     c = min(chunk, v)
     while v % c != 0:
         c -= 1
+    if c < min(chunk, v) // 16:
+        raise ValueError(
+            f"vocab size {v} has no divisor near chunk_size {chunk} "
+            f"(best is {c}); pad the vocab to a multiple of 128 or pick "
+            f"a chunk_size that divides it")
     return c
 
 

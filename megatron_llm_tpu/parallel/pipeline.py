@@ -79,7 +79,10 @@ from jax.sharding import PartitionSpec as P
 
 from megatron_llm_tpu import topology
 from megatron_llm_tpu.config import TransformerConfig
-from megatron_llm_tpu.models.language_model import embedding_forward
+from megatron_llm_tpu.models.language_model import (
+    embedding_forward,
+    lm_head_weight,
+)
 from megatron_llm_tpu.models.transformer import rotary_freqs, transformer_layer
 from megatron_llm_tpu.ops.cross_entropy import vocab_parallel_cross_entropy
 from megatron_llm_tpu.ops.layernorm import apply_norm
@@ -259,11 +262,7 @@ def build_pipeline_loss_fn(
         mesh = topology.get_mesh()
         emb_p = params["embedding"]
         trans = params["transformer"]
-        head_w = (
-            params["lm_head"]["weight"]
-            if "lm_head" in params
-            else emb_p["word"]["embedding"]
-        )
+        head_w = lm_head_weight(params)
         freqs = rotary_freqs(cfg)
         tokens, labels, loss_mask = (
             batch["tokens"], batch["labels"], batch["loss_mask"],
@@ -422,10 +421,7 @@ def build_pipeline_grad_fn(
         emb_p = params["embedding"]
         trans = params["transformer"]
         untied = "lm_head" in params
-        head_w = (
-            params["lm_head"]["weight"] if untied
-            else emb_p["word"]["embedding"]
-        )
+        head_w = lm_head_weight(params)
         freqs = rotary_freqs(cfg)
         tokens, labels, loss_mask = (
             batch["tokens"], batch["labels"], batch["loss_mask"],

@@ -95,3 +95,18 @@ def test_model_loss_parity_fused_vs_unfused(utils):
     for a, b in zip(jax.tree_util.tree_leaves(gf),
                     jax.tree_util.tree_leaves(gu)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_pick_chunk_guards():
+    from megatron_llm_tpu.ops.cross_entropy import _flce_pick_chunk
+
+    assert _flce_pick_chunk(32000, 8192) == 8000
+    assert _flce_pick_chunk(96, 200) == 96        # chunk > vocab: whole vocab
+    with pytest.raises(ValueError, match=">= 1"):
+        _flce_pick_chunk(32000, 0)
+    with pytest.raises(ValueError, match=">= 1"):
+        _flce_pick_chunk(32000, -3)
+    # vocab with no divisor near the request (2 * 16001): refuse rather
+    # than silently serializing the scan into ~16k steps
+    with pytest.raises(ValueError, match="no divisor"):
+        _flce_pick_chunk(32002, 8192)
