@@ -79,6 +79,13 @@ def _add_network_size_args(parser):
     g.add_argument("--num_attention_heads", type=int, default=None)
     g.add_argument("--num_attention_heads_kv", type=int, default=None)
     g.add_argument("--kv_channels", type=int, default=None)
+    # mixture-of-experts (TPU-native extension; reference has no MoE)
+    g.add_argument("--num_experts", type=int, default=0)
+    g.add_argument("--moe_top_k", type=int, default=2)
+    g.add_argument("--moe_capacity_factor", type=float, default=1.25)
+    g.add_argument("--moe_min_capacity", type=int, default=4)
+    g.add_argument("--moe_aux_loss_coeff", type=float, default=1e-2)
+    g.add_argument("--moe_z_loss_coeff", type=float, default=0.0)
     g.add_argument("--seq_length", type=int, default=None)
     # T5 decoder sequence length (reference: --decoder_seq_length,
     # megatron/arguments.py encoder/decoder seq args)
@@ -447,6 +454,15 @@ def validate_args(args, world_size: Optional[int] = None):
     # SP requires TP > 1 (reference: arguments.py:329-335)
     if args.sequence_parallel and args.tensor_model_parallel_size == 1:
         args.sequence_parallel = False
+
+    # MoE (TPU-native extension): decoder-only models, no pipeline yet.
+    # (The bias-free-experts constraint is enforced by TransformerConfig,
+    # after per-model defaults are applied.)
+    if getattr(args, "num_experts", 0) > 1:
+        if args.pipeline_model_parallel_size > 1:
+            raise ValueError(
+                "--num_experts > 1 is not supported with pipeline "
+                "parallelism yet; use tensor/data/context parallelism")
     return args
 
 
@@ -491,6 +507,12 @@ def transformer_config_from_args(args, model_name: Optional[str] = None
         use_flash_attn=args.use_flash_attn,
         fused_lm_cross_entropy=args.fused_lm_cross_entropy,
         fused_ce_chunk_size=args.fused_ce_chunk_size,
+        num_experts=args.num_experts,
+        moe_top_k=args.moe_top_k,
+        moe_capacity_factor=args.moe_capacity_factor,
+        moe_min_capacity=args.moe_min_capacity,
+        moe_aux_loss_coeff=args.moe_aux_loss_coeff,
+        moe_z_loss_coeff=args.moe_z_loss_coeff,
     )
 
 

@@ -172,6 +172,19 @@ class TransformerConfig:
     # --- lima dropout (reference: --lima_dropout, transformer.py) ---
     lima_dropout: bool = False
 
+    # --- mixture of experts (TPU-native extension; the reference has no
+    # MoE — SURVEY §2.2 marks EP "absent").  Experts replace the dense MLP
+    # in every layer when num_experts > 1; expert weights are sharded over
+    # the dp mesh axis ('expert' logical axis, EP folded into dp) and
+    # tokens reach their experts through XLA all-to-alls inserted by GSPMD
+    # around the dispatch/combine einsums (models/moe.py). ---
+    num_experts: int = 0                 # 0/1 = dense MLP
+    moe_top_k: int = 2                   # experts per token
+    moe_capacity_factor: float = 1.25    # per-expert buffer slack
+    moe_min_capacity: int = 4            # capacity floor (decode s=1)
+    moe_aux_loss_coeff: float = 1e-2     # load-balance loss weight
+    moe_z_loss_coeff: float = 0.0        # router logit z-loss weight
+
     def __post_init__(self):
         if self.ffn_hidden_size is None:
             object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
@@ -191,6 +204,14 @@ class TransformerConfig:
                 "position_embedding_type",
                 PositionEmbeddingType(self.position_embedding_type),
             )
+        if self.num_experts > 1:
+            if self.add_bias_linear:
+                raise ValueError("MoE experts do not support linear biases "
+                                 "(set add_bias_linear=False)")
+            if not (1 <= self.moe_top_k <= self.num_experts):
+                raise ValueError(
+                    f"moe_top_k ({self.moe_top_k}) must be in "
+                    f"[1, num_experts={self.num_experts}]")
 
     # convenience ------------------------------------------------------
     @property

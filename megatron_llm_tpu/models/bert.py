@@ -129,6 +129,11 @@ class BertModel:
     """
 
     def __init__(self, cfg: TransformerConfig, add_binary_head: bool = True):
+        if cfg.num_experts > 1:
+            raise NotImplementedError(
+                "MoE (num_experts > 1) is only wired for the decoder-only "
+                "GPT family; BertModel does not unpack the (hidden, aux) "
+                "stack return")
         self.cfg = cfg
         self.add_binary_head = add_binary_head
 

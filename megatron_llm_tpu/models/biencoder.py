@@ -51,6 +51,11 @@ class BiEncoderModel:
                  only_query: bool = False,
                  only_context: bool = False):
         assert not (only_query and only_context)
+        if cfg.num_experts > 1:
+            raise NotImplementedError(
+                "MoE (num_experts > 1) is only wired for the decoder-only "
+                "GPT family; BiEncoderModel does not unpack the "
+                "(hidden, aux) stack return")
         self.cfg = cfg
         self.projection_dim = projection_dim
         self.shared = shared_query_context

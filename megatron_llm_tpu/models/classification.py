@@ -38,6 +38,11 @@ class ClassificationModel:
     (reference: classification.py:24-107)."""
 
     def __init__(self, cfg: TransformerConfig, num_classes: int):
+        if cfg.num_experts > 1:
+            raise NotImplementedError(
+                "MoE (num_experts > 1) is only wired for the decoder-only "
+                "GPT family; ClassificationModel does not unpack the "
+                "(hidden, aux) stack return")
         self.cfg = cfg
         self.num_classes = num_classes
 

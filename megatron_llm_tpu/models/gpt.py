@@ -75,6 +75,7 @@ class GPTModel:
         """Returns per-token loss [b, s] when labels given, else logits
         [b, s, V] (reference: gpt_model.py:82-100)."""
         cfg = self.cfg
+        moe_on = cfg.num_experts > 1
         if (labels is not None and kv_caches is None
                 and cfg.fused_lm_cross_entropy and _vocab_unsharded()):
             # fused head+CE over vocab chunks: the [b, s, V] logits are
@@ -85,21 +86,31 @@ class GPTModel:
                 sequence_parallel=sequence_parallel,
                 compute_logits=False,
             )
+            moe_aux = None
+            if moe_on:
+                h, moe_aux = h
             head = lm_head_weight(params)
-            return fused_linear_cross_entropy(
+            loss = fused_linear_cross_entropy(
                 h, head.astype(cfg.compute_jnp_dtype), labels,
                 chunk_size=cfg.fused_ce_chunk_size,
             )
+            return (loss, moe_aux) if moe_on else loss
         out = language_model_forward(
             params, tokens, position_ids, attention_mask, self.cfg,
             rng_key=rng_key, train=train, sequence_parallel=sequence_parallel,
             kv_caches=kv_caches,
         )
+        moe_aux = None
         if kv_caches is not None:
             logits, new_caches = out
         else:
             logits, new_caches = out, None
+            if moe_on:
+                logits, moe_aux = logits
         if labels is None:
+            # generation: routing aux is irrelevant, drop it
             return (logits, new_caches) if kv_caches is not None else logits
         loss = vocab_parallel_cross_entropy(logits.astype(jnp.float32), labels)
-        return (loss, new_caches) if kv_caches is not None else loss
+        if kv_caches is not None:
+            return loss, new_caches
+        return (loss, moe_aux) if moe_on else loss

@@ -24,6 +24,7 @@ from megatron_llm_tpu.parallel.layers import (
     vocab_parallel_embedding,
 )
 from megatron_llm_tpu.parallel.sharding import constrain
+from megatron_llm_tpu.models.moe import moe_mlp_specs
 from megatron_llm_tpu.models.transformer import (
     init_stack_params,
     rotary_freqs,
@@ -100,14 +101,18 @@ def transformer_layer_specs(layers, stacked: bool = True) -> dict:
                 layers["attention"]["dense"], "heads", None, stacked
             ),
         },
-        "mlp": {
-            "dense_h_to_4h": _linear_spec(
-                layers["mlp"]["dense_h_to_4h"], None, "ffn", stacked
-            ),
-            "dense_4h_to_h": _linear_spec(
-                layers["mlp"]["dense_4h_to_h"], "ffn", None, stacked
-            ),
-        },
+        "mlp": (
+            moe_mlp_specs(layers["mlp"], stacked)
+            if "experts" in layers["mlp"]
+            else {
+                "dense_h_to_4h": _linear_spec(
+                    layers["mlp"]["dense_h_to_4h"], None, "ffn", stacked
+                ),
+                "dense_4h_to_h": _linear_spec(
+                    layers["mlp"]["dense_4h_to_h"], "ffn", None, stacked
+                ),
+            }
+        ),
     }
     if "post_attention_norm" in layers:
         layer_specs["post_attention_norm"] = _norm_spec(
@@ -343,9 +348,15 @@ def language_model_forward(
             rng_key=k_stack, train=train, sequence_parallel=sequence_parallel,
         )
         new_caches = None
+        if cfg.num_experts > 1:
+            # MoE: the stack also returns the accumulated [lb, z] routing
+            # aux losses; (x, aux) replaces x in every non-cache return
+            h, moe_aux = h
 
     if not compute_logits:
-        return (h, new_caches) if kv_caches is not None else h
+        if kv_caches is not None:
+            return h, new_caches
+        return (h, moe_aux) if cfg.num_experts > 1 else h
 
     head = lm_head_weight(params)
     logits = parallel_lm_logits(
@@ -355,7 +366,7 @@ def language_model_forward(
     )
     if kv_caches is not None:
         return logits, new_caches
-    return logits
+    return (logits, moe_aux) if cfg.num_experts > 1 else logits
 
 
 def flops_per_token(cfg: TransformerConfig, seq_len: Optional[int] = None) -> float:
@@ -373,6 +384,9 @@ def flops_per_token(cfg: TransformerConfig, seq_len: Optional[int] = None) -> fl
     qkv = h * (nh + 2 * ng) * d
     proj = nh * d * h
     mlp_p = h * ffn * mult + ffn * h
+    if cfg.num_experts > 1:
+        # MoE: top_k experts touched per token + the router matmul
+        mlp_p = cfg.moe_top_k * mlp_p + h * cfg.num_experts
     dense = L * (qkv + proj + mlp_p)
     emb = cfg.padded_vocab_size * h
     # fwd = 2 flops/param/token, bwd = 4, attention = 2*2*s*nh*d per layer fwd

@@ -101,6 +101,11 @@ class T5Model:
     """
 
     def __init__(self, cfg: TransformerConfig):
+        if cfg.num_experts > 1:
+            raise NotImplementedError(
+                "MoE (num_experts > 1) is only wired for the decoder-only "
+                "GPT family; T5Model does not unpack the (hidden, aux) "
+                "stack return")
         self.cfg = cfg
 
     # -- params ------------------------------------------------------------

@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 
 from megatron_llm_tpu.config import TransformerConfig, PositionEmbeddingType
-from megatron_llm_tpu.ops.activations import GLU_ACTIVATIONS, gelu
+from megatron_llm_tpu.ops.activations import apply_mlp_activation
+from megatron_llm_tpu.models.moe import moe_mlp
 from megatron_llm_tpu.ops.layernorm import apply_norm, init_norm_params
 from megatron_llm_tpu.ops.rope import apply_rotary_emb, precompute_freqs_cis
 from megatron_llm_tpu.ops.softmax import (
@@ -137,10 +138,16 @@ def init_mlp_params(key, cfg: TransformerConfig, dtype):
 
 def init_layer_params(key, cfg: TransformerConfig, dtype, layer_type: str = "encoder"):
     ka, km, kn = jax.random.split(key, 3)
+    if cfg.num_experts > 1:
+        from megatron_llm_tpu.models.moe import init_moe_mlp_params
+
+        mlp_params = init_moe_mlp_params(km, cfg, dtype)
+    else:
+        mlp_params = init_mlp_params(km, cfg, dtype)
     params = {
         "input_norm": init_norm_params(cfg.hidden_size, cfg.normalization, dtype),
         "attention": init_attention_params(ka, cfg, dtype),
-        "mlp": init_mlp_params(km, cfg, dtype),
+        "mlp": mlp_params,
     }
     if not cfg.parallel_attn:
         # pre-MLP norm (reference: post_attention_layernorm)
@@ -414,12 +421,7 @@ def mlp(
         sequence_parallel=sequence_parallel,
         compute_dtype=cfg.compute_jnp_dtype,
     )
-    if cfg.glu_activation:
-        h = GLU_ACTIVATIONS[cfg.glu_activation](h)
-    elif cfg.gelu_variant == "exact":
-        h = jax.nn.gelu(h, approximate=False)
-    else:
-        h = gelu(h)
+    h = apply_mlp_activation(h, cfg)
     return row_parallel_linear(
         h, params["dense_4h_to_h"],
         in_logical="ffn",
@@ -513,6 +515,16 @@ def transformer_layer(
         attn_out = attention(ln_out, params["attention"], cfg, **attn_kw)
         new_cache = None
 
+    # MoE (num_experts > 1) replaces the dense MLP and adds a routing aux
+    # loss threaded up through the stack scan (models/moe.py)
+    def run_mlp(inp):
+        if cfg.num_experts > 1:
+            return moe_mlp(inp, params["mlp"], cfg)
+        return (
+            mlp(inp, params["mlp"], cfg, sequence_parallel=sequence_parallel),
+            None,
+        )
+
     if cfg.parallel_attn:
         # Falcon: mlp feeds from the same (or its own) LN output; single
         # residual add of attn + mlp (reference: transformer.py:811-845)
@@ -520,15 +532,18 @@ def transformer_layer(
             mlp_in = norm(x, params["mlp_norm"])
         else:
             mlp_in = ln_out
-        mlp_out = mlp(mlp_in, params["mlp"], cfg, sequence_parallel=sequence_parallel)
+        mlp_out, moe_aux = run_mlp(mlp_in)
         out = residual + _dropout(
             attn_out + mlp_out, hidden_dropout, k_h1, train
         )
         if cfg.use_post_ln:
             out = norm(out, params["input_norm"])
+        rets = (out,)
         if kv_cache is not None:
-            return out, new_cache
-        return out
+            rets += (new_cache,)
+        if moe_aux is not None:
+            rets += (moe_aux,)
+        return rets if len(rets) > 1 else out
 
     # sequential: attn -> residual -> ln [-> cross-attn -> residual -> ln]
     # -> mlp -> residual
@@ -552,7 +567,7 @@ def transformer_layer(
             norm(h, params["post_inter_attention_norm"])
             if not cfg.use_post_ln else h
         )
-    mlp_out = mlp(ln2, params["mlp"], cfg, sequence_parallel=sequence_parallel)
+    mlp_out, moe_aux = run_mlp(ln2)
     out = residual + _dropout(mlp_out, hidden_dropout, k_h2, train)
     if cfg.use_post_ln:
         out = norm(
@@ -560,9 +575,12 @@ def transformer_layer(
             params["post_inter_attention_norm" if is_decoder
                    else "post_attention_norm"],
         )
+    rets = (out,)
     if kv_cache is not None:
-        return out, new_cache
-    return out
+        rets += (new_cache,)
+    if moe_aux is not None:
+        rets += (moe_aux,)
+    return rets if len(rets) > 1 else out
 
 
 # ---------------------------------------------------------------------------
@@ -607,8 +625,10 @@ def transformer_stack(
         jax.random.split(rng_key, L) if rng_key is not None else jnp.zeros((L, 2), jnp.uint32)
     )
 
+    moe_on = cfg.num_experts > 1
+
     def body(carry, scanned):
-        h = carry
+        h, aux_acc = carry if moe_on else (carry, None)
         if dropout_rates is not None:
             layer_p, key, rate = scanned
         else:
@@ -622,6 +642,9 @@ def transformer_stack(
             hidden_dropout=rate,
             encoder_output=encoder_output, enc_dec_mask=enc_dec_mask,
         )
+        if moe_on:
+            out, moe_aux = out
+            return (out, aux_acc + moe_aux), None
         return out, None
 
     if cfg.recompute_granularity in ("uniform", "block", "full"):
@@ -633,11 +656,12 @@ def transformer_stack(
 
     if kv_caches is not None:
         # inference path: python loop so each layer threads its own cache
+        # (MoE aux, when present, is irrelevant at decode time and dropped)
         new_caches = []
         h = x
         for i in range(L):
             layer_p = jax.tree_util.tree_map(lambda p: p[i], layers)
-            h, c = transformer_layer(
+            h, c, *_ = transformer_layer(
                 h, layer_p, cfg,
                 freqs=freqs, attention_mask=attention_mask,
                 position_ids=position_ids, rng_key=None, train=False,
@@ -655,12 +679,14 @@ def transformer_stack(
         if dropout_rates is not None
         else (layers, layer_keys)
     )
-    h, _ = jax.lax.scan(body, x, scanned)
+    init_carry = (x, jnp.zeros((2,), jnp.float32)) if moe_on else x
+    carry, _ = jax.lax.scan(body, init_carry, scanned)
+    h, moe_aux = carry if moe_on else (carry, None)
     h = apply_norm(
         h, stack_params["final_norm"], cfg.normalization,
         eps=cfg.layernorm_epsilon, fp32_compute=cfg.norm_in_fp32,
     )
-    return h
+    return (h, moe_aux) if moe_on else h
 
 
 def rotary_freqs(cfg: TransformerConfig, seq_len: Optional[int] = None):

@@ -242,6 +242,12 @@ def build_pipeline_loss_fn(
     when ``num_virtual > 1`` (see ``permute_layer_stack``).
     """
     cfg: TransformerConfig = model.cfg
+    if cfg.num_experts > 1:
+        raise NotImplementedError(
+            "MoE under pipeline parallelism is not wired yet (the routing "
+            "aux loss is not threaded through the 1F1B tick carry); use "
+            "tp x dp x cp parallelism for MoE models"
+        )
     S, V, M, L = pp_size, num_virtual, num_microbatches, cfg.num_layers
     assert L % (S * V) == 0, f"num_layers {L} must divide pp*vpp {S * V}"
     if V > 1:
@@ -408,6 +414,12 @@ def build_pipeline_grad_fn(
     ``jax.grad(loss_fn)`` of the streaming engine.
     """
     cfg: TransformerConfig = model.cfg
+    if cfg.num_experts > 1:
+        raise NotImplementedError(
+            "MoE under pipeline parallelism is not wired yet (the routing "
+            "aux loss is not threaded through the 1F1B tick carry); use "
+            "tp x dp x cp parallelism for MoE models"
+        )
     S, M, L = pp_size, num_microbatches, cfg.num_layers
     assert L % S == 0, f"num_layers {L} must divide pp {S}"
     cl = L // S

@@ -63,6 +63,9 @@ def build_train_step(
     attention_mask.
     """
     sp = parallel_cfg.sequence_parallel
+    # MoE models return (per-token loss, [lb, z] routing aux) — static on
+    # the model config, so BERT/T5's own tuple returns are unaffected
+    moe_on = getattr(getattr(model, "cfg", None), "num_experts", 0) > 1
 
     def microbatch_loss(params, micro, rng_key, scale):
         # every batch key beyond the canonical trio is forwarded as a model
@@ -81,14 +84,29 @@ def build_train_step(
             sequence_parallel=sp,
             **extra,
         )
+        moe_aux = None
+        if moe_on:
+            loss_tok, moe_aux = loss_tok
         out = loss_func(loss_tok, micro["loss_mask"])
         # loss_func may return (total, {metric: scalar}) to log components
         # separately (reference logs a loss dict per arch, e.g. BERT's
         # {'lm loss', 'sop loss'} — pretrain_bert.py loss_func)
         loss, aux = out if isinstance(out, tuple) else (out, {})
+        total = loss
+        if moe_aux is not None:
+            # the routing losses enter the optimized objective; the logged
+            # 'lm loss' stays the pure LM component, with the balance loss
+            # (and the z-loss, when enabled) reported under their own names
+            # (reference's per-key loss dict)
+            cfg = model.cfg
+            aux = {**aux, "moe aux loss": moe_aux[0]}
+            if cfg.moe_z_loss_coeff > 0.0:
+                aux["moe z loss"] = moe_aux[1]
+            total = (loss + cfg.moe_aux_loss_coeff * moe_aux[0]
+                     + cfg.moe_z_loss_coeff * moe_aux[1])
         # scaled loss for fp16 (reference: optimizer.scale_loss,
         # schedules.py:142-202); scale==1 for bf16/fp32
-        return loss * scale / num_microbatches, (loss, aux)
+        return total * scale / num_microbatches, (loss, aux)
 
     if forward_only:
 

@@ -1,0 +1,178 @@
+"""Mixture-of-experts: routing semantics, dense parity, sharding, and the
+model/trainer integration (TPU-native extension — the reference has no MoE,
+SURVEY §2.2 "expert parallel: absent")."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import TransformerConfig
+from megatron_llm_tpu.models.moe import (
+    init_moe_mlp_params,
+    moe_capacity,
+    moe_mlp,
+    moe_mlp_specs,
+)
+from megatron_llm_tpu.models.transformer import mlp as dense_mlp
+
+
+def _cfg(**kw):
+    base = dict(
+        num_layers=2, hidden_size=32, num_attention_heads=4,
+        ffn_hidden_size=64, num_experts=4, moe_top_k=2,
+        glu_activation="swiglu", add_bias_linear=False,
+        # ample capacity: every token always fits its expert buffer
+        moe_capacity_factor=8.0,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_identical_experts_match_dense_mlp():
+    """With every expert holding the same weights and top-1 routing (gate
+    renormalizes to 1.0), the MoE layer must equal the dense MLP."""
+    cfg = _cfg(moe_top_k=1)
+    p = init_moe_mlp_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    # copy expert 0 into all experts
+    p["experts"]["w_in"] = jnp.broadcast_to(
+        p["experts"]["w_in"][:1], p["experts"]["w_in"].shape)
+    p["experts"]["w_out"] = jnp.broadcast_to(
+        p["experts"]["w_out"][:1], p["experts"]["w_out"].shape)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 32))
+
+    dense_p = {
+        "dense_h_to_4h": {"kernel": p["experts"]["w_in"][0]},
+        "dense_4h_to_h": {"kernel": p["experts"]["w_out"][0]},
+    }
+    want = dense_mlp(x, dense_p, cfg)
+    got, aux = moe_mlp(x, p, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    assert aux.shape == (2,) and np.isfinite(np.asarray(aux)).all()
+
+
+def test_uniform_router_aux_loss_is_one():
+    """Zero router weights -> uniform probs; Switch load balance
+    E * sum_e(frac_e * 1/E) == sum_e frac_e == 1 exactly."""
+    cfg = _cfg()
+    p = init_moe_mlp_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    p["router"]["kernel"] = jnp.zeros_like(p["router"]["kernel"])
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 32))
+    _, aux = moe_mlp(x, p, cfg)
+    np.testing.assert_allclose(float(aux[0]), 1.0, atol=1e-5)
+
+
+def test_capacity_dropping_zeroes_overflow_tokens():
+    """A capacity of 1 with a router forced to a single expert keeps only
+    the first token per batch row; every later token's MLP output is 0."""
+    cfg = _cfg(moe_top_k=1, moe_capacity_factor=1e-9, moe_min_capacity=1)
+    p = init_moe_mlp_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    # bias router hard toward expert 2 via a huge weight column
+    wr = np.zeros(p["router"]["kernel"].shape, np.float32)
+    wr[0, 2] = 1e6          # logits ~ x[..., 0] * 1e6 -> same sign everywhere
+    p["router"]["kernel"] = jnp.asarray(wr)
+    x = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32))) + 0.1
+    assert moe_capacity(cfg, 8) == 1
+    out, _ = moe_mlp(x, p, cfg)
+    out = np.asarray(out)
+    # token 0 got the buffer slot; tokens 1.. were dropped (zero output)
+    assert np.abs(out[:, 0]).max() > 0
+    np.testing.assert_allclose(out[:, 1:], 0.0, atol=1e-6)
+
+
+def test_grads_reach_router_and_all_experts():
+    cfg = _cfg()
+    p = init_moe_mlp_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32))
+
+    def loss(p):
+        out, aux = moe_mlp(x, p, cfg)
+        return jnp.sum(out * out) + aux[0]
+
+    g = jax.grad(loss)(p)
+    assert float(jnp.linalg.norm(g["router"]["kernel"])) > 0
+    per_expert = jnp.linalg.norm(
+        g["experts"]["w_in"].reshape(cfg.num_experts, -1), axis=-1)
+    assert (np.asarray(per_expert) > 0).all(), per_expert
+
+
+def test_sharded_matches_unsharded(utils):
+    """dp-sharded experts + batch-sharded tokens produce the same numbers
+    as the single-device run (GSPMD all-to-all dispatch is semantics-free)."""
+    from megatron_llm_tpu import topology
+    from megatron_llm_tpu.parallel import sharding as sh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = _cfg(num_experts=8)
+    p = init_moe_mlp_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16, 32))
+    want, aux_want = moe_mlp(x, p, cfg)         # no mesh constraints active
+
+    topology.initialize_model_parallel()        # dp=8 mesh
+    try:
+        specs = moe_mlp_specs(p, stacked=False)
+        p_sh = sh.shard_params(p, specs)
+        # expert dim (8) really lands on the dp axis
+        w_in_shard = p_sh["experts"]["w_in"].sharding.spec
+        assert w_in_shard[0] == "dp", w_in_shard
+        x_sh = jax.device_put(
+            x, sh.make_shardings(("batch", None, None)))
+        got, aux_got = jax.jit(lambda x, p: moe_mlp(x, p, cfg))(x_sh, p_sh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(aux_got), np.asarray(aux_want),
+                                   atol=1e-6)
+    finally:
+        topology.destroy_model_parallel()
+
+
+def test_gpt_model_moe_train_and_decode(utils):
+    """GPTModel integration: (loss, aux) contract, flops accounting, and
+    the kv-cache decode path (aux dropped)."""
+    from megatron_llm_tpu.models.gpt import GPTModel
+
+    cfg = _cfg(
+        seq_length=32, max_position_embeddings=32, padded_vocab_size=64,
+        tie_embed_logits=True, hidden_dropout=0.0, attention_dropout=0.0,
+        use_flash_attn=False,
+    )
+    dense_cfg = dataclasses.replace(cfg, num_experts=0)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 32)))
+    labels = jnp.roll(toks, -1, -1)
+
+    loss_tok, aux = model(params, toks, labels=labels, train=True)
+    assert loss_tok.shape == (2, 32)
+    assert aux.shape == (2,)
+    # aux accumulates one lb term per layer, each ~1 near-uniform at init
+    assert 0.5 * cfg.num_layers < float(aux[0]) < 2.0 * cfg.num_layers
+
+    assert model.flops_per_token() > GPTModel(dense_cfg).flops_per_token()
+
+    # generation contract: logits without labels, aux dropped
+    logits = model(params, toks)
+    assert logits.shape == (2, 32, 64)
+
+    g = jax.grad(
+        lambda p: jnp.mean(model(p, toks, labels=labels)[0])
+        + 1e-2 * model(p, toks, labels=labels)[1][0]
+    )(params)
+    layers = g["transformer"]["layers"]
+    assert float(jnp.linalg.norm(layers["mlp"]["router"]["kernel"])) > 0
+    assert float(jnp.linalg.norm(layers["mlp"]["experts"]["w_in"])) > 0
+
+
+def test_non_gpt_families_reject_moe():
+    from megatron_llm_tpu.models.bert import BertModel
+    from megatron_llm_tpu.models.t5 import T5Model
+
+    cfg = _cfg(num_tokentypes=2)
+    with pytest.raises(NotImplementedError, match="GPT family"):
+        BertModel(cfg)
+    with pytest.raises(NotImplementedError, match="GPT family"):
+        T5Model(cfg)
