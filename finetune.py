@@ -206,6 +206,12 @@ _CKPT_ARG_MAP = {
     "layernorm_epsilon": "layernorm_epsilon",
     "rope_theta": "rope_theta",
     "rope_scaling_factor": "rope_scaling_factor",
+    # MoE architecture fields: a dense rebuild of an MoE checkpoint (or
+    # vice versa) fails orbax restore on the param-tree mismatch
+    "num_experts": "num_experts",
+    "moe_top_k": "moe_top_k",
+    "moe_capacity_factor": "moe_capacity_factor",
+    "moe_min_capacity": "moe_min_capacity",
 }
 
 

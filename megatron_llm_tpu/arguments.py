@@ -455,14 +455,6 @@ def validate_args(args, world_size: Optional[int] = None):
     if args.sequence_parallel and args.tensor_model_parallel_size == 1:
         args.sequence_parallel = False
 
-    # MoE (TPU-native extension): decoder-only models, no pipeline yet.
-    # (The bias-free-experts constraint is enforced by TransformerConfig,
-    # after per-model defaults are applied.)
-    if getattr(args, "num_experts", 0) > 1:
-        if args.pipeline_model_parallel_size > 1:
-            raise ValueError(
-                "--num_experts > 1 is not supported with pipeline "
-                "parallelism yet; use tensor/data/context parallelism")
     return args
 
 

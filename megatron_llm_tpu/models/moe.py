@@ -22,6 +22,10 @@ ICI instead of host gathers.
 * Tokens over capacity are dropped (their MLP contribution is zero and
   the residual stream carries them unchanged) — standard capacity-style
   MoE semantics.
+* **Composes with the pipeline engines** (``parallel/pipeline.py``): the
+  ``[lb, z]`` aux rides the tick carry of both schedules and the manual
+  1F1B backward seeds its cotangent on every stage, so tp x pp x dp(=ep)
+  x sp train together (parity-tested in ``tests/test_pipeline.py``).
 """
 
 from __future__ import annotations

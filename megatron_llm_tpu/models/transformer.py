@@ -473,6 +473,10 @@ def transformer_layer(
     * per-layer hidden dropout override (lima dropout, :765-777)
     * T5-style cross-attention when the layer has ``inter_attention`` params
       and ``encoder_output`` is given (``LayerType.decoder``, :695-714,813-825)
+
+    Returns the fixed-arity triple ``(out, new_cache, moe_aux)`` —
+    ``new_cache`` is None when ``kv_cache`` is None, ``moe_aux`` is None
+    for dense (non-MoE) configs.
     """
     is_decoder = "inter_attention" in params and encoder_output is not None
     if is_decoder and cfg.parallel_attn:
@@ -538,12 +542,7 @@ def transformer_layer(
         )
         if cfg.use_post_ln:
             out = norm(out, params["input_norm"])
-        rets = (out,)
-        if kv_cache is not None:
-            rets += (new_cache,)
-        if moe_aux is not None:
-            rets += (moe_aux,)
-        return rets if len(rets) > 1 else out
+        return out, new_cache, moe_aux
 
     # sequential: attn -> residual -> ln [-> cross-attn -> residual -> ln]
     # -> mlp -> residual
@@ -575,12 +574,7 @@ def transformer_layer(
             params["post_inter_attention_norm" if is_decoder
                    else "post_attention_norm"],
         )
-    rets = (out,)
-    if kv_cache is not None:
-        rets += (new_cache,)
-    if moe_aux is not None:
-        rets += (moe_aux,)
-    return rets if len(rets) > 1 else out
+    return out, new_cache, moe_aux
 
 
 # ---------------------------------------------------------------------------
@@ -634,7 +628,7 @@ def transformer_stack(
         else:
             layer_p, key = scanned
             rate = None
-        out = transformer_layer(
+        out, _, moe_aux = transformer_layer(
             h, layer_p, cfg,
             freqs=freqs, attention_mask=attention_mask, position_ids=position_ids,
             rng_key=key if rng_key is not None else None,
@@ -643,7 +637,6 @@ def transformer_stack(
             encoder_output=encoder_output, enc_dec_mask=enc_dec_mask,
         )
         if moe_on:
-            out, moe_aux = out
             return (out, aux_acc + moe_aux), None
         return out, None
 
@@ -661,7 +654,7 @@ def transformer_stack(
         h = x
         for i in range(L):
             layer_p = jax.tree_util.tree_map(lambda p: p[i], layers)
-            h, c, *_ = transformer_layer(
+            h, c, _ = transformer_layer(
                 h, layer_p, cfg,
                 freqs=freqs, attention_mask=attention_mask,
                 position_ids=position_ids, rng_key=None, train=False,

@@ -242,12 +242,7 @@ def build_pipeline_loss_fn(
     when ``num_virtual > 1`` (see ``permute_layer_stack``).
     """
     cfg: TransformerConfig = model.cfg
-    if cfg.num_experts > 1:
-        raise NotImplementedError(
-            "MoE under pipeline parallelism is not wired yet (the routing "
-            "aux loss is not threaded through the 1F1B tick carry); use "
-            "tp x dp x cp parallelism for MoE models"
-        )
+    moe_on = cfg.num_experts > 1
     S, V, M, L = pp_size, num_virtual, num_microbatches, cfg.num_layers
     assert L % (S * V) == 0, f"num_layers {L} must divide pp*vpp {S * V}"
     if V > 1:
@@ -285,7 +280,11 @@ def build_pipeline_loss_fn(
             lay_key0 = jax.random.fold_in(rng_key_, 2)
 
             def run_chunk(h, v, m):
+                """Apply this device's chunk v; returns (h, aux [2]) where
+                aux is the chunk's accumulated MoE routing losses (zeros
+                for dense models)."""
                 def layer_body(carry, i):
+                    hh, aux = carry
                     li = v * cl + i                       # local stacked row
                     lp = jax.tree_util.tree_map(
                         lambda x: lax.dynamic_index_in_dim(
@@ -296,20 +295,24 @@ def build_pipeline_loss_fn(
                         jax.random.fold_in(lay_key0, m),
                         pp_rank * local_L + li,
                     )
-                    out = transformer_layer(
-                        carry, lp, cfg,
+                    out, _, a = transformer_layer(
+                        hh, lp, cfg,
                         freqs=freqs, attention_mask=None, position_ids=None,
                         rng_key=key if use_dropout else None,
                         train=use_dropout,
                         sequence_parallel=sequence_parallel,
                     )
-                    return out, None
+                    if moe_on:
+                        aux = aux + a
+                    return (out, aux), None
 
-                h, _ = lax.scan(layer_body, h, jnp.arange(cl))
-                return h
+                (h, aux), _ = lax.scan(
+                    layer_body, (h, jnp.zeros((2,), jnp.float32)),
+                    jnp.arange(cl))
+                return h, aux
 
             def tick(carry, t):
-                act, ce_sum, tok_sum = carry
+                act, ce_sum, tok_sum, aux_sum = carry
                 w = t - pp_rank
                 m, v, valid = _decode_item(w, M, S, V)
                 toks_m = _index_mb(tokens_, m)
@@ -321,7 +324,10 @@ def build_pipeline_loss_fn(
                     vocab_parallel_manual=True,
                 ).astype(cfg.compute_jnp_dtype)
                 inp = jnp.where(is_first & (v == 0), h_emb, act)
-                out = run_chunk(inp, v, m)
+                out, aux_c = run_chunk(inp, v, m)
+                # every stage owns cl layers of every valid item, so the
+                # routing aux accrues on all stages (unlike CE)
+                aux_sum = aux_sum + aux_c * valid.astype(jnp.float32)
 
                 # streamed head + CE: valid only on (last stage, last chunk)
                 h_fin = apply_norm(
@@ -343,6 +349,7 @@ def build_pipeline_loss_fn(
                     act_next,
                     ce_sum + jnp.sum(ce * wgt),
                     tok_sum + jnp.sum(wgt),
+                    aux_sum,
                 ), None
 
             tick_fn = jax.checkpoint(
@@ -361,32 +368,41 @@ def build_pipeline_loss_fn(
                 block, policy=jax.checkpoint_policies.nothing_saveable
             )
             act0 = jnp.zeros((mb, s, cfg.hidden_size), cfg.compute_jnp_dtype)
-            (act_f, ce_sum, tok_sum), _ = lax.scan(
+            (act_f, ce_sum, tok_sum, aux_sum), _ = lax.scan(
                 block_fn,
-                (act0, jnp.float32(0.0), jnp.float32(0.0)),
+                (act0, jnp.float32(0.0), jnp.float32(0.0),
+                 jnp.zeros((2,), jnp.float32)),
                 jnp.arange(n_blocks),
             )
             # ticks beyond T (block padding) decode to invalid items -> masked
             ce_tot = lax.psum(ce_sum, "pp")
             tok_tot = lax.psum(tok_sum, "pp")
-            return ce_tot, tok_tot
+            aux_tot = lax.psum(aux_sum, "pp")
+            return ce_tot, tok_tot, aux_tot
 
         layer_in_spec = jax.tree_util.tree_map(lambda _: P("pp"),
                                                trans["layers"])
         rep = jax.tree_util.tree_map(lambda _: P(), emb_p)
         fnorm_spec = jax.tree_util.tree_map(lambda _: P(),
                                             trans["final_norm"])
-        ce_tot, tok_tot = jax.shard_map(
+        ce_tot, tok_tot, aux_tot = jax.shard_map(
             shmap_fn,
             mesh=mesh,
             in_specs=(layer_in_spec, rep, P(), fnorm_spec, P(), P(), P(), P()),
-            out_specs=(P(), P()),
+            out_specs=(P(), P(), P()),
             axis_names={"pp"},
             check_vma=False,
         )(trans["layers"], _pipeline_embedding_layout(emb_p, mesh), head_w,
           trans["final_norm"], tokens, labels, loss_mask, rng_key)
 
         loss = ce_tot / jnp.maximum(tok_tot, 1.0)
+        if moe_on:
+            # mean routing aux per microbatch enters the objective with the
+            # configured coefficients; (loss, aux) is reported for logging
+            aux_mean = aux_tot / M
+            total = (loss + cfg.moe_aux_loss_coeff * aux_mean[0]
+                     + cfg.moe_z_loss_coeff * aux_mean[1])
+            return total * scale, (loss, aux_mean)
         return loss * scale, loss
 
     return loss_fn
@@ -414,12 +430,7 @@ def build_pipeline_grad_fn(
     ``jax.grad(loss_fn)`` of the streaming engine.
     """
     cfg: TransformerConfig = model.cfg
-    if cfg.num_experts > 1:
-        raise NotImplementedError(
-            "MoE under pipeline parallelism is not wired yet (the routing "
-            "aux loss is not threaded through the 1F1B tick carry); use "
-            "tp x dp x cp parallelism for MoE models"
-        )
+    moe_on = cfg.num_experts > 1
     S, M, L = pp_size, num_microbatches, cfg.num_layers
     assert L % S == 0, f"num_layers {L} must divide pp {S}"
     cl = L // S
@@ -445,7 +456,7 @@ def build_pipeline_grad_fn(
         tok_tot = jnp.maximum(jnp.sum(loss_mask.astype(jnp.float32)), 1.0)
 
         def shmap_fn(layers_local, emb_p_, head_w_, fnorm_, tokens_,
-                     labels_, mask_, rng_key_, seed_):
+                     labels_, mask_, rng_key_, seed_, aux_seed_):
             pp_rank = lax.axis_index("pp")
             is_first = (pp_rank == 0).astype(jnp.float32)
             is_last = (pp_rank == S - 1).astype(jnp.float32)
@@ -453,7 +464,10 @@ def build_pipeline_grad_fn(
             lay_key0 = jax.random.fold_in(rng_key_, 2)
 
             def chunk_fwd(h, layers_loc, m):
+                """(h, aux [2]): this stage's cl layers + its MoE routing
+                losses (zeros for dense models)."""
                 def layer_body(carry, i):
+                    hh, aux = carry
                     lp = jax.tree_util.tree_map(
                         lambda x: lax.dynamic_index_in_dim(
                             x, i, 0, keepdims=False),
@@ -462,17 +476,21 @@ def build_pipeline_grad_fn(
                     key = jax.random.fold_in(
                         jax.random.fold_in(lay_key0, m), pp_rank * cl + i
                     )
-                    out = transformer_layer(
-                        carry, lp, cfg,
+                    out, _, a = transformer_layer(
+                        hh, lp, cfg,
                         freqs=freqs, attention_mask=None, position_ids=None,
                         rng_key=key if use_dropout else None,
                         train=use_dropout,
                         sequence_parallel=sequence_parallel,
                     )
-                    return out, None
+                    if moe_on:
+                        aux = aux + a
+                    return (out, aux), None
 
-                h, _ = lax.scan(layer_body, h, jnp.arange(cl))
-                return h
+                (h, aux), _ = lax.scan(
+                    layer_body, (h, jnp.zeros((2,), jnp.float32)),
+                    jnp.arange(cl))
+                return h, aux
 
             def embed(emb_params, m):
                 toks_m = _index_mb(tokens_, m)
@@ -502,14 +520,14 @@ def build_pipeline_grad_fn(
 
             def tick(carry, t):
                 act_f, act_b, stash, g_lay, g_emb, g_head, g_norm, \
-                    ce_sum, tok_sum = carry
+                    ce_sum, tok_sum, aux_sum = carry
 
                 # ---------------- forward chunk ---------------------------
                 f = t - pp_rank
                 m_f, _, valid_f = _decode_item(f, M, S, 1)
                 h_emb = embed(emb_p_, m_f)
                 inp = jnp.where((pp_rank == 0), h_emb, act_f)
-                out = chunk_fwd(inp, layers_local, m_f)
+                out, _ = chunk_fwd(inp, layers_local, m_f)
                 # stash the chunk input for the backward recompute
                 slot_f = jnp.mod(f, R)
                 old = lax.dynamic_index_in_dim(stash, slot_f, 0,
@@ -529,19 +547,21 @@ def build_pipeline_grad_fn(
                 x = lax.dynamic_index_in_dim(stash, slot_b, 0, keepdims=False)
 
                 def fwd_path(x_in, layers_loc, head_in, fnorm_in):
-                    o = chunk_fwd(x_in, layers_loc, m_b)
+                    o, aux_c = chunk_fwd(x_in, layers_loc, m_b)
                     ce, wgt = head_ce(o, head_in, fnorm_in, m_b)
-                    return o, ce, wgt
+                    return o, ce, wgt, aux_c
 
-                (o_b, ce_b, wgt_b), vjp = jax.vjp(
+                (o_b, ce_b, wgt_b, aux_b), vjp = jax.vjp(
                     fwd_path, x, layers_local, head_w_, fnorm_
                 )
                 # last stage seeds from CE; other stages from the incoming
-                # cotangent (zeroed on the last stage)
+                # cotangent (zeroed on the last stage).  The routing aux is
+                # seeded on EVERY stage (each owns its layers' routers).
                 cot_o = (act_b * (1.0 - is_last)).astype(o_b.dtype)
                 cot_ce = (seed_ * is_last * vmask).astype(ce_b.dtype)
+                cot_aux = aux_seed_ * vmask
                 dx, d_lay, d_head, d_norm = vjp(
-                    (cot_o, cot_ce, jnp.zeros_like(wgt_b))
+                    (cot_o, cot_ce, jnp.zeros_like(wgt_b), cot_aux)
                 )
                 # first stage: push dx through the embedding lookup
                 _, emb_vjp = jax.vjp(lambda ep: embed(ep, m_b), emb_p_)
@@ -560,13 +580,14 @@ def build_pipeline_grad_fn(
                     g_norm, d_norm)
                 ce_sum = ce_sum + ce_b * is_last * vmask
                 tok_sum = tok_sum + wgt_b * is_last * vmask
+                aux_sum = aux_sum + aux_b * vmask
 
                 act_b_next = lax.ppermute(
                     (dx * vmask).astype(cfg.compute_jnp_dtype),
                     "pp", _bwd_rotation(S),
                 )
                 return (act_f_next, act_b_next, stash, g_lay, g_emb,
-                        g_head, g_norm, ce_sum, tok_sum), None
+                        g_head, g_norm, ce_sum, tok_sum, aux_sum), None
 
             zeros_f32 = lambda tree: jax.tree_util.tree_map(  # noqa: E731
                 lambda x: jnp.zeros(x.shape, jnp.float32), tree)
@@ -581,10 +602,11 @@ def build_pipeline_grad_fn(
                 zeros_f32(fnorm_),
                 jnp.float32(0.0),
                 jnp.float32(0.0),
+                jnp.zeros((2,), jnp.float32),
             )
             carry, _ = lax.scan(tick, carry0, jnp.arange(T))
             (_, _, _, g_lay, g_emb, g_head, g_norm,
-             ce_sum, tok_sum) = carry
+             ce_sum, tok_sum, aux_sum) = carry
             # replicated-param grads: emit per-stage contributions stacked
             # over pp and sum them outside the shard_map — an in-body psum
             # of a tp-auto-sharded array over the manual pp axis trips the
@@ -593,8 +615,9 @@ def build_pipeline_grad_fn(
                 lambda g: g[None], t)
             ce_tot = lax.psum(ce_sum, "pp")
             tok_tot_ = lax.psum(tok_sum, "pp")
+            aux_tot = lax.psum(aux_sum, "pp")
             return (g_lay, stack(g_emb), g_head[None], stack(g_norm),
-                    ce_tot, tok_tot_)
+                    ce_tot, tok_tot_, aux_tot)
 
         layer_in_spec = jax.tree_util.tree_map(lambda _: P("pp"),
                                                trans["layers"])
@@ -606,17 +629,21 @@ def build_pipeline_grad_fn(
                                                trans["final_norm"])
         # cotangent seed: d(scale * mean CE)/d(per-item CE sum)
         seed = jnp.float32(scale) / tok_tot
-        g_lay, g_emb, g_head, g_norm, ce_tot, tok_tot_ = jax.shard_map(
+        # routing-aux cotangent: d(scale * coeff . mean-per-microbatch aux)
+        aux_seed = (jnp.float32(scale) / M) * jnp.asarray(
+            [cfg.moe_aux_loss_coeff, cfg.moe_z_loss_coeff], jnp.float32)
+        g_lay, g_emb, g_head, g_norm, ce_tot, tok_tot_, aux_tot = jax.shard_map(
             shmap_fn,
             mesh=mesh,
             in_specs=(layer_in_spec, rep_emb, P(), fnorm_spec,
-                      P(), P(), P(), P(), P()),
+                      P(), P(), P(), P(), P(), P()),
             out_specs=(layer_in_spec, stacked_emb, P("pp"), stacked_fnorm,
-                       P(), P()),
+                       P(), P(), P()),
             axis_names={"pp"},
             check_vma=False,
         )(trans["layers"], _pipeline_embedding_layout(emb_p, mesh), head_w,
-          trans["final_norm"], tokens, labels, loss_mask, rng_key, seed)
+          trans["final_norm"], tokens, labels, loss_mask, rng_key, seed,
+          aux_seed)
         sum_pp = lambda t: jax.tree_util.tree_map(  # noqa: E731
             lambda g: jnp.sum(g, axis=0), t)
         g_emb = sum_pp(g_emb)
@@ -634,6 +661,8 @@ def build_pipeline_grad_fn(
             grads["embedding"]["word"]["embedding"] = (
                 grads["embedding"]["word"]["embedding"] + g_head
             )
+        if moe_on:
+            return loss, grads, aux_tot / M
         return loss, grads
 
     return grad_fn
@@ -666,6 +695,13 @@ def build_pipeline_train_step(
         raise ValueError("manual 1f1b schedule supports vpp=1 only; "
                          "use schedule='stream' for interleaved VPP")
 
+    moe_on = model.cfg.num_experts > 1
+
+    def moe_metrics(metrics, aux):
+        metrics["moe aux loss"] = aux[0]
+        if model.cfg.moe_z_loss_coeff > 0.0:
+            metrics["moe z loss"] = aux[1]
+
     if schedule == "1f1b":
         grad_fn = build_pipeline_grad_fn(
             model, pp, num_microbatches,
@@ -674,7 +710,8 @@ def build_pipeline_train_step(
 
         def train_step(params, opt_state, batch, rng_key, lr, wd):
             scale = opt_state.grad_scaler.scale
-            loss, grads = grad_fn(params, batch, rng_key, scale)
+            out = grad_fn(params, batch, rng_key, scale)
+            loss, grads = out[0], out[1]
             new_params, new_opt_state, stats = optimizer.step(
                 params, grads, opt_state, lr, wd
             )
@@ -684,6 +721,8 @@ def build_pipeline_train_step(
                 "loss_scale": stats["loss_scale"],
                 "skipped_iter": stats["found_inf"].astype(jnp.int32),
             }
+            if moe_on:
+                moe_metrics(metrics, out[2])
             return new_params, new_opt_state, metrics
 
         return jax.jit(train_step, donate_argnums=(0, 1))
@@ -700,7 +739,8 @@ def build_pipeline_train_step(
         def scaled_loss(p):
             return loss_fn(p, batch, rng_key, scale)
 
-        (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
+        (_, lfaux), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
+        loss, moe_aux = lfaux if moe_on else (lfaux, None)
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         new_params, new_opt_state, stats = optimizer.step(
             params, grads, opt_state, lr, wd
@@ -711,6 +751,8 @@ def build_pipeline_train_step(
             "loss_scale": stats["loss_scale"],
             "skipped_iter": stats["found_inf"].astype(jnp.int32),
         }
+        if moe_on:
+            moe_metrics(metrics, moe_aux)
         return new_params, new_opt_state, metrics
 
     return jax.jit(train_step, donate_argnums=(0, 1))

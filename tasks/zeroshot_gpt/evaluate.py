@@ -29,6 +29,8 @@ def _build_eval_fns(model):
     def loss_sum(params, tokens, pad_mask):
         inp, labels = tokens[:, :-1], tokens[:, 1:]
         loss_tok = model(params, inp, labels=labels)  # [b, s]
+        if model.cfg.num_experts > 1:
+            loss_tok, _ = loss_tok      # MoE: drop the routing aux at eval
         return jnp.sum(loss_tok * pad_mask.astype(loss_tok.dtype))
 
     @jax.jit

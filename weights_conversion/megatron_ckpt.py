@@ -357,6 +357,11 @@ def save_reference_checkpoint(save_dir: str, iteration, params, cfg,
 
     tp = tensor_parallel
     layers = params["transformer"]["layers"]
+    if "experts" in layers["mlp"]:
+        raise NotImplementedError(
+            "MoE params cannot be exported to a reference Megatron "
+            "checkpoint: the reference has no MoE layout (its mlp is "
+            "dense_h_to_4h/dense_4h_to_h)")
     # .shape on the stacked kernel directly — np.asarray here would pull
     # the largest tensor in the model to host just to read one dim
     num_layers = int(
