@@ -190,6 +190,26 @@ def load_checkpoint(
         )
         return ocp.checkpoint_utils.construct_restore_args(abstract)
 
+    def _host_restore_args(path):
+        """No-template restore (conversion/resharding tools, tests): pull
+        every leaf to host numpy.  Explicit restore_type keeps orbax off
+        its sharding-file path — on a host-side tool there is no device
+        topology to mismatch, and no 'unsafe when restoring on a different
+        topology' warning to emit."""
+        import numpy as np
+
+        item_meta = ckptr.metadata(path).item_metadata
+        if item_meta is None or getattr(item_meta, "tree", None) is None:
+            # metadata file missing/unreadable (older writer, partial
+            # copy): let orbax derive structure itself; the topology
+            # warning may fire but the restore still works
+            return None
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray),
+            item_meta.tree)
+
     if not load_params:
         # optimizer/scheduler-only restore (second phase of a CLI resume,
         # once the optimizer exists to provide a template)
@@ -199,7 +219,9 @@ def load_checkpoint(
             ckpt_dir / "model",
             restore_args=_restore_args_for(params_template))
     else:
-        params = ckptr.restore(ckpt_dir / "model")
+        params = ckptr.restore(
+            ckpt_dir / "model",
+            restore_args=_host_restore_args(ckpt_dir / "model"))
 
     opt_state = None
     if not finetune and (ckpt_dir / "optim").exists() and opt_state_template is not None:

@@ -235,6 +235,9 @@ def build_pipeline_loss_fn(
 ):
     """Returns ``loss_fn(params, batch, rng_key, scale, train) ->
     (scaled_loss, loss)`` computing the full pipelined global-batch loss.
+    For MoE configs (``num_experts > 1``) the return is
+    ``(scaled_total, (loss, aux))`` where ``scaled_total`` includes the
+    weighted routing losses and ``aux`` is the ``[lb, z]`` mean.
 
     ``batch``: dict with tokens/labels/loss_mask of shape [M, mb, s].
     ``params``: the standard model pytree; ``transformer.layers`` leaves
@@ -420,7 +423,10 @@ def build_pipeline_grad_fn(
     sequence_parallel: bool = False,
 ):
     """Returns ``grad_fn(params, batch, rng_key, scale, train) ->
-    (loss, grads)`` with a hand-scheduled 1F1B backward.
+    (loss, grads)`` with a hand-scheduled 1F1B backward; for MoE configs
+    (``num_experts > 1``) it returns ``(loss, grads, aux)`` with the
+    ``[lb, z]`` routing-aux mean, and ``grads`` are gradients of the full
+    weighted objective.
 
     Activation memory is flat in M: the scan is never autodiffed, so the
     only live state is the carry — one fwd activation, one bwd cotangent,
