@@ -60,6 +60,11 @@ MODEL_DEFAULTS = {
                     use_rms_norm=True, use_bias=False, tie_embed_logits=False,
                     sliding_window_size=4096,
                     hidden_dropout=0.0, attention_dropout=0.0),
+    # sparse-MoE mistral (TPU-native extension; the reference has no MoE)
+    "mixtral": dict(position_embedding_type="rotary", glu_activation="swiglu",
+                    use_rms_norm=True, use_bias=False, tie_embed_logits=False,
+                    num_experts=8, moe_top_k=2, rope_theta=1e6,
+                    hidden_dropout=0.0, attention_dropout=0.0),
     "gpt": dict(),
 }
 

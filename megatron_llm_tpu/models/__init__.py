@@ -8,6 +8,7 @@ from megatron_llm_tpu.models.gpt import GPTModel
 from megatron_llm_tpu.models.llama import LlamaModel, llama_config
 from megatron_llm_tpu.models.falcon import FalconModel, falcon_config
 from megatron_llm_tpu.models.mistral import MistralModel, mistral_config
+from megatron_llm_tpu.models.mixtral import MixtralModel, mixtral_config
 from megatron_llm_tpu.models.gpt2 import gpt2_config
 from megatron_llm_tpu.models.bert import BertModel, bert_config
 from megatron_llm_tpu.models.t5 import T5Model, t5_config
@@ -23,6 +24,7 @@ MODEL_REGISTRY = {
     "codellama": LlamaModel,
     "falcon": FalconModel,
     "mistral": MistralModel,
+    "mixtral": MixtralModel,
 }
 # BERT/T5 train through their own entry points (pretrain_bert.py /
 # pretrain_t5.py), mirroring the reference; they are not finetune.py models.

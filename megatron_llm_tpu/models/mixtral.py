@@ -1,0 +1,51 @@
+"""Mixtral wrapper (sparse MoE Mistral).
+
+Beyond the reference (which has neither MoE nor Mixtral): the same
+assert-the-architecture-flags pattern as ``mistral_model.py:22-34``,
+for the Mixtral-8x7B family — llama-style trunk, GQA, and a top-2
+routed 8-expert MLP per layer (``models/moe.py``).
+"""
+
+from __future__ import annotations
+
+from megatron_llm_tpu.config import TransformerConfig, PositionEmbeddingType
+from megatron_llm_tpu.models.gpt import GPTModel
+
+
+class MixtralModel(GPTModel):
+    def __init__(self, cfg: TransformerConfig):
+        assert cfg.position_embedding_type == PositionEmbeddingType.rotary
+        assert cfg.glu_activation == "swiglu"
+        assert cfg.normalization == "rmsnorm"
+        assert not cfg.add_bias_linear
+        assert not cfg.tie_embed_logits
+        assert cfg.num_experts > 1, "mixtral is a sparse MoE model"
+        super().__init__(cfg)
+
+
+def mixtral_config(size: str = "8x7B", **overrides) -> TransformerConfig:
+    shapes = {
+        "tiny": dict(num_layers=2, hidden_size=128, num_attention_heads=4,
+                     num_attention_heads_kv=2, ffn_hidden_size=352,
+                     padded_vocab_size=32000, num_experts=4),
+        "8x7B": dict(num_layers=32, hidden_size=4096,
+                     num_attention_heads=32, num_attention_heads_kv=8,
+                     ffn_hidden_size=14336, padded_vocab_size=32000,
+                     num_experts=8),
+    }
+    base = dict(
+        position_embedding_type=PositionEmbeddingType.rotary,
+        glu_activation="swiglu",
+        normalization="rmsnorm",
+        add_bias_linear=False,
+        tie_embed_logits=False,
+        moe_top_k=2,
+        rope_theta=1e6,
+        seq_length=4096,
+        max_position_embeddings=32768,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+    )
+    base.update(shapes[size])
+    base.update(overrides)
+    return TransformerConfig(**base)

@@ -38,16 +38,35 @@ def _np(t):
     return t.detach().to("cpu").float().numpy().copy()
 
 
-def convert_llama_family(hf_model, dtype=np.float32):
+def _dense_glu_mlp(sd, p):
+    """HF llama/mistral mlp.{gate,up,down}_proj -> dense GLU mlp subtree."""
+    return {
+        "dense_h_to_4h": {
+            "kernel": pack_glu_ffn(
+                _np(sd[p + "mlp.gate_proj.weight"]),
+                _np(sd[p + "mlp.up_proj.weight"]),
+            )
+        },
+        "dense_4h_to_h": {
+            "kernel": np.ascontiguousarray(
+                _np(sd[p + "mlp.down_proj.weight"]).T)
+        },
+    }
+
+
+def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None):
     """LlamaForCausalLM / MistralForCausalLM -> param pytree + config dict.
 
     reference: hf_to_megatron.py:117-258 (llama), :185-258 (mistral).
+    ``layer_mlp(sd, prefix)``: per-layer mlp-subtree converter hook —
+    defaults to the dense GLU mlp; convert_mixtral swaps in the MoE one.
     """
     hf_cfg = hf_model.config
     nh = hf_cfg.num_attention_heads
     ng = getattr(hf_cfg, "num_key_value_heads", nh)
     d = hf_cfg.hidden_size // nh
     sd = dict(hf_model.state_dict())
+    layer_mlp = layer_mlp or _dense_glu_mlp
 
     layers = []
     for i in range(hf_cfg.num_hidden_layers):
@@ -69,46 +88,23 @@ def convert_llama_family(hf_model, dtype=np.float32):
             "post_attention_norm": {
                 "scale": _np(sd[p + "post_attention_layernorm.weight"])
             },
-            "mlp": {
-                "dense_h_to_4h": {
-                    "kernel": pack_glu_ffn(
-                        _np(sd[p + "mlp.gate_proj.weight"]),
-                        _np(sd[p + "mlp.up_proj.weight"]),
-                    )
-                },
-                "dense_4h_to_h": {
-                    "kernel": np.ascontiguousarray(
-                        _np(sd[p + "mlp.down_proj.weight"]).T)
-                },
-            },
+            "mlp": layer_mlp(sd, p),
         })
 
     import jax.numpy as jnp
 
-    stacked = {}
-    def stack(*path):
+    def stack_tree(template, *path):
+        """Stack every leaf of the (per-layer identical) subtree."""
+        if isinstance(template, dict):
+            return {k: stack_tree(v, *path, k) for k, v in template.items()}
+
         def get(lp, keys):
             for kk in keys:
                 lp = lp[kk]
             return lp
         return jnp.asarray(np.stack([get(l, path) for l in layers]), dtype)
 
-    layer_tree = {
-        "input_norm": {"scale": stack("input_norm", "scale")},
-        "attention": {
-            "query_key_value": {
-                "kernel": stack("attention", "query_key_value", "kernel")},
-            "dense": {"kernel": stack("attention", "dense", "kernel")},
-        },
-        "post_attention_norm": {
-            "scale": stack("post_attention_norm", "scale")},
-        "mlp": {
-            "dense_h_to_4h": {
-                "kernel": stack("mlp", "dense_h_to_4h", "kernel")},
-            "dense_4h_to_h": {
-                "kernel": stack("mlp", "dense_4h_to_h", "kernel")},
-        },
-    }
+    layer_tree = stack_tree(layers[0])
     params = {
         "embedding": {
             "word": {"embedding": jnp.asarray(
@@ -143,6 +139,50 @@ def convert_llama_family(hf_model, dtype=np.float32):
         "hidden_dropout": 0.0,
         "attention_dropout": 0.0,
     }
+    return params, config
+
+
+def convert_mixtral(hf_model, dtype=np.float32):
+    """MixtralForCausalLM -> param pytree + config dict.
+
+    The trunk (embeddings, norms, GQA attention, lm_head) converts exactly
+    like the llama family (shared code path); the ``block_sparse_moe``
+    block maps to the MoE MLP layout of ``models/moe.py``:
+
+    * ``gate.weight`` [E, h]      -> router kernel [h, E]
+    * per expert ``w1`` (gate) and ``w3`` (up), both [f, h]
+                                  -> w_in [E, h, 2f] (same GLU halves as
+                                     ``pack_glu_ffn``)
+    * per expert ``w2`` [h, f]    -> w_out [E, f, h]
+    """
+    hf_cfg = hf_model.config
+    E = hf_cfg.num_local_experts
+
+    def moe_mlp(sd, p):
+        moe = p + "block_sparse_moe."
+        return {
+            "router": {"kernel": np.ascontiguousarray(
+                _np(sd[moe + "gate.weight"]).T)},
+            "experts": {
+                "w_in": np.stack([
+                    pack_glu_ffn(_np(sd[f"{moe}experts.{e}.w1.weight"]),
+                                 _np(sd[f"{moe}experts.{e}.w3.weight"]))
+                    for e in range(E)
+                ]),
+                "w_out": np.stack([
+                    np.ascontiguousarray(
+                        _np(sd[f"{moe}experts.{e}.w2.weight"]).T)
+                    for e in range(E)
+                ]),
+            },
+        }
+
+    params, config = convert_llama_family(hf_model, dtype, layer_mlp=moe_mlp)
+    config.update({
+        "rope_theta": getattr(hf_cfg, "rope_theta", 1e6),
+        "num_experts": E,
+        "moe_top_k": hf_cfg.num_experts_per_tok,
+    })
     return params, config
 
 
@@ -271,6 +311,7 @@ CONVERTERS = {
     "llama2": convert_llama_family,
     "codellama": convert_llama_family,
     "mistral": convert_llama_family,
+    "mixtral": convert_mixtral,
     "falcon": convert_falcon,
 }
 

@@ -27,8 +27,19 @@ from weights_conversion.util import (
 )
 
 
-def llama_family_state_dict(params, config):
-    """param pytree -> HF LlamaForCausalLM/MistralForCausalLM state dict."""
+def _dense_glu_mlp_writer(sd, p, g, t):
+    gate, up = unpack_glu_ffn(g("mlp", "dense_h_to_4h", "kernel"))
+    sd[p + "mlp.gate_proj.weight"] = t(gate)
+    sd[p + "mlp.up_proj.weight"] = t(up)
+    sd[p + "mlp.down_proj.weight"] = t(
+        np.ascontiguousarray(g("mlp", "dense_4h_to_h", "kernel").T))
+
+
+def llama_family_state_dict(params, config, *, mlp_writer=None):
+    """param pytree -> HF LlamaForCausalLM/MistralForCausalLM state dict.
+
+    ``mlp_writer(sd, prefix, g, t)``: per-layer mlp emitter hook — defaults
+    to the dense GLU mlp; mixtral_state_dict swaps in the MoE one."""
     import torch
 
     nh = config["num_attention_heads"]
@@ -36,6 +47,7 @@ def llama_family_state_dict(params, config):
     d = config["hidden_size"] // nh
     L = config["num_layers"]
     t = lambda a: torch.tensor(np.asarray(a, np.float32))
+    mlp_writer = mlp_writer or _dense_glu_mlp_writer
 
     sd = {
         "model.embed_tokens.weight": t(
@@ -46,23 +58,17 @@ def llama_family_state_dict(params, config):
     layers = params["transformer"]["layers"]
     for i in range(L):
         g = lambda *path: np.asarray(_index(layers, path, i), np.float32)
+        p = f"model.layers.{i}."
         q, k, v = unpack_qkv(g("attention", "query_key_value", "kernel"),
                              nh, ng, d)
-        sd[f"model.layers.{i}.self_attn.q_proj.weight"] = t(
-            rotary_interleaved_to_hf(q, d))
-        sd[f"model.layers.{i}.self_attn.k_proj.weight"] = t(
-            rotary_interleaved_to_hf(k, d))
-        sd[f"model.layers.{i}.self_attn.v_proj.weight"] = t(v)
-        sd[f"model.layers.{i}.self_attn.o_proj.weight"] = t(
+        sd[p + "self_attn.q_proj.weight"] = t(rotary_interleaved_to_hf(q, d))
+        sd[p + "self_attn.k_proj.weight"] = t(rotary_interleaved_to_hf(k, d))
+        sd[p + "self_attn.v_proj.weight"] = t(v)
+        sd[p + "self_attn.o_proj.weight"] = t(
             np.ascontiguousarray(g("attention", "dense", "kernel").T))
-        gate, up = unpack_glu_ffn(g("mlp", "dense_h_to_4h", "kernel"))
-        sd[f"model.layers.{i}.mlp.gate_proj.weight"] = t(gate)
-        sd[f"model.layers.{i}.mlp.up_proj.weight"] = t(up)
-        sd[f"model.layers.{i}.mlp.down_proj.weight"] = t(
-            np.ascontiguousarray(g("mlp", "dense_4h_to_h", "kernel").T))
-        sd[f"model.layers.{i}.input_layernorm.weight"] = t(
-            g("input_norm", "scale"))
-        sd[f"model.layers.{i}.post_attention_layernorm.weight"] = t(
+        mlp_writer(sd, p, g, t)
+        sd[p + "input_layernorm.weight"] = t(g("input_norm", "scale"))
+        sd[p + "post_attention_layernorm.weight"] = t(
             g("post_attention_norm", "scale"))
     return sd
 
@@ -122,6 +128,28 @@ def falcon_state_dict(params, config):
     return sd
 
 
+def mixtral_state_dict(params, config):
+    """param pytree -> HF MixtralForCausalLM state dict (inverse of
+    hf_to_megatron.convert_mixtral): trunk shared with the llama family,
+    MoE MLP back to block_sparse_moe gate/w1/w2/w3."""
+    E = config["num_experts"]
+
+    def moe_writer(sd, p, g, t):
+        moe = p + "block_sparse_moe."
+        sd[moe + "gate.weight"] = t(
+            np.ascontiguousarray(g("mlp", "router", "kernel").T))
+        w_in = g("mlp", "experts", "w_in")      # [E, h, 2f]
+        w_out = g("mlp", "experts", "w_out")    # [E, f, h]
+        for e in range(E):
+            gate, up = unpack_glu_ffn(w_in[e])
+            sd[f"{moe}experts.{e}.w1.weight"] = t(gate)
+            sd[f"{moe}experts.{e}.w3.weight"] = t(up)
+            sd[f"{moe}experts.{e}.w2.weight"] = t(
+                np.ascontiguousarray(w_out[e].T))
+
+    return llama_family_state_dict(params, config, mlp_writer=moe_writer)
+
+
 def _index(tree, path, i):
     for k in path:
         tree = tree[k]
@@ -157,6 +185,24 @@ def hf_config_for(model_name: str, config: dict):
             max_position_embeddings=config["max_position_embeddings"],
             rms_norm_eps=config.get("layernorm_epsilon", 1e-5),
             sliding_window=config.get("sliding_window_size", 4096),
+            tie_word_embeddings=False,
+        )
+    if model_name == "mixtral":
+        from transformers import MixtralConfig
+
+        return MixtralConfig(
+            vocab_size=config["padded_vocab_size"],
+            hidden_size=config["hidden_size"],
+            intermediate_size=config["ffn_hidden_size"],
+            num_hidden_layers=config["num_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            num_key_value_heads=config.get("num_attention_heads_kv"),
+            max_position_embeddings=config["max_position_embeddings"],
+            rms_norm_eps=config.get("layernorm_epsilon", 1e-5),
+            rope_theta=config.get("rope_theta", 1e6),
+            sliding_window=config.get("sliding_window_size"),
+            num_local_experts=config["num_experts"],
+            num_experts_per_tok=config.get("moe_top_k", 2),
             tie_word_embeddings=False,
         )
     if model_name == "falcon":
@@ -207,8 +253,9 @@ def main():
 
     hf_cfg = hf_config_for(model_name, config)
     hf = AutoModelForCausalLM.from_config(hf_cfg)
-    writer = (falcon_state_dict if model_name == "falcon"
-              else llama_family_state_dict)
+    writer = {"falcon": falcon_state_dict,
+              "mixtral": mixtral_state_dict}.get(
+        model_name, llama_family_state_dict)
     sd = writer(params, config)
     missing, unexpected = hf.load_state_dict(sd, strict=False)
     if missing or unexpected:
