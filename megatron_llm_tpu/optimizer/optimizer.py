@@ -240,6 +240,15 @@ class MegatronOptimizer:
             if not zero1 or dp_size <= 1:
                 return spec
             spec = tuple(spec)
+            # a leaf already sharded over dp (MoE 'expert' axis) cannot take
+            # a second dp dimension — and needs none: its state memory is
+            # already divided by dp
+            from megatron_llm_tpu import topology
+            from megatron_llm_tpu.parallel.sharding import DEFAULT_RULES
+
+            if any(DEFAULT_RULES.get(ax) == topology.DP_AXIS for ax in spec
+                   if ax is not None):
+                return spec
             for i, (ax, dim) in enumerate(zip(spec, leaf.shape)):
                 if ax is None and dim % dp_size == 0:
                     return spec[:i] + ("dp_shard",) + spec[i + 1:]
@@ -257,6 +266,30 @@ class MegatronOptimizer:
             grad_scaler=GradScalerState(scale=None, growth_tracker=None,
                                         hysteresis_tracker=None),
         )
+
+    def shard_zero1(self, opt_state, param_specs, params, dp_size: int, *,
+                    verify: bool = True, min_bytes: int = 32 << 10):
+        """Lay the optimizer state out ZeRO-1 (dp-sharded) on the mesh and
+        verify nothing sizeable stayed replicated — the one-call form of
+        state_specs + shard + verify used by the driver dryrun and tests.
+        Also shards fp32 masters when the optimizer keeps them."""
+        from megatron_llm_tpu.parallel import sharding as sh
+
+        specs = self.state_specs(param_specs, params, zero1=True,
+                                 dp_size=dp_size)
+        opt_state = opt_state._replace(
+            exp_avg=sh.shard_params(opt_state.exp_avg, specs.exp_avg),
+            exp_avg_sq=(
+                sh.shard_params(opt_state.exp_avg_sq, specs.exp_avg_sq)
+                if opt_state.exp_avg_sq is not None else None),
+            master_params=(
+                sh.shard_params(opt_state.master_params,
+                                specs.master_params)
+                if opt_state.master_params is not None else None),
+        )
+        if verify and dp_size > 1:
+            self.verify_zero1_sharding(opt_state, min_bytes=min_bytes)
+        return opt_state
 
     def verify_zero1_sharding(self, opt_state, *, dp_axis: str = "dp",
                               min_bytes: int = 1 << 20):

@@ -176,3 +176,70 @@ def test_non_gpt_families_reject_moe():
         BertModel(cfg)
     with pytest.raises(NotImplementedError, match="GPT family"):
         T5Model(cfg)
+
+
+def test_moe_kv_cache_decode_matches_full_forward(utils):
+    """Incremental MoE decode (capacity floor covers s=1 routing) must
+    reproduce the one-shot causal forward logits."""
+    from megatron_llm_tpu.models.mixtral import MixtralModel, mixtral_config
+    from megatron_llm_tpu.text_generation.generation import (
+        _forward_with_cache,
+        init_kv_caches,
+    )
+
+    cfg = mixtral_config(
+        "tiny", num_layers=2, seq_length=64, max_position_embeddings=64,
+        padded_vocab_size=64, use_flash_attn=False,
+        moe_capacity_factor=8.0,
+    )
+    model = MixtralModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 64, (2, 10)))
+
+    full_logits = model(params, toks, train=False)
+
+    caches = init_kv_caches(model.cfg, 2, 16)
+    logits_p, caches = _forward_with_cache(model, params, toks[:, :4],
+                                           caches, 0)
+    parts = [logits_p]
+    for t in range(4, 10):
+        lg, caches = _forward_with_cache(model, params, toks[:, t:t + 1],
+                                         caches, t)
+        parts.append(lg)
+    inc_logits = jnp.concatenate(parts, axis=1)
+    np.testing.assert_allclose(np.asarray(inc_logits),
+                               np.asarray(full_logits), atol=2e-4)
+
+
+def test_zero1_shards_moe_expert_state(utils):
+    """ZeRO-1 state sharding must dp-shard the (large) expert optimizer
+    moments, not silently replicate them."""
+    from megatron_llm_tpu import topology
+    from megatron_llm_tpu.config import TrainConfig
+    from megatron_llm_tpu.models.mixtral import MixtralModel, mixtral_config
+    from megatron_llm_tpu.optimizer import MegatronOptimizer
+    from megatron_llm_tpu.parallel import sharding as sh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = mixtral_config(
+        "tiny", num_layers=2, seq_length=32, max_position_embeddings=32,
+        padded_vocab_size=256, num_experts=8, hidden_size=64,
+        ffn_hidden_size=176, num_attention_heads=4,
+        num_attention_heads_kv=2, use_flash_attn=False,
+    )
+    model = MixtralModel(cfg)
+    topology.initialize_model_parallel(tensor_model_parallel_size=2)  # dp=4
+    try:
+        params = model.init(jax.random.PRNGKey(0))
+        params = sh.shard_params(params, model.param_specs(params))
+        opt = MegatronOptimizer(TrainConfig(lr=1e-3))
+        opt_state = opt.init(params)
+        opt_state = opt.shard_zero1(opt_state, model.param_specs(params),
+                                    params, 4, min_bytes=16 << 10)
+        w_in_spec = opt_state.exp_avg[
+            "transformer"]["layers"]["mlp"]["experts"]["w_in"].sharding.spec
+        assert "dp" in jax.tree_util.tree_leaves(list(w_in_spec)), w_in_spec
+    finally:
+        topology.destroy_model_parallel()

@@ -237,8 +237,9 @@ def test_hf_mixtral_logit_parity_and_roundtrip():
     )
     hf = MixtralForCausalLM(hf_cfg).eval()
     params, config = convert_mixtral(hf)
-    cfg = TransformerConfig(**config, use_flash_attn=False,
-                            moe_capacity_factor=16.0)
+    # the converted config must itself carry dropless capacity (E/top_k)
+    assert config["moe_capacity_factor"] == 2.0
+    cfg = TransformerConfig(**config, use_flash_attn=False)
     model = MixtralModel(cfg)
 
     toks = np.random.RandomState(0).randint(0, 128, (2, 16))

@@ -178,10 +178,15 @@ def convert_mixtral(hf_model, dtype=np.float32):
         }
 
     params, config = convert_llama_family(hf_model, dtype, layer_mlp=moe_mlp)
+    top_k = hf_cfg.num_experts_per_tok
     config.update({
-        "rope_theta": getattr(hf_cfg, "rope_theta", 1e6),
         "num_experts": E,
-        "moe_top_k": hf_cfg.num_experts_per_tok,
+        "moe_top_k": top_k,
+        # HF Mixtral routing is dropless; E/top_k makes the per-row expert
+        # buffers cover every token so converted models reproduce HF logits
+        # exactly.  Training users who want capacity-style dropping can
+        # lower this (1.25 is the framework default for from-scratch MoE).
+        "moe_capacity_factor": float(E) / top_k,
     })
     return params, config
 
