@@ -4,7 +4,7 @@
 # launch: no torchrun — one process per host; multi-host runs set
 # RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT (jax.distributed bootstrap).
 #
-# Usage: examples/finetune.sh <gpt/llama/llama2/codellama/falcon/mistral>
+# Usage: examples/finetune.sh <gpt/llama/llama2/codellama/falcon/mistral/mixtral>
 #        [--tp=8] [--pp=1] [--micro-batch=1] [--global-batch=12]
 #        [--iters=1000] [--checkpoint=...] [--data=...] [--out=...]
 #        [--seq-len=...] [--instruct] [--wandb]
@@ -47,6 +47,12 @@ case $MODEL in
     SEQ_DEFAULT=8192
     EXTRA=(--use_rms_norm --glu_activation swiglu --no_tie_embed_logits
            --position_embedding_type rotary --sliding_window_size 4096)
+    TOKENIZER=SentencePieceTokenizer;;
+  mixtral)
+    SEQ_DEFAULT=8192
+    EXTRA=(--use_rms_norm --glu_activation swiglu --no_tie_embed_logits
+           --position_embedding_type rotary --num_experts 8 --moe_top_k 2
+           --rope_theta 1e6)
     TOKENIZER=SentencePieceTokenizer;;
   falcon)
     SEQ_DEFAULT=2048
