@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from megatron_llm_tpu import checkpointing, topology
+from megatron_llm_tpu.data.data_samplers import place_host_batch
 from megatron_llm_tpu.arguments import (
     parallel_config_from_args,
     train_config_from_args,
@@ -159,8 +160,7 @@ def build_data_iterator(args, mesh, num_micro):
             return None
         def gen():
             for b in it:
-                yield {k: jax.device_put(jnp.asarray(v), dsh)
-                       for k, v in b.items()}
+                yield {k: place_host_batch(v, dsh) for k, v in b.items()}
         return gen()
 
     return shard(host_iter), shard(eval_iter)

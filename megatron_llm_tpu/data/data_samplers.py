@@ -6,11 +6,10 @@ Reference: ``megatron/data/data_samplers.py`` —
 (:120+) shuffles per epoch with a seed derived from the epoch.
 
 TPU adaptation: under a single controller the loader yields **global**
-batches shaped ``[num_micro, micro_batch * dp, seq]``; device placement
-shards the batch axis over dp (``jax.device_put`` single-host,
-``jax.make_array_from_process_local_data`` multi-host, where each process
-reads only its own dp-block of sample indices — the same per-rank slicing
-as the reference, moved from the sampler into the host-data step).
+batches shaped ``[num_micro, micro_batch * dp, seq]``; ``place_host_batch``
+shards the batch axis over dp (``jax.device_put`` single-host;
+``jax.make_array_from_callback`` multi-host, where every process builds
+the same global host batch and transfers only its addressable shards).
 There is no tp broadcast: TP ranks consume the same global array
 (reference needed ``broadcast_data``, core/tensor_parallel/data.py:65-105).
 """
@@ -94,6 +93,28 @@ class MegatronPretrainingRandomSampler:
                     break
                 self.consumed_samples += len(batch)
                 yield batch
+
+
+def place_host_batch(arr, sharding):
+    """Host array -> global ``jax.Array`` laid out per ``sharding``.
+
+    Single-process: a plain ``device_put``.  Multi-process (multi-host
+    DCN): every process has built the same global host batch, and
+    ``jax.make_array_from_callback`` hands each process only its
+    *addressable* shards to transfer — the multi-host assembly that
+    replaces the reference's tp-rank-0-reads-then-broadcasts protocol
+    (``core/tensor_parallel/data.py:65-105``).  Hosts read the full
+    global batch (read amplification across hosts, device transfer only
+    for local shards); restricting the host read to the local dp block
+    is a further optimization the sampler's index batches permit.
+    """
+    import jax
+
+    arr = np.asarray(arr)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
 
 
 def build_pretraining_data_loader(

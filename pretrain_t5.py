@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from megatron_llm_tpu import checkpointing, topology
+from megatron_llm_tpu.data.data_samplers import place_host_batch
 from megatron_llm_tpu.arguments import (
     parallel_config_from_args,
     train_config_from_args,
@@ -90,9 +91,9 @@ def build_data_iterator(args, mesh, num_micro):
         for b in host_iter:
             out = {}
             for k, v in b.items():
-                arr = jnp.asarray(v)
+                arr = np.asarray(v)
                 spec = [None, "dp"] + [None] * (arr.ndim - 2)
-                out[k] = jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+                out[k] = place_host_batch(arr, NamedSharding(mesh, P(*spec)))
             yield out
 
     return gen()

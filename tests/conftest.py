@@ -16,16 +16,16 @@ import os
 # process*.  Raise them — slow is fine, SIGABRT mid-suite is not.
 _WANTED_FLAGS = (
     "--xla_force_host_platform_device_count=8 "
-    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120 "
-    "--xla_cpu_collective_call_terminate_timeout_seconds=1200"
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=300 "
+    "--xla_cpu_collective_call_terminate_timeout_seconds=7200"
 )
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     _flags = (_flags + " " + _WANTED_FLAGS).strip()
 elif "collective_call_terminate_timeout" not in _flags:
     _flags = (_flags + " "
-              + "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120 "
-              + "--xla_cpu_collective_call_terminate_timeout_seconds=1200")
+              + "--xla_cpu_collective_call_warn_stuck_timeout_seconds=300 "
+              + "--xla_cpu_collective_call_terminate_timeout_seconds=7200")
 os.environ["XLA_FLAGS"] = _flags
 os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -67,3 +67,16 @@ def utils():
 def _reset_topology():
     yield
     topology.destroy_model_parallel()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled executables + tracing caches between test modules.
+
+    A full-suite run accumulates hundreds of compiled shard_map programs;
+    on a small CI box the later heavyweight modules (test_pipeline's 1F1B
+    engines) then slow to the point of tripping XLA's collective-call
+    terminate timeout — a SIGABRT, not a failure.  Per-module cache
+    clearing keeps each module's footprint what it is when run alone."""
+    yield
+    jax.clear_caches()
