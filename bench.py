@@ -262,7 +262,7 @@ def child_main():
 
 def run_child(force_cpu: bool, deadline_s: float, init_s: float,
               extra_env: dict | None = None):
-    """Run the measurement child; returns the JSON line or None.
+    """Run the measurement child; returns (json_line or None, failure_why).
 
     Two kill conditions: a hard overall deadline, and an init timeout —
     the child hasn't logged the BENCH_INIT_OK sentinel within
@@ -333,14 +333,16 @@ def run_child(force_cpu: bool, deadline_s: float, init_s: float,
     t_err.join(timeout=5)
     t_out.join(timeout=5)
     if why is None and proc.returncode != 0:
-        log(f"parent: child exited rc={proc.returncode}")
+        why = f"child exited rc={proc.returncode}"
+        log(f"parent: {why}")
     for line in state["out"]:
         line = line.strip()
         if line.startswith("{") and '"metric"' in line:
-            return line
-    if why is None and proc.returncode == 0:
-        log("parent: child produced no JSON line")
-    return None
+            return line, None
+    if why is None:
+        why = "child produced no JSON line"
+        log(f"parent: {why}")
+    return None, why
 
 
 def main():
@@ -363,12 +365,28 @@ def main():
                          "extra_env": {"BENCH_NO_PALLAS": "1"}})
     attempts.append({"force_cpu": True, "deadline_s": 120.0, "init_s": 60.0})
 
+    failures = []
     for i, a in enumerate(attempts):
-        line = run_child(**a)
+        line, why = run_child(**a)
         if line is not None:
+            if a.get("force_cpu") and i > 0:
+                # every TPU attempt failed and this measurement is the CPU
+                # safety net — record the ACTUAL per-attempt failures in
+                # the artifact instead of looking like a choice
+                try:
+                    rec = json.loads(line)
+                    rec["tpu_fallback_reason"] = (
+                        "TPU attempts failed: "
+                        + "; ".join(failures)
+                        + " — see docs/perf_tpu.md for the recorded "
+                          "on-chip measurements")
+                    line = json.dumps(rec)
+                except ValueError:
+                    pass
             print(line, flush=True)
             log("parent: done")
             return 0
+        failures.append(f"attempt {i + 1}: {why}")
         if i + 1 < len(attempts):
             log("parent: falling back")
     log("parent: all attempts failed")
