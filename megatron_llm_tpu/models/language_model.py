@@ -205,15 +205,9 @@ def vocab_parallel_lookup_manual(table: jax.Array,
     # the call site sits inside a pp-manual shard_map: the nested region
     # must use the *context* (abstract) mesh and re-declare every
     # already-manual axis alongside the newly manualized tp
-    am = jax.sharding.get_abstract_mesh()
-    if am is None or not am.axis_names:
-        am = topology.get_mesh()
-    if tp_axis not in am.axis_names or am.shape[tp_axis] == 1:
+    am, manual = topology.nesting_mesh(tp_axis)
+    if am is None:
         return scatter_free_lookup(table, tokens)
-    manual = {
-        name for name, t in zip(am.axis_names, am.axis_types)
-        if "Manual" in str(t)
-    }
 
     def local(table_l, toks):
         vl = table_l.shape[0]

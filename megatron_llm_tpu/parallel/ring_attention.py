@@ -157,8 +157,19 @@ def context_parallel_attention(
     q_chunk_size: int = DEFAULT_Q_CHUNK,
 ):
     """shard_map wrapper: q/k/v are global arrays with the sequence axis
-    sharded over cp ('batch','seq_cp',heads,d); returns same layout."""
-    mesh = topology.get_mesh()
+    sharded over cp ('batch','seq_cp',heads,d); returns same layout.
+
+    Nests under the pipeline engine's pp-manual shard_map: inside a manual
+    region jax requires the *abstract* context mesh (whose pp axis is
+    already Manual) and the re-declaration of its manual axes
+    (``topology.nesting_mesh``)."""
+    mesh, manual = topology.nesting_mesh(topology.CP_AXIS)
+    if mesh is None:
+        raise RuntimeError(
+            "context_parallel_attention called with no usable 'cp' axis in "
+            "scope (callers gate on get_context_parallel_world_size() > 1; "
+            "an enclosing custom mesh without a cp axis cannot host ring "
+            "attention)")
     fn = partial(
         ring_self_attention,
         axis_name=topology.CP_AXIS,
@@ -173,6 +184,6 @@ def context_parallel_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        axis_names={topology.CP_AXIS},
+        axis_names=manual | {topology.CP_AXIS},
         check_vma=False,
     )(q, k, v)

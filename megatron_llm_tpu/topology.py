@@ -196,6 +196,31 @@ def initialize_distributed(
 # Sharding constructors.
 # ---------------------------------------------------------------------------
 
+def nesting_mesh(required_axis: str):
+    """Mesh + already-manual axes for a shard_map that may nest inside
+    another manual region (the pipeline engines).
+
+    Inside an enclosing manual shard_map jax requires the *abstract*
+    context mesh and the re-declaration of every already-Manual axis;
+    outside, the concrete device mesh.  Returns ``(mesh, manual_axes)``,
+    or ``(None, None)`` when ``required_axis`` is absent or size 1 in the
+    selected mesh — the caller should fall back to its unsharded path.
+    Shared by ``vocab_parallel_lookup_manual`` and
+    ``context_parallel_attention``."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if (mesh is None or not mesh.axis_names
+            or required_axis not in mesh.axis_names):
+        mesh = _MESH
+    if (mesh is None or required_axis not in mesh.axis_names
+            or mesh.shape[required_axis] == 1):
+        return None, None
+    manual = {
+        name for name, t in zip(mesh.axis_names, mesh.axis_types)
+        if "Manual" in str(t)
+    }
+    return mesh, manual
+
+
 def named_sharding(*spec) -> NamedSharding:
     return NamedSharding(get_mesh(), P(*spec))
 

@@ -383,3 +383,26 @@ def test_moe_pipeline_grad_parity_1f1b(utils):
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5,
                                    err_msg=str(pa))
+
+
+def test_pipeline_with_context_parallelism(utils):
+    """pp=2 x cp=2 x dp=2: ring attention (a cp shard_map nested inside
+    the pp-manual region, using the abstract context mesh) matches the
+    unpipelined, unsharded loss."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = llama_config("tiny", num_layers=4, seq_length=64,
+                       max_position_embeddings=64, padded_vocab_size=128)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = _batch(2, 2, 64, 128)
+    base = float(_unpiped_loss(model, params, batch))
+
+    mesh = utils.initialize_model_parallel(tp=1, pp=2, cp=2)
+    ps = sh.shard_params(params, model.param_specs(params))
+    dsh = NamedSharding(mesh, P(None, "dp", "cp"))
+    batch_s = {k: jax.device_put(v, dsh) for k, v in batch.items()}
+    loss_fn = build_pipeline_loss_fn(model, 2, 2)
+    out = jax.jit(lambda p, b, k: loss_fn(p, b, k, train=False)[1])(
+        ps, batch_s, jax.random.PRNGKey(0))
+    assert abs(float(out) - base) < 1e-3
