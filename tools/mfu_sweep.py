@@ -29,7 +29,8 @@ from megatron_llm_tpu.training import build_train_step
 PEAK = 197e12
 
 def bench_cfg(label, mb=8, remat="selective", flash=True, fused_rms=True,
-              L=16, h=1280, ffn=3584, heads=16, seq=2048, iters=5, bq=None, bk=None):
+              L=16, h=1280, ffn=3584, heads=16, seq=2048, iters=5, bq=None,
+              bk=None, experts=0, top_k=2):
     import megatron_llm_tpu.ops.pallas.flash_attention as fa
     orig_bq, orig_bk = fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K
     if bq: fa.DEFAULT_BLOCK_Q = bq
@@ -37,7 +38,8 @@ def bench_cfg(label, mb=8, remat="selective", flash=True, fused_rms=True,
     cfg = llama_config("tiny", num_layers=L, hidden_size=h, num_attention_heads=heads,
         ffn_hidden_size=ffn, padded_vocab_size=32000, seq_length=seq,
         max_position_embeddings=seq, params_dtype="bf16", compute_dtype="bf16",
-        recompute_granularity=remat, use_flash_attn=flash, use_fused_rmsnorm=fused_rms)
+        recompute_granularity=remat, use_flash_attn=flash, use_fused_rmsnorm=fused_rms,
+        num_experts=experts, moe_top_k=top_k)
     model = LlamaModel(cfg)
     params = model.init(jax.random.PRNGKey(0))
     n = model.num_params(params)
@@ -114,6 +116,17 @@ GROUPS["tune650"] = [
     dict(label="650M bq1024 bk512", mb=4, h=2048, heads=16, ffn=5632, L=10, bq=1024, bk=512),
     dict(label="650M remat full", mb=4, h=2048, heads=16, ffn=5632, L=10, remat="full"),
     dict(label="650M mb6", mb=6, h=2048, heads=16, ffn=5632, L=10),
+]
+GROUPS["moe"] = [
+    # MoE on one chip: all experts local (ep needs a mesh); measures the
+    # dispatch/combine einsum overhead vs the dense MLP at matched
+    # active-FLOPs (dense ffn == top_k * moe ffn per token)
+    dict(label="dense h2048 L10 ffn5632 (bench)",
+         mb=4, h=2048, heads=16, ffn=5632, L=10),
+    dict(label="moe E4 top2 ffn2816 (matched active)",
+         mb=4, h=2048, heads=16, ffn=2816, L=10, experts=4),
+    dict(label="moe E8 top2 ffn2816",
+         mb=4, h=2048, heads=16, ffn=2816, L=10, experts=8),
 ]
 GROUPS["all"] = GROUPS["baseline"] + GROUPS["blocks"]
 
