@@ -455,6 +455,25 @@ def validate_args(args, world_size: Optional[int] = None):
     if args.sequence_parallel and args.tensor_model_parallel_size == 1:
         args.sequence_parallel = False
 
+    # Dropless-style capacity (c >= s*k/E, i.e. factor >= E/top_k) is what
+    # convert_mixtral records so converted models reproduce HF logits; for
+    # TRAINING the dispatch/combine one-hots are O(b*s*k*E*c) fp32 — at
+    # factor E/k that is O(b*s^2*k) per microbatch and an easy OOM at long
+    # seq.  Warn here (validate_args runs after --use_checkpoint_args
+    # adoption) rather than silently training into it.
+    if getattr(args, "num_experts", 0) and args.num_experts > 1:
+        dropless = args.num_experts / max(args.moe_top_k, 1)
+        if args.moe_capacity_factor >= dropless:
+            print(
+                f" > WARNING: moe_capacity_factor "
+                f"({args.moe_capacity_factor:g}) >= num_experts/top_k "
+                f"({dropless:g}) is a DROPLESS (inference-exact) setting; "
+                f"the MoE dispatch buffers scale O(seq^2) with it at "
+                f"seq_length={args.seq_length}.  For training, "
+                f"--moe_capacity_factor 1.25 (the default) is the usual "
+                f"choice.", flush=True,
+            )
+
     return args
 
 

@@ -184,6 +184,11 @@ class TransformerConfig:
     moe_min_capacity: int = 4            # capacity floor (decode s=1)
     moe_aux_loss_coeff: float = 1e-2     # load-balance loss weight
     moe_z_loss_coeff: float = 0.0        # router logit z-loss weight
+    # expert-dim placement: "auto" derives from the live mesh (E % dp == 0)
+    # and is resolved ONCE at model construction (GPTModel.__init__) so
+    # param-spec time and trace time cannot disagree if the mesh changes in
+    # between (round-3 advisor finding); "expert" / "replicated" force it.
+    moe_expert_axis: str = "auto"
 
     def __post_init__(self):
         if self.ffn_hidden_size is None:
@@ -212,6 +217,10 @@ class TransformerConfig:
                 raise ValueError(
                     f"moe_top_k ({self.moe_top_k}) must be in "
                     f"[1, num_experts={self.num_experts}]")
+            if self.moe_expert_axis not in ("auto", "expert", "replicated"):
+                raise ValueError(
+                    f"moe_expert_axis must be auto|expert|replicated, got "
+                    f"{self.moe_expert_axis!r}")
 
     # convenience ------------------------------------------------------
     @property

@@ -16,6 +16,7 @@ There is no tp broadcast: TP ranks consume the same global array
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Iterator, Optional
@@ -113,8 +114,37 @@ def place_host_batch(arr, sharding):
     arr = np.asarray(arr)
     if jax.process_count() == 1:
         return jax.device_put(arr, sharding)
+    if os.environ.get("MEGATRON_TPU_DATA_CHECKSUM") == "1":
+        _verify_cross_host_batch(arr)
     return jax.make_array_from_callback(
         arr.shape, sharding, lambda idx: arr[idx])
+
+
+def _verify_cross_host_batch(arr):
+    """Debug-mode guard for the multi-host contract above: every process
+    must have built a byte-identical global batch, or the assembled
+    ``jax.Array`` is silently inconsistent and training corrupts.  Enabled
+    with ``MEGATRON_TPU_DATA_CHECKSUM=1``; costs one tiny allgather per
+    batch.  (round-3 advisor finding)
+
+    The env var must be set on **every** process of the job: the allgather
+    is a collective, and a process that skips it while others enter it
+    deadlocks the first batch (launchers should export it job-wide, like
+    any other collective-affecting flag)."""
+    import zlib
+
+    import jax
+    from jax.experimental import multihost_utils
+
+    h = zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+    all_h = np.asarray(
+        multihost_utils.process_allgather(np.uint32(h))).reshape(-1)
+    if not (all_h == all_h[0]).all():
+        raise RuntimeError(
+            "place_host_batch: host batches DIVERGE across processes "
+            f"(crc32 per process: {[hex(int(x)) for x in all_h]}); every "
+            "process must build the same global batch — check dataloader "
+            "seeds/sharding")
 
 
 def build_pretraining_data_loader(

@@ -43,7 +43,11 @@ class GPTModel:
     pytree owned by the caller."""
 
     def __init__(self, cfg: TransformerConfig):
-        self.cfg = cfg
+        from megatron_llm_tpu.models.moe import resolve_expert_axis
+
+        # pin the MoE expert-dim placement to the mesh as it stands NOW, so
+        # spec time and trace time agree even across a mesh re-init
+        self.cfg = resolve_expert_axis(cfg)
 
     # -- params ------------------------------------------------------------
     def init(self, key) -> dict:

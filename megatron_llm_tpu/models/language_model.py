@@ -88,9 +88,11 @@ def _norm_spec(p, stacked):
     return {k: lead + (None,) for k in p}
 
 
-def transformer_layer_specs(layers, stacked: bool = True) -> dict:
+def transformer_layer_specs(layers, stacked: bool = True, cfg=None) -> dict:
     """Logical-axis specs for one (layer-stacked) transformer layer pytree,
-    including the decoder ``inter_attention`` block when present."""
+    including the decoder ``inter_attention`` block when present.  ``cfg``
+    (when given) carries the resolved ``moe_expert_axis`` so MoE specs
+    don't re-derive placement from the live mesh."""
     layer_specs = {
         "input_norm": _norm_spec(layers["input_norm"], stacked),
         "attention": {
@@ -102,7 +104,7 @@ def transformer_layer_specs(layers, stacked: bool = True) -> dict:
             ),
         },
         "mlp": (
-            moe_mlp_specs(layers["mlp"], stacked)
+            moe_mlp_specs(layers["mlp"], stacked, cfg=cfg)
             if "experts" in layers["mlp"]
             else {
                 "dense_h_to_4h": _linear_spec(
@@ -133,9 +135,9 @@ def transformer_layer_specs(layers, stacked: bool = True) -> dict:
     return layer_specs
 
 
-def transformer_stack_specs(stack_params) -> dict:
+def transformer_stack_specs(stack_params, cfg=None) -> dict:
     return {
-        "layers": transformer_layer_specs(stack_params["layers"]),
+        "layers": transformer_layer_specs(stack_params["layers"], cfg=cfg),
         "final_norm": _norm_spec(stack_params["final_norm"], False),
     }
 
@@ -144,7 +146,8 @@ def language_model_param_specs(params, cfg: TransformerConfig):
     """Logical-axis spec pytree matching ``init_language_model_params``
     (consumed by ``parallel.sharding.shard_params``)."""
     norm_spec = _norm_spec
-    layer_specs = transformer_layer_specs(params["transformer"]["layers"])
+    layer_specs = transformer_layer_specs(
+        params["transformer"]["layers"], cfg=cfg)
 
     specs = {
         "embedding": {"word": {"embedding": ("vocab", None)}},

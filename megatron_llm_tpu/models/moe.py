@@ -54,7 +54,13 @@ def moe_capacity(cfg: TransformerConfig, seq_len: int) -> int:
 def expert_axis(num_experts: int):
     """``'expert'`` when the expert dim can shard over dp (E % dp == 0 on
     an initialized mesh), else ``None`` (replicated experts — correct, just
-    not expert-parallel; covers tiny-E tests and E < dp meshes)."""
+    not expert-parallel; covers tiny-E tests and E < dp meshes).
+
+    Reads *global* topology state — callers on the model path must resolve
+    this once (``resolve_expert_axis``) and carry the answer in
+    ``cfg.moe_expert_axis`` so param placement (spec time) and activation
+    constraints (trace time) cannot diverge if the mesh is re-initialized
+    in between (round-3 advisor finding)."""
     from megatron_llm_tpu import topology
 
     try:
@@ -62,6 +68,34 @@ def expert_axis(num_experts: int):
     except RuntimeError:
         return None
     return "expert" if num_experts % dp == 0 else None
+
+
+def resolve_expert_axis(cfg: TransformerConfig) -> TransformerConfig:
+    """Pin ``moe_expert_axis='auto'`` to the current mesh's answer; no-op
+    for dense configs or already-resolved ones.  With NO mesh initialized
+    yet the config stays ``'auto'`` (later live derivation) — pinning
+    'replicated' here would permanently disable expert parallelism for a
+    model constructed before ``initialize_model_parallel``."""
+    if cfg.num_experts > 1 and cfg.moe_expert_axis == "auto":
+        from megatron_llm_tpu import topology
+
+        try:
+            dp = topology.get_data_parallel_world_size()
+        except RuntimeError:
+            return cfg
+        return cfg.replace(
+            moe_expert_axis="expert" if cfg.num_experts % dp == 0
+            else "replicated")
+    return cfg
+
+
+def _cfg_expert_axis(cfg: TransformerConfig):
+    """Resolved logical axis for the expert dim: ``'expert'`` or ``None``.
+    Falls back to live derivation only for unresolved (``'auto'``) configs
+    — direct unit-test calls that never went through a model wrapper."""
+    if cfg.moe_expert_axis == "auto":
+        return expert_axis(cfg.num_experts)
+    return "expert" if cfg.moe_expert_axis == "expert" else None
 
 
 def init_moe_mlp_params(key, cfg: TransformerConfig, dtype):
@@ -85,10 +119,10 @@ def init_moe_mlp_params(key, cfg: TransformerConfig, dtype):
     }
 
 
-def moe_mlp_specs(params, stacked: bool = True) -> dict:
+def moe_mlp_specs(params, stacked: bool = True, cfg=None) -> dict:
     lead = ("stage",) if stacked else ()
     E = params["experts"]["w_in"].shape[1 if stacked else 0]
-    ex = expert_axis(E)
+    ex = _cfg_expert_axis(cfg) if cfg is not None else expert_axis(E)
     return {
         "router": {"kernel": lead + (None, None)},
         "experts": {
@@ -141,7 +175,7 @@ def moe_mlp(
     disp_tok = jnp.sum(disp4, axis=2)                          # [b, s, E, c]
 
     # --- dispatch: [E, b, c, h], expert dim onto the dp axis (all-to-all) ---
-    ex = expert_axis(E)
+    ex = _cfg_expert_axis(cfg)
     expert_in = jnp.einsum(
         "bsec,bsh->ebch", disp_tok.astype(cdtype), x.astype(cdtype))
     expert_in = constrain(expert_in, ex, None, None, None)

@@ -225,7 +225,8 @@ class MegatronOptimizer:
         return new_params, new_state, stats
 
     # ------------------------------------------------------------------
-    def state_specs(self, param_specs, params, zero1: bool = False, dp_size: int = 1):
+    def state_specs(self, param_specs, params, zero1: bool = False,
+                    dp_size: int = 1, rules=None):
         """Logical-axis specs for the optimizer state.
 
         With ``zero1`` (reference DistributedOptimizer,
@@ -234,6 +235,11 @@ class MegatronOptimizer:
         formulation of ZeRO-1 (state memory / dp; XLA inserts the
         reduce-scatter/all-gather pair the reference issues by hand in
         reduce_model_grads/gather_model_params).
+
+        ``rules`` must be the same logical->mesh table the params were
+        sharded with (defaults to ``DEFAULT_RULES``): the already-on-dp
+        skip below reads it, and a custom table could otherwise map an
+        axis onto dp (or off it) differently than the real param layout.
         """
 
         def shard_dp(spec, leaf):
@@ -246,7 +252,8 @@ class MegatronOptimizer:
             from megatron_llm_tpu import topology
             from megatron_llm_tpu.parallel.sharding import DEFAULT_RULES
 
-            if any(DEFAULT_RULES.get(ax) == topology.DP_AXIS for ax in spec
+            active = rules if rules is not None else DEFAULT_RULES
+            if any(active.get(ax) == topology.DP_AXIS for ax in spec
                    if ax is not None):
                 return spec
             for i, (ax, dim) in enumerate(zip(spec, leaf.shape)):
@@ -268,23 +275,33 @@ class MegatronOptimizer:
         )
 
     def shard_zero1(self, opt_state, param_specs, params, dp_size: int, *,
-                    verify: bool = True, min_bytes: int = 32 << 10):
+                    verify: bool = True, min_bytes: int = 32 << 10,
+                    rules=None):
         """Lay the optimizer state out ZeRO-1 (dp-sharded) on the mesh and
         verify nothing sizeable stayed replicated — the one-call form of
         state_specs + shard + verify used by the driver dryrun and tests.
-        Also shards fp32 masters when the optimizer keeps them."""
+        Also shards fp32 masters when the optimizer keeps them.  Pass the
+        same ``rules`` the params were sharded with (if custom)."""
+        from megatron_llm_tpu import topology
         from megatron_llm_tpu.parallel import sharding as sh
 
+        if rules is not None and "dp_shard" not in rules:
+            # the synthetic ZeRO-1 axis must map to dp even under custom
+            # tables, or the whole state silently stays replicated
+            rules = {**rules, "dp_shard": topology.DP_AXIS}
+
         specs = self.state_specs(param_specs, params, zero1=True,
-                                 dp_size=dp_size)
+                                 dp_size=dp_size, rules=rules)
         opt_state = opt_state._replace(
-            exp_avg=sh.shard_params(opt_state.exp_avg, specs.exp_avg),
+            exp_avg=sh.shard_params(opt_state.exp_avg, specs.exp_avg,
+                                    rules=rules),
             exp_avg_sq=(
-                sh.shard_params(opt_state.exp_avg_sq, specs.exp_avg_sq)
+                sh.shard_params(opt_state.exp_avg_sq, specs.exp_avg_sq,
+                                rules=rules)
                 if opt_state.exp_avg_sq is not None else None),
             master_params=(
                 sh.shard_params(opt_state.master_params,
-                                specs.master_params)
+                                specs.master_params, rules=rules)
                 if opt_state.master_params is not None else None),
         )
         if verify and dp_size > 1:

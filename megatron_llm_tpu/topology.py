@@ -208,9 +208,16 @@ def nesting_mesh(required_axis: str):
     Shared by ``vocab_parallel_lookup_manual`` and
     ``context_parallel_attention``."""
     mesh = jax.sharding.get_abstract_mesh()
-    if (mesh is None or not mesh.axis_names
-            or required_axis not in mesh.axis_names):
+    if mesh is None or not mesh.axis_names:
+        # not inside any mesh context: the concrete global mesh governs
         mesh = _MESH
+    elif required_axis not in mesh.axis_names:
+        # an abstract mesh IS active but doesn't carry the axis: do NOT
+        # silently switch to the global mesh — a nested shard_map over a
+        # different mesh than the enclosing context fails with an opaque
+        # jax error; (None, None) routes callers to their clean fallback
+        # (round-3 advisor finding)
+        return None, None
     if (mesh is None or required_axis not in mesh.axis_names
             or mesh.shape[required_axis] == 1):
         return None, None
