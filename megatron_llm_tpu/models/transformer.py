@@ -303,18 +303,15 @@ def attention(
         _topo.get_context_parallel_world_size()
         if _topo.model_parallel_is_initialized() else 1
     )
-    use_ring = (
-        cp_size > 1
-        and kv_cache is None
+    # flash/ring/chunked all hardcode causal(+window) masking and no
+    # dropout — one eligibility predicate for the three paths
+    flash_eligible = (
+        kv_cache is None
         and attention_mask is None
         and not (train and cfg.attention_dropout > 0.0)
     )
-    use_flash = (
-        cfg.use_flash_attn
-        and kv_cache is None
-        and attention_mask is None
-        and not (train and cfg.attention_dropout > 0.0)
-    )
+    use_ring = cp_size > 1 and flash_eligible
+    use_flash = cfg.use_flash_attn and flash_eligible
     if use_ring:
         from megatron_llm_tpu.parallel.ring_attention import (
             context_parallel_attention,
@@ -336,7 +333,26 @@ def attention(
             softmax_scale=1.0 / math.sqrt(cfg.head_dim),
         )
     else:
-        ctx = core_attention(q, k, v, cfg, attention_mask, dropout_key, train)
+        from megatron_llm_tpu.ops.chunked_attention import (
+            CHUNKED_ATTENTION_MIN_SEQ,
+            chunked_causal_attention,
+        )
+
+        # long-context XLA fallback: the [s, s] score tensor of the plain
+        # path fails to compile at seq >= 4096 on this stack
+        # (docs/perf_tpu.md), which would turn a flash-kernel degradation
+        # into a dead run exactly when the fallback matters; the q-chunked
+        # path is exact and bounds score memory per chunk
+        if flash_eligible and q.shape[1] >= CHUNKED_ATTENTION_MIN_SEQ:
+            ctx = chunked_causal_attention(
+                q, k, v,
+                causal=True,
+                sliding_window=cfg.sliding_window_size,
+                softmax_scale=1.0 / math.sqrt(cfg.head_dim),
+            )
+        else:
+            ctx = core_attention(q, k, v, cfg, attention_mask, dropout_key,
+                                 train)
 
     b, s = ctx.shape[:2]
     ctx = ctx.reshape(b, s, cfg.num_attention_heads * cfg.head_dim)

@@ -340,6 +340,160 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                      *, scale, block_q, block_k, causal, window, kv_len,
+                      q_len):
+    """Single-pass backward: one sweep of the (ki, qi) block grid computes
+    dq, dk, dv together, sharing the s = q k^T recompute and the
+    dp = do v^T matmul that the two-kernel structure (below) performs
+    twice — 5 block matmuls instead of 7 (the round-3 'known headroom',
+    docs/perf_tpu.md).
+
+    dq accumulation: the dq output block is the FULL [sq, d] fp32 slab
+    per (b, h), whose index map ignores (ki, qi) — consecutive revisits
+    keep it VMEM-resident across the whole sweep, so the row slice for
+    each qi accumulates in place with no HBM round trip; it is written
+    back once when (b, h) advances."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when((ki == 0) & (qi == 0))
+    def _init_dq():
+        dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = jnp.bool_(True)
+    if causal:
+        run = run & (k_start <= q_start + block_q - 1)
+    if window is not None:
+        run = run & (k_start + block_k - 1 > q_start - window)
+
+    @pl.when(run)
+    def _compute():
+        k_row_valid = (k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < kv_len
+        q_row_valid = (q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)) < q_len
+        q = jnp.where(q_row_valid, q_ref[0, 0].astype(jnp.float32), 0.0)
+        k = jnp.where(k_row_valid, k_ref[0, 0].astype(jnp.float32), 0.0)
+        v = jnp.where(k_row_valid, v_ref[0, 0].astype(jnp.float32), 0.0)
+        do = jnp.where(q_row_valid, do_ref[0, 0].astype(jnp.float32), 0.0)
+        lse = jnp.where(q_row_valid,
+                        jnp.max(lse_ref[0, 0], axis=-1, keepdims=True), 0.0)
+        delta = jnp.where(q_row_valid,
+                          jnp.max(delta_ref[0, 0], axis=-1, keepdims=True),
+                          0.0)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        q_ids = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_ids = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = (k_ids < kv_len) & (q_ids < q_len)
+        if causal:
+            mask &= k_ids <= q_ids
+        if window is not None:
+            mask &= k_ids > q_ids - window
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)         # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - delta)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [bk, d]
+        rows = pl.ds(q_start, block_q)
+        dq_ref[0, 0, rows, :] += jax.lax.dot(
+            ds, k, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_fused_call(q, k, v, do, lse, delta, *, scale, causal, window,
+                    bq, bk, nq, nk):
+    b, nh, sq, d = q.shape
+    ng, sk = k.shape[1], k.shape[2]
+    qpg = nh // ng
+    kw = dict(scale=scale, block_q=bq, block_k=bk, causal=causal,
+              window=window, kv_len=sk, q_len=sq)
+    dq, dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, **kw),
+        grid=(b, nh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bb, h, ki, qi: (bb, h, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, ki, qi: (bb, h // qpg, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, ki, qi: (bb, h // qpg, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, d), lambda bb, h, ki, qi: (bb, h, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, LANES),
+                         lambda bb, h, ki, qi: (bb, h, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, LANES),
+                         lambda bb, h, ki, qi: (bb, h, qi, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            # full-seq dq slab; index map ignores (ki, qi) -> VMEM-resident
+            # for the whole (b, h) sweep (see kernel docstring)
+            pl.BlockSpec((1, 1, sq, d), lambda bb, h, ki, qi: (bb, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, ki, qi: (bb, h, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bb, h, ki, qi: (bb, h, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nh, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, nh, sk, d), q.dtype),
+            jax.ShapeDtypeStruct((b, nh, sk, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(q, k, v, do, lse, delta)
+    dk = dk_h.reshape(b, ng, qpg, sk, d).sum(axis=2)
+    dv = dv_h.reshape(b, ng, qpg, sk, d).sum(axis=2)
+    return dq.astype(q.dtype), dk, dv
+
+
+# The fused single-pass backward is the default; the two-kernel structure
+# below is kept as the fallback (bench.py kernel smoke degrades to it if
+# the fused kernel fails to lower on some libtpu, and partial trailing
+# blocks only support it).
+FUSED_BACKWARD = True
+# The fused kernel keeps the whole [sq, d] fp32 dq slab VMEM-resident; the
+# round-3 tile sweep put 1024x1024 score tiles near the scoped-vmem limit,
+# so cap the slab (4 MB = seq 8192 at d 128) and route longer sequences to
+# the two-kernel structure instead of risking a compile-time OOM at
+# exactly the long-context lengths the fallback ladder protects.
+FUSED_BWD_MAX_SLAB_BYTES = 4 << 20
+
+
 def _bwd_call(q, k, v, o, lse, do, *, scale, causal, window,
               block_q, block_k):
     b, nh, sq, d = q.shape
@@ -352,6 +506,14 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, window,
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
+
+    if (FUSED_BACKWARD and sq % bq == 0 and sk % bk == 0
+            and sq * d * 4 <= FUSED_BWD_MAX_SLAB_BYTES):
+        # full blocks only: the fused kernel's in-place row-slice
+        # accumulation into the dq slab assumes every q block is complete
+        return _bwd_fused_call(
+            q, k, v, do, lse, delta, scale=scale, causal=causal,
+            window=window, bq=bq, bk=bk, nq=nq, nk=nk)
 
     kw = dict(scale=scale, block_q=bq, block_k=bk, causal=causal,
               window=window, kv_len=sk, q_len=sq)

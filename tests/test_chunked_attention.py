@@ -1,0 +1,94 @@
+"""Q-chunked exact attention (the long-context XLA fallback,
+VERDICT r3 #6): exactness vs the unchunked path, window/GQA handling,
+and an 8k fwd+bwd that the [s, s] path could not survive on the TPU
+remote compiler."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.ops.chunked_attention import chunked_causal_attention
+from megatron_llm_tpu.ops.pallas.flash_attention import _reference_attention
+
+
+def _qkv(b=2, s=256, nh=4, ng=2, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, s, nh, d).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(b, s, ng, d).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(b, s, ng, d).astype(np.float32)) * 0.3
+    return q, k, v
+
+
+@pytest.mark.parametrize("window", [None, 64])
+def test_chunked_matches_reference(window):
+    q, k, v = _qkv()
+    ref = _reference_attention(q, k, v, True, window, 0.125)
+    got = chunked_causal_attention(
+        q, k, v, causal=True, sliding_window=window, softmax_scale=0.125,
+        q_chunk_size=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_chunked_grads_match_reference():
+    q, k, v = _qkv()
+    ref_fn = lambda *a: (_reference_attention(*a, True, None, 0.125) ** 2).sum()
+    got_fn = lambda *a: (chunked_causal_attention(
+        *a, causal=True, softmax_scale=0.125, q_chunk_size=64) ** 2).sum()
+    gr = jax.grad(ref_fn, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(got_fn, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_chunked_nondivisible_chunk_falls_to_divisor():
+    q, k, v = _qkv(s=96)  # 96 % 64 != 0 -> chunk shrinks to 48
+    ref = _reference_attention(q, k, v, True, None, 0.125)
+    got = chunked_causal_attention(
+        q, k, v, causal=True, softmax_scale=0.125, q_chunk_size=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_chunked_8k_fwd_bwd_survives():
+    """The actual degradation scenario: seq 8192, where the unchunked
+    [s, s] score tensor is 256 MB fp32 per (b, head-group) and kills the
+    remote compiler.  Chunked must produce finite grads."""
+    q, k, v = _qkv(b=1, s=8192, nh=2, ng=1, d=16)
+    fn = lambda q, k, v: (chunked_causal_attention(
+        q, k, v, causal=True, q_chunk_size=1024) ** 2).sum()
+    g = jax.grad(fn)(q, k, v)
+    assert g.shape == q.shape
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_model_dispatch_uses_chunked_at_long_seq(monkeypatch):
+    """attention() must route flash-eligible long-seq inputs through the
+    chunked path when flash is off."""
+    import megatron_llm_tpu.ops.chunked_attention as ca
+    from megatron_llm_tpu.config import TransformerConfig
+    from megatron_llm_tpu.models import transformer as T
+
+    monkeypatch.setattr(ca, "CHUNKED_ATTENTION_MIN_SEQ", 64)
+    called = {}
+    real = ca.chunked_causal_attention
+
+    def spy(*a, **kw):
+        called["yes"] = True
+        return real(*a, **kw)
+
+    # attention() imports the symbol from the module at call time
+    monkeypatch.setattr(ca, "chunked_causal_attention", spy)
+
+    cfg = TransformerConfig(
+        num_layers=1, hidden_size=32, num_attention_heads=4,
+        ffn_hidden_size=64, padded_vocab_size=64, seq_length=128,
+        max_position_embeddings=128, use_flash_attn=False,
+        position_embedding_type="rotary",
+    )
+    params = T.init_layer_params(jax.random.PRNGKey(0), cfg, jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 128, 32))
+    freqs = T.rotary_freqs(cfg)
+    T.attention(
+        x, params["attention"], cfg, freqs=freqs, attention_mask=None,
+        position_ids=None, dropout_key=None, train=False)
+    assert called.get("yes")
