@@ -1,0 +1,128 @@
+"""Open-retrieval (ORQA/DPR) wiki evidence dataset.
+
+Reference: ``megatron/data/orqa_wiki_dataset.py:1-193`` — a TSV of
+``doc_id \\t doc_text \\t title`` rows (the DPR 2018 Wikipedia dump
+format) tokenized as ``[CLS] title [SEP] text [SEP]`` with token types,
+trimmed/padded to ``max_seq_length``; plus the batch producer the
+evidence-embedding job consumes
+(``megatron/data/biencoder_dataset_utils.py:24-72``).
+
+TPU adaptation: plain numpy samples under a single controller — no
+torch Dataset/DataLoader, no ``tensor_parallel.broadcast_data`` (every
+host builds the same batch; ``place_host_batch`` handles device
+placement).  The per-sample dict keys mirror the reference so the
+embedding job and eval read identically: ``row_id``, ``context``,
+``context_types``, ``context_pad_mask``.
+"""
+
+from __future__ import annotations
+
+import csv
+import sys
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+
+def build_tokens_types_paddings_from_ids(text_ids, max_seq_length,
+                                         cls_id, sep_id, pad_id):
+    """[CLS] ids [SEP] with type-0 tokens, trimmed to fit, padded; returns
+    (ids, types, pad_mask) — reference orqa_wiki_dataset.py:68-103."""
+    enc_ids = [cls_id] + list(text_ids)
+    if len(enc_ids) > max_seq_length - 1:
+        enc_ids = enc_ids[: max_seq_length - 1]
+    enc_ids.append(sep_id)
+    n = len(enc_ids)
+    pad = max_seq_length - n
+    enc_ids.extend([pad_id] * pad)
+    types = [0] * n + [pad_id] * pad
+    pad_mask = np.array([1] * n + [0] * pad, dtype=np.int64)
+    return enc_ids, types, pad_mask
+
+
+def build_tokens_types_paddings_from_text(row, tokenizer, max_seq_length):
+    """title + [SEP] + text -> (ids, types, pad_mask) — reference
+    orqa_wiki_dataset.py:51-65."""
+    title_ids = tokenizer.tokenize(row["title"])
+    context_ids = tokenizer.tokenize(row["text"])
+    extended = title_ids + [tokenizer.sep] + context_ids
+    return build_tokens_types_paddings_from_ids(
+        extended, max_seq_length, tokenizer.cls, tokenizer.sep,
+        tokenizer.pad)
+
+
+def build_sample(row_id, context_ids, context_types, context_pad_mask):
+    return {
+        "row_id": int(row_id),
+        "context": np.array(context_ids, dtype=np.int64),
+        "context_types": np.array(context_types, dtype=np.int64),
+        "context_pad_mask": np.asarray(context_pad_mask, dtype=np.int64),
+    }
+
+
+class OpenRetrievalEvidenceDataset:
+    """The DPR evidence corpus, row-addressable and iterable.
+
+    ``samples``: list of {doc_id, text, title}; ``id2text``: doc_id ->
+    (text, title) for eval-side answer matching (reference
+    orqa_wiki_dataset.py:122-193)."""
+
+    def __init__(self, datapath: str, tokenizer, max_seq_length: int,
+                 sample_rate: float = 1.0, seed: int = 1234):
+        self.tokenizer = tokenizer
+        self.max_seq_length = max_seq_length
+        self.samples, self.id2text = self.process_samples_from_single_path(
+            datapath)
+        if sample_rate < 1.0:
+            k = int(len(self.samples) * sample_rate)
+            rng = np.random.RandomState(seed)
+            idx = rng.choice(len(self.samples), size=k, replace=False)
+            self.samples = [self.samples[i] for i in sorted(idx)]
+        print(f" > evidence dataset: {len(self.samples)} rows "
+              f"from {datapath}", file=sys.stderr, flush=True)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        row = self.samples[idx]
+        ids, types, pad_mask = build_tokens_types_paddings_from_text(
+            row, self.tokenizer, self.max_seq_length)
+        return build_sample(row["doc_id"], ids, types, pad_mask)
+
+    @staticmethod
+    def process_samples_from_single_path(filename):
+        rows: List[dict] = []
+        id2text = {}
+        with open(filename, newline="") as tsvfile:
+            reader = csv.reader(tsvfile, delimiter="\t")
+            next(reader, None)  # header: id, text, title
+            for row in reader:
+                doc_id = int(row[0])
+                text, title = row[1], row[2]
+                rows.append({"doc_id": doc_id, "text": text, "title": title})
+                assert doc_id not in id2text, f"duplicate doc_id {doc_id}"
+                id2text[doc_id] = (text, title)
+        return rows, id2text
+
+
+def evidence_batches(dataset: OpenRetrievalEvidenceDataset,
+                     batch_size: int,
+                     lo: int = 0,
+                     hi: Optional[int] = None) -> Iterator[dict]:
+    """Stacked numpy batches over dataset rows [lo, hi) — the
+    single-controller stand-in for the reference's one-epoch dataloader +
+    ``get_open_retrieval_batch`` (biencoder_dataset_utils.py:24-72).
+    The trailing partial batch is yielded as-is."""
+    hi = len(dataset) if hi is None else hi
+    for start in range(lo, hi, batch_size):
+        samples = [dataset[i] for i in range(start, min(start + batch_size,
+                                                        hi))]
+        yield {
+            "row_id": np.array([s["row_id"] for s in samples],
+                               dtype=np.int64),
+            "context": np.stack([s["context"] for s in samples]),
+            "context_types": np.stack([s["context_types"] for s in samples]),
+            "context_pad_mask": np.stack(
+                [s["context_pad_mask"] for s in samples]),
+        }

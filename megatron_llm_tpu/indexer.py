@@ -81,3 +81,34 @@ class IndexBuilder:
             self.store.merge_shards_and_save()
         # multi-host: caller barriers, then rank 0 calls
         # store.merge_shards_and_save() once every shard is on disk
+
+
+class EvidenceIndexBuilder(IndexBuilder):
+    """IndexBuilder over an ``OpenRetrievalEvidenceDataset`` (wiki TSV)
+    instead of an ICT block map — the missing half of the reference's
+    RETRIEVER-EVAL workflow (megatron/indexer.py driven by
+    orqa_wiki_dataset + biencoder_dataset_utils): TSV rows are embedded by
+    the context tower and stored under their ``doc_id``."""
+
+    def build_and_save_index(self):
+        from megatron_llm_tpu.data.orqa_wiki_dataset import evidence_batches
+
+        n = len(self.dataset)
+        lo = (n * self.rank) // self.world_size
+        hi = (n * (self.rank + 1)) // self.world_size
+        done = last_log = 0
+        for batch in evidence_batches(self.dataset, self.batch_size, lo, hi):
+            emb = np.asarray(self._embed(
+                self.params,
+                jnp.asarray(batch["context"], jnp.int32),
+                jnp.asarray(batch["context_pad_mask"], jnp.int32)))
+            self.store.add_block_data([int(r) for r in batch["row_id"]], emb)
+            done += len(batch["row_id"])
+            if self.log_interval and done - last_log >= self.log_interval:
+                last_log = done
+                print(f" > evidence indexer rank {self.rank}: "
+                      f"{done}/{hi - lo}", flush=True)
+        self.store.save_shard()
+        self.store.clear()
+        if self.world_size == 1:
+            self.store.merge_shards_and_save()

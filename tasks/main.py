@@ -59,6 +59,9 @@ def get_tasks_args(parser):
                    action="store_true")
     g.add_argument("--retriever_report_topk_accuracies", nargs="*",
                    type=int, default=None)
+    g.add_argument("--sample_rate", type=float, default=1.0,
+                   help="subsample fraction of the evidence corpus "
+                        "(reference orqa_wiki_dataset.py:140)")
     # MSDP (multi-stage dialogue prompting) flags
     g.add_argument("--guess_file", default=None)
     g.add_argument("--answer_file", default=None)
