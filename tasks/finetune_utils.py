@@ -74,22 +74,60 @@ def load_pretrained_trunk(params, pretrained_checkpoint: str):
     return grafted
 
 
+def named_valid_splits(paths, make_dataset):
+    """[(split_name, dataset)] from dev-file paths, one dataset per path
+    (per-split reporting, reference eval_utils.accuracy_func_provider).
+    Names come from the basename (extension stripped); collisions get a
+    numeric suffix so two ``matched/dev.tsv mismatched/dev.tsv`` splits
+    can't silently overwrite each other in the predictions dump."""
+    import os
+
+    splits = []
+    seen = {}
+    for p in paths:
+        name = os.path.splitext(os.path.basename(os.path.normpath(p)))[0] \
+            or "dev"
+        if name in seen:
+            seen[name] += 1
+            name = f"{name}{seen[name]}"
+        else:
+            seen[name] = 0
+        splits.append((name, make_dataset(name, p)))
+    return splits
+
+
 def accuracy_func_provider(model, params_getter, dataset, batch_size,
-                           collate=classification_collate):
-    """Returns a callable computing top-1 accuracy over ``dataset``
-    (reference: tasks/eval_utils.py accuracy_func_provider)."""
+                           collate=classification_collate,
+                           output_predictions: bool = False,
+                           predictions_dir: Optional[str] = None):
+    """Returns a callable computing top-1 accuracy
+    (reference: tasks/eval_utils.py accuracy_func_provider).
+
+    ``dataset``: either one dataset, or a list of ``(split_name, dataset)``
+    pairs — per-split correct/total is printed like the reference's
+    ``calculate_correct_answers`` and the overall accuracy returned.
+    With ``output_predictions`` the per-sample softmaxes/labels/uids of
+    every split are written to ``predictions_dir/predictions_epochN.json``
+    (the reference torch-saves the same triple per split,
+    eval_utils.py:56-59)."""
+    if (isinstance(dataset, (list, tuple)) and dataset
+            and isinstance(dataset[0], tuple)
+            and isinstance(dataset[0][0], str)):
+        splits = list(dataset)
+    else:
+        splits = [("validation", dataset)]
 
     @jax.jit
     def logits_fn(params, tokens, attention_mask, tokentype_ids):
         return model(params, tokens, attention_mask,
                      tokentype_ids=tokentype_ids)
 
-    def evaluate():
-        params = params_getter()
+    def eval_split(params, ds):
         correct = total = 0
-        for lo in range(0, len(dataset), batch_size):
-            samples = [dataset[i]
-                       for i in range(lo, min(lo + batch_size, len(dataset)))]
+        softmaxes, labels, ids = [], [], []
+        for lo in range(0, len(ds), batch_size):
+            samples = [ds[i]
+                       for i in range(lo, min(lo + batch_size, len(ds)))]
             b = collate(samples)
             n = len(samples)
             # pad the tail batch to the compiled shape
@@ -101,9 +139,47 @@ def accuracy_func_provider(model, params_getter, dataset, batch_size,
                                jnp.asarray(b["tokens"]),
                                jnp.asarray(b["attention_mask"]),
                                jnp.asarray(b["tokentype_ids"]))
-            pred = np.asarray(jnp.argmax(logits, axis=-1))[:n]
+            logits = np.asarray(logits, np.float32)[:n]
+            pred = logits.argmax(-1)
             correct += int((pred == b["labels"][:n]).sum())
             total += n
+            if output_predictions:
+                e = np.exp(logits - logits.max(-1, keepdims=True))
+                softmaxes.extend((e / e.sum(-1, keepdims=True)).tolist())
+                labels.extend(b["labels"][:n].tolist())
+                ids.extend(int(s.get("uid", lo + j))
+                           for j, s in enumerate(samples))
+        return correct, total, (softmaxes, labels, ids)
+
+    def evaluate(epoch: int = -1):
+        params = params_getter()
+        correct = total = 0
+        named_predictions = {}
+        for name, ds in splits:
+            c, t, preds = eval_split(params, ds)
+            correct += c
+            total += t
+            pct = 100.0 * c / max(t, 1)
+            print(f" > |epoch: {epoch}| metrics for {name}: "
+                  f"correct / total = {c} / {t} = {pct:.4f} %", flush=True)
+            if output_predictions:
+                named_predictions[name] = {
+                    "softmaxes": preds[0], "labels": preds[1],
+                    "ids": preds[2],
+                }
+        pct = 100.0 * correct / max(total, 1)
+        print(f" >> |epoch: {epoch}| overall: correct / total = "
+              f"{correct} / {total} = {pct:.4f} %", flush=True)
+        if output_predictions and predictions_dir:
+            import json
+            import os
+
+            os.makedirs(predictions_dir, exist_ok=True)
+            path = os.path.join(predictions_dir,
+                                f"predictions_epoch{epoch}.json")
+            with open(path, "w") as f:
+                json.dump(named_predictions, f)
+            print(f" > wrote predictions to {path}", flush=True)
         return correct / max(total, 1)
 
     return evaluate
@@ -115,12 +191,21 @@ def finetune(args, model, train_dataset, valid_dataset,
     """Epoch-driven finetune (reference: tasks/finetune_utils.py:finetune).
 
     Uses the generic compiled train step with one microbatch per step; the
-    global batch is ``args.micro_batch_size x dp``.
+    global batch is ``args.micro_batch_size x dp``.  ``valid_dataset`` may
+    be a list of ``(split_name, dataset)`` pairs for per-split reporting.
+
+    Reference-parity plumbing (tasks/finetune_utils.py:_train + main):
+    warmup+decay LR schedule over the full epoch span, per-epoch
+    checkpoint, best-accuracy checkpoint under ``<save>/best``, and
+    prediction dumps at each eval when ``args.save`` is set.
     """
+    import os
+
     from megatron_llm_tpu.arguments import (
         parallel_config_from_args,
         train_config_from_args,
     )
+    from megatron_llm_tpu.optimizer.scheduler import OptimizerParamScheduler
 
     tc = train_config_from_args(args)
     pc = parallel_config_from_args(args)
@@ -145,7 +230,21 @@ def finetune(args, model, train_dataset, valid_dataset,
     key = jax.random.PRNGKey(args.seed + 1)
 
     epochs = args.epochs or 0
-    lr = args.lr
+    # LR schedule over the whole finetune span (reference: _train drives
+    # the standard OptimizerParamScheduler; warmup fraction from
+    # --lr_warmup_fraction, linear decay to min_lr by the last iteration)
+    steps_per_epoch = (len(train_dataset) // batch_size
+                       if getattr(args, "keep_last", False) is False
+                       else -(-len(train_dataset) // batch_size))
+    total_iters = max(epochs * max(steps_per_epoch, 1), 1)
+    warmup = getattr(args, "lr_warmup_fraction", None)
+    scheduler = OptimizerParamScheduler(
+        max_lr=args.lr, min_lr=getattr(args, "min_lr", 0.0) or 0.0,
+        lr_warmup_steps=int((warmup or 0.0) * total_iters),
+        lr_decay_steps=total_iters,
+        lr_decay_style=getattr(args, "lr_decay_style", "linear") or "linear",
+        start_wd=tc.weight_decay, end_wd=tc.weight_decay,
+    )
     it = 0
     best = None
     state = {"params": params}
@@ -153,7 +252,9 @@ def finetune(args, model, train_dataset, valid_dataset,
     if valid_dataset is not None:
         eval_fn = accuracy_func_provider(
             model, lambda: state["params"], valid_dataset,
-            batch_size, collate)
+            batch_size, collate,
+            output_predictions=bool(args.save),
+            predictions_dir=args.save)
 
     for epoch in range(epochs):
         for batch in _epoch_batches(train_dataset, batch_size, rng,
@@ -161,26 +262,37 @@ def finetune(args, model, train_dataset, valid_dataset,
                                                       False), collate=collate):
             global_batch = {k: v[None] for k, v in batch.items()}  # M=1
             key, sub = jax.random.split(key)
+            lr, wd = scheduler.step()
             params, opt_state, metrics = step_fn(
                 params, opt_state, global_batch, sub,
-                jnp.float32(lr), jnp.float32(tc.weight_decay))
+                jnp.float32(lr), jnp.float32(wd))
             state["params"] = params
             it += 1
             if it % args.log_interval == 0:
-                print(f"epoch {epoch} iter {it} | "
+                print(f"epoch {epoch} iter {it} | lr {lr:.3e} | "
                       f"loss {float(metrics['lm loss']):.4f}", flush=True)
         if eval_fn is not None:
-            acc = eval_fn()
+            acc = eval_fn(epoch)
             print(f"epoch {epoch} | validation accuracy {acc * 100:.2f}%",
                   flush=True)
-            best = acc if best is None else max(best, acc)
+            if best is None or acc > best:
+                best = acc
+                if args.save:
+                    # checkpoint-best: the reference keeps the per-epoch
+                    # checkpoints and users pick by logged dev accuracy;
+                    # a dedicated best/ copy makes the pick explicit
+                    checkpointing.save_checkpoint(
+                        os.path.join(args.save, "best"), it, params,
+                        opt_state)
+                    print(f"epoch {epoch} | new best ({acc * 100:.2f}%): "
+                          f"saved {args.save}/best", flush=True)
         if end_of_epoch_callback is not None:
             end_of_epoch_callback(epoch, params)
         if args.save:
             checkpointing.save_checkpoint(args.save, it, params, opt_state)
 
     if epochs == 0 and eval_fn is not None:  # evaluation only
-        acc = eval_fn()
+        acc = eval_fn(-1)
         print(f"validation accuracy {acc * 100:.2f}%", flush=True)
         best = acc
     return params, best

@@ -37,8 +37,15 @@ def main():
 
     train_ds = Dataset("training", args.train_data, tokenizer,
                        args.seq_length)
-    valid_ds = Dataset("validation", args.valid_data, tokenizer,
-                       args.seq_length) if args.valid_data else None
+    # one dataset per dev file -> per-split accuracy reporting (e.g. MNLI
+    # dev-matched + dev-mismatched)
+    valid_ds = None
+    if args.valid_data:
+        from tasks.finetune_utils import named_valid_splits
+
+        valid_ds = named_valid_splits(
+            args.valid_data,
+            lambda name, p: Dataset(name, [p], tokenizer, args.seq_length))
 
     model = ClassificationModel(_cfg_from_args(args), num_classes)
     _, best = finetune(args, model, train_ds, valid_ds)

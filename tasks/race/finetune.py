@@ -31,8 +31,15 @@ def main():
 
     train_ds = RaceDataset("training", args.train_data, tokenizer,
                            args.seq_length)
-    valid_ds = RaceDataset("validation", args.valid_data, tokenizer,
-                           args.seq_length) if args.valid_data else None
+    # one dataset per dev path -> per-split accuracy
+    valid_ds = None
+    if args.valid_data:
+        from tasks.finetune_utils import named_valid_splits
+
+        valid_ds = named_valid_splits(
+            args.valid_data,
+            lambda name, p: RaceDataset(name, [p], tokenizer,
+                                        args.seq_length))
 
     model = MultipleChoiceModel(_cfg_from_args(args))
     _, best = finetune(args, model, train_ds, valid_ds,
