@@ -30,11 +30,13 @@ PEAK = 197e12
 
 def bench_cfg(label, mb=8, remat="selective", flash=True, fused_rms=True,
               L=16, h=1280, ffn=3584, heads=16, seq=2048, iters=5, bq=None,
-              bk=None, experts=0, top_k=2):
+              bk=None, experts=0, top_k=2, fused_bwd=None):
     import megatron_llm_tpu.ops.pallas.flash_attention as fa
     orig_bq, orig_bk = fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K
+    orig_fused = fa.FUSED_BACKWARD
     if bq: fa.DEFAULT_BLOCK_Q = bq
     if bk: fa.DEFAULT_BLOCK_K = bk
+    if fused_bwd is not None: fa.FUSED_BACKWARD = fused_bwd
     cfg = llama_config("tiny", num_layers=L, hidden_size=h, num_attention_heads=heads,
         ffn_hidden_size=ffn, padded_vocab_size=32000, seq_length=seq,
         max_position_embeddings=seq, params_dtype="bf16", compute_dtype="bf16",
@@ -69,6 +71,7 @@ def bench_cfg(label, mb=8, remat="selective", flash=True, fused_rms=True,
         print(f"{label:44s} FAILED: {type(e).__name__}: {str(e)[:120]}", flush=True)
     fa.DEFAULT_BLOCK_Q = orig_bq
     fa.DEFAULT_BLOCK_K = orig_bk
+    fa.FUSED_BACKWARD = orig_fused
 
 GROUPS = {
     "baseline": [
@@ -127,6 +130,35 @@ GROUPS["moe"] = [
          mb=4, h=2048, heads=16, ffn=2816, L=10, experts=4),
     dict(label="moe E8 top2 ffn2816",
          mb=4, h=2048, heads=16, ffn=2816, L=10, experts=8),
+]
+# round-4: the fused single-pass flash backward (the round-3 "known
+# headroom") A/B'd at the bench shape and at matched-baseline seq 4096 —
+# VERDICT r3 #2 wants MFU >= 0.47 at seq 4096
+GROUPS["fusedbwd"] = [
+    dict(label="650M seq2048 two-kernel bwd", mb=4, h=2048, heads=16,
+         ffn=5632, L=10, fused_bwd=False),
+    dict(label="650M seq2048 fused bwd", mb=4, h=2048, heads=16,
+         ffn=5632, L=10, fused_bwd=True),
+    dict(label="650M seq4096 two-kernel bwd", mb=2, h=2048, heads=16,
+         ffn=5632, L=10, seq=4096, fused_bwd=False),
+    dict(label="650M seq4096 fused bwd", mb=2, h=2048, heads=16,
+         ffn=5632, L=10, seq=4096, fused_bwd=True),
+    dict(label="650M seq8192 fused bwd", mb=1, h=2048, heads=16,
+         ffn=5632, L=10, seq=8192, fused_bwd=True),
+]
+GROUPS["seq4096"] = [
+    dict(label="650M seq4096 mb1", mb=1, h=2048, heads=16, ffn=5632,
+         L=10, seq=4096),
+    dict(label="650M seq4096 mb2", mb=2, h=2048, heads=16, ffn=5632,
+         L=10, seq=4096),
+    dict(label="650M seq4096 mb4", mb=4, h=2048, heads=16, ffn=5632,
+         L=10, seq=4096),
+    dict(label="650M seq4096 mb2 bq2048", mb=2, h=2048, heads=16,
+         ffn=5632, L=10, seq=4096, bq=2048, bk=1024),
+    dict(label="650M seq4096 mb2 bk2048", mb=2, h=2048, heads=16,
+         ffn=5632, L=10, seq=4096, bq=1024, bk=2048),
+    dict(label="650M seq4096 mb2 full-remat", mb=2, h=2048, heads=16,
+         ffn=5632, L=10, seq=4096, remat="full"),
 ]
 GROUPS["all"] = GROUPS["baseline"] + GROUPS["blocks"]
 
