@@ -31,7 +31,9 @@ def extra_args(parser):
     g.add_argument("--evidence_data_path", default=None,
                    help="indexed dataset of evidence blocks (falls back "
                         "to --data_path)")
-    g.add_argument("--titles_data_path", required=True)
+    g.add_argument("--titles_data_path", default=None,
+                   help="required for indexed-dataset evidence; unused "
+                        "for wiki-TSV evidence")
     g.add_argument("--embedding_path", "--block_data_path",
                    dest="embedding_path", required=True,
                    help="output embeddings store (reference spells this "
@@ -86,6 +88,38 @@ def main():
         args.data_path[0] if args.data_path else None)
     if evidence is None:
         raise SystemExit("need --evidence_data_path or --data_path")
+    if str(evidence).endswith(".tsv"):
+        # DPR wiki-TSV evidence (same corpus format the reference's
+        # orqa_wiki_dataset reads); no titles dataset needed
+        from megatron_llm_tpu.data.orqa_wiki_dataset import (
+            OpenRetrievalEvidenceDataset,
+        )
+        from megatron_llm_tpu.indexer import EvidenceIndexBuilder
+
+        ds = OpenRetrievalEvidenceDataset(
+            evidence, tokenizer, args.retriever_seq_length)
+        rank, world = jax.process_index(), jax.process_count()
+        builder = EvidenceIndexBuilder(
+            model, params, ds, args.embedding_path,
+            batch_size=args.indexer_batch_size,
+            rank=rank, world_size=world,
+            log_interval=args.indexer_log_interval,
+        )
+        builder.build_and_save_index()
+        if world > 1:
+            # all shards on disk before rank 0 merges (the builder's
+            # documented multi-host protocol)
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("doc-index-shards")
+            if rank == 0:
+                builder.store.merge_shards_and_save()
+            multihost_utils.sync_global_devices("doc-index-merged")
+        print(f" > wrote evidence embeddings to {args.embedding_path}")
+        return
+    if args.titles_data_path is None:
+        raise SystemExit("--titles_data_path is required for "
+                         "indexed-dataset evidence")
     blocks = get_indexed_dataset_(evidence)
     titles = get_indexed_dataset_(args.titles_data_path)
     ict = ICTDataset(

@@ -30,32 +30,36 @@ PEAK = 197e12
 
 def bench_cfg(label, mb=8, remat="selective", flash=True, fused_rms=True,
               L=16, h=1280, ffn=3584, heads=16, seq=2048, iters=5, bq=None,
-              bk=None, experts=0, top_k=2, fused_bwd=None):
+              bk=None, experts=0, top_k=2, fused_bwd=None, vocab=32000,
+              fused_ce=False):
     import megatron_llm_tpu.ops.pallas.flash_attention as fa
     orig_bq, orig_bk = fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K
     orig_fused = fa.FUSED_BACKWARD
     if bq: fa.DEFAULT_BLOCK_Q = bq
     if bk: fa.DEFAULT_BLOCK_K = bk
     if fused_bwd is not None: fa.FUSED_BACKWARD = fused_bwd
-    cfg = llama_config("tiny", num_layers=L, hidden_size=h, num_attention_heads=heads,
-        ffn_hidden_size=ffn, padded_vocab_size=32000, seq_length=seq,
-        max_position_embeddings=seq, params_dtype="bf16", compute_dtype="bf16",
-        recompute_granularity=remat, use_flash_attn=flash, use_fused_rmsnorm=fused_rms,
-        num_experts=experts, moe_top_k=top_k)
-    model = LlamaModel(cfg)
-    params = model.init(jax.random.PRNGKey(0))
-    n = model.num_params(params)
-    tc = TrainConfig(micro_batch_size=mb, global_batch_size=mb, train_iters=0, lr=1e-4,
-                     optimizer="adam", bf16=True, clip_grad=1.0)
-    opt = MegatronOptimizer(tc, params_dtype=jnp.bfloat16)
-    opt_state = opt.init(params)
-    step = build_train_step(model, opt, ParallelConfig(), 1)
-    rng = np.random.RandomState(0)
-    toks = jnp.asarray(rng.randint(0, 32000, (1, mb, seq)))
-    batch = {"tokens": toks, "labels": jnp.roll(toks, -1, -1),
-             "loss_mask": jnp.ones_like(toks, jnp.float32)}
-    key = jax.random.PRNGKey(1)
     try:
+        # model/optimizer init INSIDE the trial guard: the memory-edge
+        # trials (bigvocab) can OOM at init, which must fail that one
+        # trial, not abort the sweep
+        cfg = llama_config("tiny", num_layers=L, hidden_size=h, num_attention_heads=heads,
+            ffn_hidden_size=ffn, padded_vocab_size=vocab, seq_length=seq,
+            max_position_embeddings=seq, params_dtype="bf16", compute_dtype="bf16",
+            recompute_granularity=remat, use_flash_attn=flash, use_fused_rmsnorm=fused_rms,
+            num_experts=experts, moe_top_k=top_k, fused_lm_cross_entropy=fused_ce)
+        model = LlamaModel(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        n = model.num_params(params)
+        tc = TrainConfig(micro_batch_size=mb, global_batch_size=mb, train_iters=0, lr=1e-4,
+                         optimizer="adam", bf16=True, clip_grad=1.0)
+        opt = MegatronOptimizer(tc, params_dtype=jnp.bfloat16)
+        opt_state = opt.init(params)
+        step = build_train_step(model, opt, ParallelConfig(), 1)
+        rng = np.random.RandomState(0)
+        toks = jnp.asarray(rng.randint(0, vocab, (1, mb, seq)))
+        batch = {"tokens": toks, "labels": jnp.roll(toks, -1, -1),
+                 "loss_mask": jnp.ones_like(toks, jnp.float32)}
+        key = jax.random.PRNGKey(1)
         for _ in range(2):
             params, opt_state, m = step(params, opt_state, batch, key, 1e-4, 0.0)
             float(m["lm loss"])
@@ -159,6 +163,25 @@ GROUPS["seq4096"] = [
          ffn=5632, L=10, seq=4096, bq=1024, bk=2048),
     dict(label="650M seq4096 mb2 full-remat", mb=2, h=2048, heads=16,
          ffn=5632, L=10, seq=4096, remat="full"),
+]
+# fused chunked linear+CE flip point (VERDICT r3 #8): at 32k vocab it
+# measured a tie (docs/perf_tpu.md "tried and rejected"); the claim is
+# the trade flips at 128k vocab where the [tokens, vocab] fp32 logits
+# block is 4x bigger.  Smaller L keeps the 128k-vocab embedding+head
+# (h2048: 2 x 0.5 GB bf16) inside 16 GB next to the Adam state.
+GROUPS["bigvocab"] = [
+    dict(label="v32k  unfused (bench cfg)", mb=4, h=2048, heads=16,
+         ffn=5632, L=10),
+    dict(label="v32k  fused-CE", mb=4, h=2048, heads=16, ffn=5632, L=10,
+         fused_ce=True),
+    dict(label="v128k unfused", mb=4, h=2048, heads=16, ffn=5632, L=8,
+         vocab=131072),
+    dict(label="v128k fused-CE", mb=4, h=2048, heads=16, ffn=5632, L=8,
+         vocab=131072, fused_ce=True),
+    dict(label="v256k unfused", mb=2, h=2048, heads=16, ffn=5632, L=6,
+         vocab=262144),
+    dict(label="v256k fused-CE", mb=2, h=2048, heads=16, ffn=5632, L=6,
+         vocab=262144, fused_ce=True),
 ]
 GROUPS["all"] = GROUPS["baseline"] + GROUPS["blocks"]
 
