@@ -228,6 +228,11 @@ def _add_distributed_args(parser):
                    default=None)
     g.add_argument("--sequence_parallel", action="store_true")
     g.add_argument("--context_parallel_size", type=int, default=1)
+    g.add_argument("--context_parallel_algo", default="ring",
+                   choices=["ring", "ulysses"],
+                   help="cp attention algorithm: K/V ring (ppermute) or "
+                        "Ulysses all-to-all (heads %% cp == 0; falls back "
+                        "to ring otherwise)")
     g.add_argument("--use_distributed_optimizer", action="store_true")
     g.add_argument("--expert_model_parallel_size", type=int, default=1)
     g.add_argument("--distributed_backend", default="xla",
@@ -524,6 +529,7 @@ def transformer_config_from_args(args, model_name: Optional[str] = None
         moe_min_capacity=args.moe_min_capacity,
         moe_aux_loss_coeff=args.moe_aux_loss_coeff,
         moe_z_loss_coeff=args.moe_z_loss_coeff,
+        context_parallel_algo=args.context_parallel_algo,
     )
 
 

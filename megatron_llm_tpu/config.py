@@ -190,6 +190,14 @@ class TransformerConfig:
     # between (round-3 advisor finding); "expert" / "replicated" force it.
     moe_expert_axis: str = "auto"
 
+    # --- context parallelism algorithm (TPU-native extension; the
+    # reference has neither): "ring" = K/V ppermute around the cp axis
+    # (parallel/ring_attention.py, any head count); "ulysses" = all-to-all
+    # heads<->sequence so attention runs dense+local with the tuned flash
+    # kernel (parallel/ulysses.py; needs heads % cp == 0, auto-falls back
+    # to ring otherwise). ---
+    context_parallel_algo: str = "ring"
+
     def __post_init__(self):
         if self.ffn_hidden_size is None:
             object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
@@ -209,6 +217,10 @@ class TransformerConfig:
                 "position_embedding_type",
                 PositionEmbeddingType(self.position_embedding_type),
             )
+        if self.context_parallel_algo not in ("ring", "ulysses"):
+            raise ValueError(
+                f"context_parallel_algo must be ring|ulysses, got "
+                f"{self.context_parallel_algo!r}")
         if self.num_experts > 1:
             if self.add_bias_linear:
                 raise ValueError("MoE experts do not support linear biases "

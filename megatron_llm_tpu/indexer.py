@@ -88,7 +88,11 @@ class EvidenceIndexBuilder(IndexBuilder):
     instead of an ICT block map — the missing half of the reference's
     RETRIEVER-EVAL workflow (megatron/indexer.py driven by
     orqa_wiki_dataset + biencoder_dataset_utils): TSV rows are embedded by
-    the context tower and stored under their ``doc_id``."""
+    the context tower and stored under their ``doc_id``.
+
+    Unlike the base class, multi-host merging is handled HERE (barrier ->
+    rank-0 merge -> barrier) so every caller gets the full protocol from
+    one ``build_and_save_index()`` call."""
 
     def build_and_save_index(self):
         from megatron_llm_tpu.data.orqa_wiki_dataset import evidence_batches
@@ -112,3 +116,11 @@ class EvidenceIndexBuilder(IndexBuilder):
         self.store.clear()
         if self.world_size == 1:
             self.store.merge_shards_and_save()
+        else:
+            # all shards must be on disk before rank 0 merges
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("evidence-index-shards")
+            if self.rank == 0:
+                self.store.merge_shards_and_save()
+            multihost_utils.sync_global_devices("evidence-index-merged")

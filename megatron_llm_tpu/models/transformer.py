@@ -316,13 +316,32 @@ def attention(
         from megatron_llm_tpu.parallel.ring_attention import (
             context_parallel_attention,
         )
-
-        ctx = context_parallel_attention(
-            q, k, v,
-            causal=True,
-            sliding_window=cfg.sliding_window_size,
-            softmax_scale=1.0 / math.sqrt(cfg.head_dim),
+        from megatron_llm_tpu.parallel.ulysses import (
+            ulysses_context_attention,
+            ulysses_supported,
         )
+
+        # two context-parallel algorithms (both absent from the
+        # reference): 'ulysses' all-to-alls heads<->sequence so attention
+        # runs dense and local (needs heads % cp == 0); 'ring' permutes
+        # K/V around the cp ring (any head count).  Ulysses falls back to
+        # ring when the head counts don't divide cp.
+        algo = getattr(cfg, "context_parallel_algo", "ring")
+        if algo == "ulysses" and ulysses_supported(
+                cfg.num_attention_heads, cfg.num_query_groups, cp_size):
+            ctx = ulysses_context_attention(
+                q, k, v,
+                causal=True,
+                sliding_window=cfg.sliding_window_size,
+                softmax_scale=1.0 / math.sqrt(cfg.head_dim),
+            )
+        else:
+            ctx = context_parallel_attention(
+                q, k, v,
+                causal=True,
+                sliding_window=cfg.sliding_window_size,
+                softmax_scale=1.0 / math.sqrt(cfg.head_dim),
+            )
     elif use_flash:
         from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
 

@@ -199,22 +199,14 @@ def _main_wiki_evidence(args, tokenizer, model, params, evidence):
         print(f" > embedding store {embedding_path} absent: embedding "
               f"{len(evidence_ds)} evidence rows "
               f"(rank {rank}/{world})", flush=True)
-        builder = EvidenceIndexBuilder(
+        # EvidenceIndexBuilder handles the multi-host barrier + rank-0
+        # merge internally
+        EvidenceIndexBuilder(
             model, params, evidence_ds, embedding_path,
             batch_size=getattr(args, "indexer_batch_size", 128),
             rank=rank, world_size=world,
             log_interval=getattr(args, "indexer_log_interval", 0),
-        )
-        builder.build_and_save_index()
-        if world > 1:
-            # every shard must be on disk before rank 0 merges (the same
-            # barrier+merge protocol IndexBuilder documents)
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices("evidence-index-shards")
-            if rank == 0:
-                builder.store.merge_shards_and_save()
-            multihost_utils.sync_global_devices("evidence-index-merged")
+        ).build_and_save_index()
     elif getattr(args, "sample_rate", 1.0) < 1.0:
         print(f" > WARNING: reusing existing embedding store "
               f"{embedding_path}; --sample_rate has no effect on it "

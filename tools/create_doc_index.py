@@ -98,23 +98,14 @@ def main():
 
         ds = OpenRetrievalEvidenceDataset(
             evidence, tokenizer, args.retriever_seq_length)
-        rank, world = jax.process_index(), jax.process_count()
-        builder = EvidenceIndexBuilder(
+        # EvidenceIndexBuilder handles the multi-host barrier + rank-0
+        # merge internally
+        EvidenceIndexBuilder(
             model, params, ds, args.embedding_path,
             batch_size=args.indexer_batch_size,
-            rank=rank, world_size=world,
+            rank=jax.process_index(), world_size=jax.process_count(),
             log_interval=args.indexer_log_interval,
-        )
-        builder.build_and_save_index()
-        if world > 1:
-            # all shards on disk before rank 0 merges (the builder's
-            # documented multi-host protocol)
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices("doc-index-shards")
-            if rank == 0:
-                builder.store.merge_shards_and_save()
-            multihost_utils.sync_global_devices("doc-index-merged")
+        ).build_and_save_index()
         print(f" > wrote evidence embeddings to {args.embedding_path}")
         return
     if args.titles_data_path is None:
