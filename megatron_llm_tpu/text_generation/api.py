@@ -21,8 +21,17 @@ from megatron_llm_tpu.text_generation.generation import (
 )
 
 
-def _tokenize_prompts(tokenizer, prompts: Sequence[str], pad_id: int):
+def _tokenize_prompts(tokenizer, prompts: Sequence[str], pad_id: int,
+                      add_bos: bool = False):
     tokenized = [tokenizer.tokenize(p) for p in prompts]
+    if add_bos:
+        # reference tokenization.py prepends eod as the BOS sentinel for
+        # GPT-family tokenizers; use a real bos id when the tokenizer has
+        # one
+        bos = getattr(tokenizer, "bos_token_id", None)
+        if bos is None:
+            bos = tokenizer.eod
+        tokenized = [[bos] + t for t in tokenized]
     lengths = [len(t) for t in tokenized]
     max_len = max(lengths)
     arr = np.full((len(prompts), max_len), pad_id, np.int32)
@@ -45,6 +54,12 @@ def generate(
     seed: int = 0,
     return_log_probs: bool = False,
     batch_times_seqlen_threshold: int = 512,
+    add_bos: bool = False,
+    top_p_decay: float = 0.0,
+    top_p_bound: float = 0.0,
+    stop_on_eol: bool = False,
+    stop_on_double_eol: bool = False,
+    prevent_newline_after_colon: bool = False,
 ):
     """Returns (texts, token_lists, log_probs or None).
 
@@ -53,7 +68,28 @@ def generate(
     ``--inference_batch_times_seqlen_threshold``, default 512)."""
     pad = getattr(tokenizer, "pad", 0) or 0
     eod = getattr(tokenizer, "eod", None)
-    toks, lens = _tokenize_prompts(tokenizer, prompts, pad)
+    toks, lens = _tokenize_prompts(tokenizer, prompts, pad, add_bos)
+
+    def one_tok(text):
+        ids = tokenizer.tokenize(text)
+        return ids[-1] if ids else None
+
+    extra_stop, stop_pairs, ban_pairs = [], [], []
+    if stop_on_eol or stop_on_double_eol:
+        eol = one_tok("\n")
+        if stop_on_eol and eol is not None:
+            extra_stop.append(eol)
+        if stop_on_double_eol:
+            dbl = one_tok("\n\n")
+            if dbl is not None and dbl != eol:
+                extra_stop.append(dbl)      # single '\n\n' merge token
+            if eol is not None:
+                stop_pairs.append((eol, eol))  # two consecutive newlines
+    if prevent_newline_after_colon:
+        colon, eol = one_tok(":"), one_tok("\n")
+        if colon is not None and eol is not None:
+            ban_pairs.append((colon, eol))
+
     out_tokens, _, log_probs = generate_tokens(
         model, params, toks, lens, jax.random.PRNGKey(seed),
         max_new_tokens=tokens_to_generate,
@@ -61,15 +97,30 @@ def generate(
         top_k=top_k, top_p=top_p, temperature=temperature, greedy=greedy,
         eod_id=eod, return_log_probs=return_log_probs,
         batch_times_seqlen_threshold=batch_times_seqlen_threshold,
+        top_p_decay=top_p_decay, top_p_bound=top_p_bound,
+        extra_stop_ids=tuple(extra_stop), stop_pairs=tuple(stop_pairs),
+        ban_pairs=tuple(ban_pairs),
     )
     out_tokens = np.asarray(out_tokens)
+    stop_set = set(extra_stop)
+    if eod is not None:
+        stop_set.add(eod)
+    pair_set = set(stop_pairs)
     texts, token_lists = [], []
     for i, row in enumerate(out_tokens):
         row = row.tolist()
-        # trim at eod after the prompt
-        if eod is not None and eod in row[int(lens[i]):]:
-            end = row.index(eod, int(lens[i])) + 1
-            row = row[:end]
+        # trim at the first stop condition after the prompt (eod, an
+        # extra stop id, or a stop bigram) — rows frozen by a stop leave
+        # the rest of the row at its zero init, which must not reach the
+        # caller as detokenized id-0 tokens
+        start = int(lens[i])
+        end = len(row)
+        for j in range(start, len(row)):
+            if row[j] in stop_set or (j > 0
+                                      and (row[j - 1], row[j]) in pair_set):
+                end = j + 1
+                break
+        row = row[:end]
         token_lists.append(row)
         texts.append(tokenizer.detokenize(row))
     return texts, token_lists, (np.asarray(log_probs) if return_log_probs
@@ -85,6 +136,12 @@ def generate_and_post_process(
     temperature: float = 1.0,
     random_seed: int = 0,
     batch_times_seqlen_threshold: int = 512,
+    add_BOS: bool = False,
+    top_p_decay: float = 0.0,
+    top_p_bound: float = 0.0,
+    stop_on_eol: bool = False,
+    stop_on_double_eol: bool = False,
+    prevent_newline_after_colon: bool = False,
     **_unused,
 ):
     """Reference signature compatibility (api.py:19-69)."""
@@ -94,6 +151,9 @@ def generate_and_post_process(
         greedy=(top_k_sampling == 1), seed=random_seed,
         return_log_probs=return_output_log_probs,
         batch_times_seqlen_threshold=batch_times_seqlen_threshold,
+        add_bos=add_BOS, top_p_decay=top_p_decay, top_p_bound=top_p_bound,
+        stop_on_eol=stop_on_eol, stop_on_double_eol=stop_on_double_eol,
+        prevent_newline_after_colon=prevent_newline_after_colon,
     )
     segments = [[tokenizer.detokenize([t]) for t in row] for row in tokens]
     return texts, segments, log_probs, tokens
@@ -104,15 +164,21 @@ def beam_search_and_post_process(
     tokens_to_generate: int = 64,
     beam_size: int = 4,
     length_penalty: float = 1.0,
+    stop_token=None,
+    add_BOS: bool = False,
     **_unused,
 ):
-    """Reference: api.py:147-201 (batch of 1)."""
+    """Reference: api.py:147-201 (batch of 1); ``stop_token`` overrides
+    eod as the beam termination token (the server's stop_token knob)."""
     assert len(prompts) == 1, "beam search supports a single prompt"
     toks, lens = _tokenize_prompts(tokenizer, prompts,
-                                   getattr(tokenizer, "pad", 0) or 0)
+                                   getattr(tokenizer, "pad", 0) or 0,
+                                   add_BOS)
     beams, scores = beam_search(
         model, params, toks[:1], beam_size=beam_size,
-        max_new_tokens=tokens_to_generate, eod_id=tokenizer.eod,
+        max_new_tokens=tokens_to_generate,
+        eod_id=(int(stop_token) if stop_token is not None
+                else tokenizer.eod),
         length_penalty=length_penalty,
     )
     beams = np.asarray(beams)

@@ -26,6 +26,8 @@ from megatron_llm_tpu.models.language_model import language_model_forward
 from megatron_llm_tpu.models.transformer import rotary_freqs
 from megatron_llm_tpu.text_generation.sampling import modify_logits, sample
 
+NEG_INF_LOGIT = -1e10
+
 
 def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int,
                    dtype=None):
@@ -73,7 +75,9 @@ def _prefill_chunks(b: int, n: int, threshold: Optional[int]) -> int:
     jax.jit,
     static_argnames=("model", "max_new_tokens", "min_prompt_len", "top_k",
                      "top_p", "temperature", "greedy", "eod_id",
-                     "return_log_probs", "batch_times_seqlen_threshold"),
+                     "return_log_probs", "batch_times_seqlen_threshold",
+                     "top_p_decay", "top_p_bound", "extra_stop_ids",
+                     "stop_pairs", "ban_pairs"),
 )
 def generate_tokens(
     model,
@@ -91,13 +95,26 @@ def generate_tokens(
     eod_id: Optional[int] = None,
     return_log_probs: bool = False,
     batch_times_seqlen_threshold: Optional[int] = None,
+    top_p_decay: float = 0.0,
+    top_p_bound: float = 0.0,
+    extra_stop_ids: tuple = (),
+    stop_pairs: tuple = (),
+    ban_pairs: tuple = (),
 ):
     """Returns (tokens [b, total], gen_lengths [b], log_probs [b, total]).
 
     ``batch_times_seqlen_threshold``: prefill forwards whose batch*seqlen
     exceeds it run micro-batched (sequential ``lax.map`` chunks), so the
     [b, n, vocab] prefill logits are never materialized at once —
-    the reference's ``--inference_batch_times_seqlen_threshold``."""
+    the reference's ``--inference_batch_times_seqlen_threshold``.
+
+    Reference server semantics (text_generation/generation.py:89-287):
+    ``top_p_decay``/``top_p_bound`` multiply top_p by decay each generated
+    token with a floor at bound; ``extra_stop_ids`` stop a row like eod
+    (stop_on_eol / stop_on_double_eol); ``stop_pairs`` stop on a
+    (prev, cur) token bigram (two consecutive newlines); ``ban_pairs``
+    zero out token ``b`` whenever the previous token is ``a``
+    (prevent_newline_after_colon)."""
     cfg = model.cfg
     b, max_prompt = prompt_tokens.shape
     total = max_prompt + max_new_tokens
@@ -172,7 +189,20 @@ def generate_tokens(
     def body(state):
         pos, tokens, caches, last_logits, log_probs, done, key = state
         key, sub = jax.random.split(key)
-        nxt = sample(last_logits, sub, top_k=top_k, top_p=top_p,
+        prev = jax.lax.dynamic_index_in_dim(tokens, pos - 1, 1,
+                                            keepdims=False)
+        for a, b_id in ban_pairs:
+            # ban token b after token a (prevent_newline_after_colon)
+            hit = (prev == a)
+            last_logits = last_logits.at[:, b_id].add(
+                jnp.where(hit, NEG_INF_LOGIT, 0.0))
+        if top_p_decay > 0.0 and top_p > 0.0:
+            step_ix = (pos - prefill).astype(jnp.float32)
+            top_p_t = jnp.maximum(top_p * top_p_decay ** step_ix,
+                                  top_p_bound)
+        else:
+            top_p_t = top_p
+        nxt = sample(last_logits, sub, top_k=top_k, top_p=top_p_t,
                      temperature=temperature, greedy=greedy)
         in_prompt = pos < prompt_lengths
         cur = jax.lax.dynamic_index_in_dim(tokens, pos, 1, keepdims=False)
@@ -191,6 +221,10 @@ def generate_tokens(
             )
         if eod_id is not None:
             done = done | ((new_tok == eod_id) & ~in_prompt)
+        for s in extra_stop_ids:
+            done = done | ((new_tok == s) & ~in_prompt)
+        for a, b_id in stop_pairs:
+            done = done | ((prev == a) & (new_tok == b_id) & ~in_prompt)
         logits, caches = _forward_with_cache(
             model, params, new_tok[:, None], caches, pos
         )

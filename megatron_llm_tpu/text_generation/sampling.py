@@ -28,14 +28,24 @@ def modify_logits(
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, NEG_INF, logits)
-    if top_p > 0.0 and top_p < 1.0:
+    # top_p may be a traced scalar (per-step decayed value, the
+    # reference's top_p_decay/top_p_bound machinery) — the filter is then
+    # built unconditionally and gated with jnp.where
+    dynamic_p = isinstance(top_p, jax.Array)
+    if dynamic_p or (top_p > 0.0 and top_p < 1.0):
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # keep tokens until cumulative mass exceeds top_p (always keep top-1)
         cutoff_idx = jnp.sum((cum - probs) < top_p, axis=-1, keepdims=True) - 1
+        cutoff_idx = jnp.maximum(cutoff_idx, 0)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-        logits = jnp.where(logits < cutoff, NEG_INF, logits)
+        filtered = jnp.where(logits < cutoff, NEG_INF, logits)
+        if dynamic_p:
+            active = (top_p > 0.0) & (top_p < 1.0)
+            logits = jnp.where(active, filtered, logits)
+        else:
+            logits = filtered
     return logits
 
 

@@ -37,11 +37,21 @@ class MegatronGenerate:
     def handle(self, payload: dict):
         if "prompts" not in payload:
             return 400, {"message": "prompts argument required"}
+        if "max_len" in payload:
+            return 400, {"message": "max_len is no longer used.  Replace "
+                                    "with tokens_to_generate"}
+        if "sentences" in payload:
+            return 400, {"message": "sentences is no longer used.  Replace "
+                                    "with prompts"}
         prompts = payload["prompts"]
         if not isinstance(prompts, list) or not prompts:
             return 400, {"message": "prompts must be a non-empty list"}
         if len(prompts) > MAX_PROMPTS:
             return 400, {"message": f"maximum number of prompts is {MAX_PROMPTS}"}
+        add_BOS = bool(payload.get("add_BOS", False))
+        if not add_BOS and any(len(p) == 0 for p in prompts
+                               if isinstance(p, str)):
+            return 400, {"message": "Empty prompts require add_BOS=true"}
         tokens_to_generate = payload.get("tokens_to_generate", 64)
         if not isinstance(tokens_to_generate, int) or tokens_to_generate < 0:
             return 400, {"message": "tokens_to_generate must be an integer >= 0"}
@@ -57,8 +67,26 @@ class MegatronGenerate:
         temperature = float(payload.get("temperature", 1.0))
         if temperature < 0.0 or temperature > 100.0:
             return 400, {"message": "temperature must be in (0, 100]"}
+        top_p_decay = float(payload.get("top_p_decay", 0.0))
+        if top_p_decay < 0.0 or top_p_decay > 1.0:
+            return 400, {"message": "top_p_decay must be in [0, 1]"}
+        if top_p_decay > 0.0 and top_p == 0.0:
+            return 400, {"message": "top_p_decay requires top_p"}
+        top_p_bound = float(payload.get("top_p_bound", 0.0))
+        if "top_p_bound" in payload and (top_p_bound <= 0.0
+                                         or top_p_bound > top_p):
+            return 400, {"message": "top_p_bound must be in (0, top_p]"}
+        stop_on_double_eol = bool(payload.get("stop_on_double_eol", False))
+        stop_on_eol = bool(payload.get("stop_on_eol", False))
+        prevent_newline_after_colon = bool(
+            payload.get("prevent_newline_after_colon", False))
+        no_log = bool(payload.get("no_log", False))
         beam_width = payload.get("beam_width", None)
+        stop_token = payload.get("stop_token", None)
+        length_penalty = float(payload.get("length_penalty", 1.0))
         random_seed = int(payload.get("random_seed", 0))
+        if not no_log:
+            print(json.dumps(payload), flush=True)
 
         with self.lock:  # single in-flight generation (reference uses a lock)
             if beam_width is not None:
@@ -68,6 +96,9 @@ class MegatronGenerate:
                     self.model, self.params, self.tokenizer, prompts,
                     tokens_to_generate=tokens_to_generate,
                     beam_size=int(beam_width),
+                    length_penalty=length_penalty,
+                    stop_token=(int(stop_token) if stop_token is not None
+                                else None),
                 )
                 return 200, {"text": texts, "scores": scores.tolist()}
             texts, segments, log_probs, tokens = generate_and_post_process(
@@ -78,6 +109,12 @@ class MegatronGenerate:
                 top_p_sampling=top_p,
                 temperature=temperature,
                 random_seed=random_seed,
+                add_BOS=add_BOS,
+                top_p_decay=top_p_decay,
+                top_p_bound=top_p_bound,
+                stop_on_eol=stop_on_eol,
+                stop_on_double_eol=stop_on_double_eol,
+                prevent_newline_after_colon=prevent_newline_after_colon,
             )
             out = {"text": texts, "segments": segments, "tokens": tokens}
             if logprobs:

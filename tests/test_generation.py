@@ -184,6 +184,95 @@ def test_server_contract(model_and_params):
     httpd_holder["srv"].shutdown()
 
 
+def test_extra_stop_ids_and_pairs(model_and_params):
+    """stop_on_eol/double-eol semantics: a row stops at an extra stop id
+    or a (prev, cur) bigram exactly like eod."""
+    model, params = model_and_params
+    toks = jnp.asarray([[1, 2, 3, 4]])
+    lens = jnp.asarray([4])
+    base, n_base, _ = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(0),
+        max_new_tokens=8, min_prompt_len=4, greedy=True)
+    base_row = np.asarray(base)[0]
+    first_gen = int(base_row[4])
+    assert first_gen != 0
+
+    # stopping on the first generated token: generation freezes there
+    out, n_stop, _ = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(0),
+        max_new_tokens=8, min_prompt_len=4, greedy=True,
+        extra_stop_ids=(first_gen,))
+    row = np.asarray(out)[0]
+    assert int(row[4]) == first_gen
+    # generation stopped right after the stop token: the rest of the row
+    # is never written (stays at the zero initialization)
+    assert int(n_stop) == 5 and all(int(t) == 0 for t in row[5:])
+
+    # bigram stop: (prompt-last, first-gen) matches immediately
+    out2, n2, _ = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(0),
+        max_new_tokens=8, min_prompt_len=4, greedy=True,
+        stop_pairs=((4, first_gen),))
+    row2 = np.asarray(out2)[0]
+    assert int(n2) == 5 and all(int(t) == 0 for t in row2[5:])
+
+
+def test_ban_pairs_changes_sampling(model_and_params):
+    """prevent_newline_after_colon semantics: the banned token can never
+    follow the trigger token."""
+    model, params = model_and_params
+    toks = jnp.asarray([[1, 2, 3, 4]])
+    lens = jnp.asarray([4])
+    base, _, _ = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(0),
+        max_new_tokens=8, min_prompt_len=4, greedy=True)
+    row = np.asarray(base)[0]
+    first_gen = int(row[4])
+    # ban exactly what greedy would pick after the prompt's last token
+    out, _, _ = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(0),
+        max_new_tokens=8, min_prompt_len=4, greedy=True,
+        ban_pairs=((4, first_gen),))
+    assert int(np.asarray(out)[0][4]) != first_gen
+
+
+def test_top_p_decay_runs_and_bounds():
+    """Dynamic (traced) top_p filter: decayed top_p must floor at bound
+    and still produce valid samples."""
+    from megatron_llm_tpu.text_generation.sampling import modify_logits
+
+    logits = jnp.asarray(np.random.RandomState(0).randn(2, 16), jnp.float32)
+    # tiny traced top_p keeps exactly the top-1 token per row
+    out = jax.jit(lambda l, p: modify_logits(l, top_p=p))(
+        logits, jnp.float32(1e-6))
+    kept = (np.asarray(out) > -1e9).sum(axis=-1)
+    np.testing.assert_array_equal(kept, [1, 1])
+    # a permissive traced top_p (0.9) keeps more than greedy but not all
+    out9 = jax.jit(lambda l, p: modify_logits(l, top_p=p))(
+        logits, jnp.float32(0.9))
+    kept9 = (np.asarray(out9) > -1e9).sum(axis=-1)
+    assert (kept9 >= 1).all() and (kept9 < 16).all()
+    # inactive traced top_p (0.0) leaves logits unchanged
+    out0 = jax.jit(lambda l, p: modify_logits(l, top_p=p))(
+        logits, jnp.float32(0.0))
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(logits))
+
+
+def test_top_p_decay_through_decode(model_and_params):
+    """top_p_decay/bound wired through the while-loop body: the decode
+    must run, produce valid ids, and differ structurally from no-decay
+    only in sampling (shapes/lengths identical)."""
+    model, params = model_and_params
+    toks = jnp.asarray([[1, 2, 3, 4]])
+    lens = jnp.asarray([4])
+    out, n, _ = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(1),
+        max_new_tokens=6, min_prompt_len=4,
+        top_p=0.9, top_p_decay=0.8, top_p_bound=0.2)
+    row = np.asarray(out)[0]
+    assert int(n) == 10 and ((row >= 0) & (row < 64)).all()
+
+
 @pytest.mark.parametrize("tp,sp", [(2, False), (4, True)])
 def test_sharded_generation_matches_unsharded(model_and_params, utils,
                                               tp, sp):
