@@ -1,0 +1,29 @@
+#!/bin/bash
+# Biencoder retriever finetune + evidence indexing + NQ eval
+# (reference: examples/finetune_retriever_distributed.sh +
+# evaluate_retriever_nq.sh).  TPU single-controller: no torchrun; tp/dp
+# come from the flags.
+set -euo pipefail
+WIKI_TSV=${1:?usage: $0 <wiki-evidence.tsv> <nq-dev.jsonl> <vocab.txt> [ckpt]}
+QA_DEV=${2:?}
+VOCAB=${3:?}
+CKPT=${4:-}
+
+ARGS=(
+  --num_layers 12 --hidden_size 768 --num_attention_heads 12
+  --seq_length 512 --max_position_embeddings 512
+  --retriever_seq_length 256
+  --micro_batch_size 8
+  --tokenizer_type BertWordPieceLowerCase --vocab_file "$VOCAB"
+  --biencoder_projection_dim 128
+)
+[ -n "$CKPT" ] && ARGS+=(--load "$CKPT")
+
+# 1. embed the evidence corpus with the context tower (skipped if the
+#    store exists), 2. report retriever recall@k on NQ dev
+exec python tasks/main.py --task RETRIEVER-EVAL \
+  "${ARGS[@]}" \
+  --evidence_data_path "$WIKI_TSV" \
+  --embedding_path wiki_evidence_emb.pkl \
+  --qa_data_dev "$QA_DEV" \
+  --retriever_report_topk_accuracies 1 5 20 100

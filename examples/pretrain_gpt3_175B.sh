@@ -1,0 +1,33 @@
+#!/bin/bash
+# GPT-3 175B pretraining at scale (reference: examples/pretrain_gpt3_175B.sh,
+# a 128-node SLURM/A100 recipe).  TPU version: one process per host over a
+# v5p pod slice; jax.distributed rendezvous uses the torchrun-style env
+# (RANK / WORLD_SIZE / MASTER_ADDR / MASTER_PORT) on every host.
+#
+# Layout: tp=8 (intra-host ICI) x pp=16 x dp=(chips/128); ZeRO-1 shards
+# optimizer state over dp.  Sanity-check the per-chip HBM of a layout
+# without hardware first:
+#   python tools/aot_memcheck.py --list   (add a config with these shapes)
+set -euo pipefail
+DATA_PATH=${1:?usage: $0 <blended data spec...>}
+
+exec python pretrain_gpt.py \
+  --tensor_model_parallel_size 8 \
+  --pipeline_model_parallel_size 16 \
+  --num_layers 96 --hidden_size 12288 --num_attention_heads 96 \
+  --seq_length 2048 --max_position_embeddings 2048 \
+  --micro_batch_size 1 --global_batch_size 1536 \
+  --rampup_batch_size 16 16 5859375 \
+  --train_samples 146484375 \
+  --lr_decay_samples 126953125 \
+  --lr_warmup_samples 183105 \
+  --lr 6.0e-5 --min_lr 6.0e-6 --lr_decay_style cosine \
+  --weight_decay 0.1 --clip_grad 1.0 \
+  --adam_beta1 0.9 --adam_beta2 0.95 --init_method_std 0.006 \
+  --bf16 --sequence_parallel --use_distributed_optimizer \
+  --recompute_granularity selective \
+  --data_path "$DATA_PATH" --split 949,50,1 \
+  --tokenizer_type GPT2BPETokenizer \
+  --vocab_file gpt2-vocab.json --merge_file gpt2-merges.txt \
+  --log_interval 10 --save_interval 1000 --eval_interval 1000 \
+  --eval_iters 10 --save checkpoints/gpt3_175b

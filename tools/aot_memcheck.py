@@ -68,6 +68,27 @@ CONFIGS = {
         hbm_gb=95, tp=8, pp=4, vpp=None, seq=4096, micro_batch=1,
         num_micro=8, zero1=True,
     ),
+    # SC21 weak-scaling suite rows (reference examples/sc21/run_table_1.sh
+    # + arXiv 2104.04473 Table 1) mapped onto v5p topologies — GPT-2
+    # architecture, seq 2048, same tp/pp split, dp fills the slice
+    "sc21-1.7b": dict(
+        family="gpt", shape=dict(num_layers=24, hidden_size=2304,
+                                 num_attention_heads=24),
+        topology="v5p:2x2x1", accel="v5p-8", hbm_gb=95, tp=1, pp=1,
+        vpp=None, seq=2048, micro_batch=4, num_micro=2, zero1=True,
+    ),
+    "sc21-18b": dict(
+        family="gpt", shape=dict(num_layers=40, hidden_size=6144,
+                                 num_attention_heads=48),
+        topology="v5p:4x2x2", accel="v5p-32", hbm_gb=95, tp=8, pp=1,
+        vpp=None, seq=2048, micro_batch=1, num_micro=4, zero1=True,
+    ),
+    "sc21-175b": dict(
+        family="gpt", shape=dict(num_layers=96, hidden_size=12288,
+                                 num_attention_heads=96),
+        topology="v5p:8x4x8", accel="v5p-512", hbm_gb=95, tp=8, pp=16,
+        vpp=None, seq=2048, micro_batch=1, num_micro=32, zero1=True,
+    ),
 }
 
 
@@ -81,6 +102,14 @@ def _model_for(spec):
         use_flash_attn=True,
         use_fused_rmsnorm=False,
     )
+    if spec["family"] == "gpt":
+        from megatron_llm_tpu.models.gpt import GPTModel
+        from megatron_llm_tpu.models.gpt2 import gpt2_config
+
+        common.pop("use_fused_rmsnorm", None)
+        return GPTModel(gpt2_config(
+            "tiny", **spec["shape"], padded_vocab_size=51200,
+            hidden_dropout=0.0, attention_dropout=0.0, **common))
     if spec["family"] == "llama2":
         from megatron_llm_tpu.models.llama import LlamaModel, llama_config
 
