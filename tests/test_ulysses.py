@@ -127,6 +127,33 @@ def test_indivisible_heads_fall_back_to_ring(utils, monkeypatch):
     np.testing.assert_allclose(np.asarray(out), np.asarray(base), atol=3e-5)
 
 
+def test_pipeline_with_ulysses_cp(utils):
+    """pp=2 x cp=2 x dp=2 with the Ulysses algorithm: the cp all-to-all
+    nests inside the pp-manual region (abstract context mesh via
+    topology.nesting_mesh) and matches the unpipelined, unsharded loss
+    — the same composition guarantee the ring algorithm has
+    (tests/test_pipeline.py::test_pipeline_with_context_parallelism)."""
+    from megatron_llm_tpu.parallel.pipeline import build_pipeline_loss_fn
+    from tests.test_pipeline import _batch, _unpiped_loss
+
+    cfg = llama_config("tiny", num_layers=4, seq_length=64,
+                       max_position_embeddings=64, padded_vocab_size=128,
+                       context_parallel_algo="ulysses")
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = _batch(2, 2, 64, 128)
+    base = float(_unpiped_loss(model, params, batch))
+
+    mesh = utils.initialize_model_parallel(tp=1, pp=2, cp=2)
+    ps = sh.shard_params(params, model.param_specs(params))
+    dsh = NamedSharding(mesh, P(None, "dp", "cp"))
+    batch_s = {k: jax.device_put(v, dsh) for k, v in batch.items()}
+    loss_fn = build_pipeline_loss_fn(model, 2, 2)
+    out = jax.jit(lambda p, b, k: loss_fn(p, b, k, train=False)[1])(
+        ps, batch_s, jax.random.PRNGKey(0))
+    assert abs(float(out) - base) < 1e-3
+
+
 def test_ulysses_train_step(utils):
     """One full training step with ulysses cp (dp x cp mesh): finite loss
     and grads flow."""
