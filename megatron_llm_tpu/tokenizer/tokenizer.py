@@ -7,7 +7,9 @@ with vocab padding to ``make_vocab_size_divisible_by x tp_size``;
 mistral with special-token handling and ``--no_new_tokens``).
 
 TPU build: tokenization is pure host-side; the implementations wrap the
-baked-in ``transformers``/``tokenizers`` fast backends rather than
+baked-in ``transformers``/``tokenizers`` fast backends when available,
+falling back to the self-contained WordPiece / byte-BPE implementations
+in ``tokenizer/wordpiece.py`` and ``tokenizer/bpe.py`` rather than
 vendoring BPE code.  ``sentencepiece`` is optional in this image — the
 SentencePiece path degrades to a clear error (or the HF fast tokenizer for
 the same model when given a directory).
@@ -141,10 +143,17 @@ class _GPT2BPETokenizer(AbstractTokenizer):
     """GPT-2 byte-level BPE from local vocab.json + merges.txt."""
 
     def __init__(self, vocab_file: str, merge_file: str):
-        from transformers import GPT2TokenizerFast
+        try:
+            from transformers import GPT2TokenizerFast
 
-        self._tok = GPT2TokenizerFast(vocab_file=vocab_file,
-                                      merges_file=merge_file)
+            self._tok = GPT2TokenizerFast(vocab_file=vocab_file,
+                                          merges_file=merge_file)
+        except ImportError:
+            # standalone byte-level BPE (tokenizer/bpe.py) — same
+            # algorithm, no transformers dependency
+            from megatron_llm_tpu.tokenizer.bpe import StandaloneGPT2BPE
+
+            self._tok = StandaloneGPT2BPE(vocab_file, merge_file)
         self._eod = self._tok.convert_tokens_to_ids("<|endoftext|>")
 
     @property
@@ -173,10 +182,20 @@ class _GPT2BPETokenizer(AbstractTokenizer):
 class _BertWordPieceTokenizer(AbstractTokenizer):
     def __init__(self, vocab_file: str, lower_case: bool = True,
                  vocab_extra_ids: int = 0):
-        from transformers import BertTokenizerFast
+        try:
+            from transformers import BertTokenizerFast
 
-        self._tok = BertTokenizerFast(vocab_file=vocab_file,
-                                      do_lower_case=lower_case)
+            self._tok = BertTokenizerFast(vocab_file=vocab_file,
+                                          do_lower_case=lower_case)
+        except ImportError:
+            # standalone WordPiece (tokenizer/wordpiece.py) — same
+            # algorithm, no transformers dependency
+            from megatron_llm_tpu.tokenizer.wordpiece import (
+                StandaloneWordPiece,
+            )
+
+            self._tok = StandaloneWordPiece(vocab_file,
+                                            do_lower_case=lower_case)
         # dedicated [BOS]/[EOS] tokens, matching the reference's
         # _BertWordPieceTokenizer (tokenizer.py:156-200: add_token('[BOS]'),
         # add_token('[EOS]')) — bos/eos must NOT collide with CLS/SEP/eod,

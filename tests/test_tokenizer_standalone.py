@@ -1,0 +1,153 @@
+"""Standalone (transformers-free) tokenizer backends must match the HF
+fast backends token-for-token on the same vocab files — WordPiece
+(tokenizer/wordpiece.py) and GPT-2 byte-level BPE (tokenizer/bpe.py)."""
+
+import json
+
+import pytest
+
+from megatron_llm_tpu.tokenizer.bpe import StandaloneGPT2BPE
+from megatron_llm_tpu.tokenizer.wordpiece import StandaloneWordPiece
+
+WP_VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "the", "quick", "brown", "fox", "jump", "##s", "##ed", "##ing",
+    "over", "lazy", "dog", "run", "##ner", "!", ",", ".", "$", "@",
+    "2", "##0", "##2", "##4", "cafe", "中", "文",
+]
+
+TEXTS_WP = [
+    "The quick brown fox jumps over the lazy dog.",
+    "runner running, jumped!",
+    "Café CAFE cafe",           # accent strip + lowercase
+    "$2024 @the",               # symbol splitting
+    "中文 the dog",              # CJK per-character
+    "unknownword the",          # [UNK] path
+    "the [MASK] fox [SEP]",     # special tokens stay atomic
+    "",
+]
+
+
+@pytest.fixture(scope="module")
+def wp_pair(tmp_path_factory):
+    p = tmp_path_factory.mktemp("wp") / "vocab.txt"
+    p.write_text("\n".join(WP_VOCAB) + "\n")
+    standalone = StandaloneWordPiece(str(p))
+    hf = pytest.importorskip("transformers").BertTokenizerFast(
+        vocab_file=str(p), do_lower_case=True)
+    return standalone, hf
+
+
+def test_wordpiece_matches_hf(wp_pair):
+    standalone, hf = wp_pair
+    for text in TEXTS_WP:
+        got = standalone.encode(text, add_special_tokens=False)
+        want = hf.encode(text, add_special_tokens=False)
+        assert got == want, (text, got, want)
+
+
+def test_wordpiece_special_token_growth(wp_pair):
+    standalone, _ = wp_pair
+    n0 = len(standalone)
+    standalone.add_special_tokens({"bos_token": "[BOS]",
+                                   "eos_token": "[EOS]"})
+    assert standalone.bos_token_id == n0
+    assert standalone.eos_token_id == n0 + 1
+    standalone.add_special_tokens(
+        {"additional_special_tokens": ["<extra_id_0>", "<extra_id_1>"]})
+    assert standalone.additional_special_tokens_ids == [n0 + 2, n0 + 3]
+
+
+def test_wordpiece_decode_joins_continuations(wp_pair):
+    standalone, _ = wp_pair
+    ids = standalone.encode("jumps", add_special_tokens=False)
+    assert standalone.decode(ids) == "jumps"
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 byte-level BPE
+# ---------------------------------------------------------------------------
+
+def _mini_bpe_files(tmp_path):
+    """A miniature but complete GPT-2-format vocab: every base
+    byte-unicode symbol + a few merges + <|endoftext|>."""
+    from megatron_llm_tpu.tokenizer.bpe import bytes_to_unicode
+
+    base = list(bytes_to_unicode().values())
+    merges = [("h", "e"), ("l", "l"), ("he", "ll"), ("o", "w"),
+              ("Ġ", "w"), ("Ġw", "o"), ("hell", "o"), ("Ġwo", "rld")]
+    # merge outputs must exist in the vocab; 'rld' pieces come from base
+    extra = ["he", "ll", "hell", "ow", "Ġw", "Ġwo", "hello", "rl",
+             "Ġworld", "rld"]
+    merges.insert(0, ("r", "l"))
+    merges.insert(1, ("rl", "d"))
+    vocab = {t: i for i, t in enumerate(base + extra + ["<|endoftext|>"])}
+    vf = tmp_path / "vocab.json"
+    vf.write_text(json.dumps(vocab))
+    mf = tmp_path / "merges.txt"
+    mf.write_text("#version: 0.2\n"
+                  + "\n".join(" ".join(m) for m in merges) + "\n")
+    return str(vf), str(mf)
+
+
+TEXTS_BPE = [
+    "hello world",
+    "hello <|endoftext|> world",   # special token stays atomic
+    "hello hello world!",
+    "  spaces   and\nnewlines",
+    "unicode: café 中文 🙂",
+    "",
+]
+
+
+def test_gpt2_bpe_matches_hf(tmp_path):
+    vf, mf = _mini_bpe_files(tmp_path)
+    standalone = StandaloneGPT2BPE(vf, mf)
+    transformers = pytest.importorskip("transformers")
+    hf = transformers.GPT2TokenizerFast(vocab_file=vf, merges_file=mf)
+    for text in TEXTS_BPE:
+        got = standalone.encode(text)
+        want = hf.encode(text)
+        assert got == want, (text, got, want)
+        assert standalone.decode(got) == hf.decode(want)
+
+
+def test_gpt2_bpe_roundtrip_arbitrary_bytes(tmp_path):
+    vf, mf = _mini_bpe_files(tmp_path)
+    standalone = StandaloneGPT2BPE(vf, mf)
+    text = "hello world \t ~ § ß 中"
+    assert standalone.decode(standalone.encode(text)) == text
+
+
+def test_wrapper_uses_standalone_when_transformers_missing(tmp_path,
+                                                          monkeypatch):
+    """_BertWordPieceTokenizer / _GPT2BPETokenizer fall back to the
+    standalone backends when transformers cannot import."""
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_transformers(name, *a, **kw):
+        if name == "transformers" or name.startswith("transformers."):
+            raise ImportError("blocked for test")
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(builtins, "__import__", no_transformers)
+
+    from megatron_llm_tpu.tokenizer.tokenizer import (
+        _BertWordPieceTokenizer,
+        _GPT2BPETokenizer,
+    )
+
+    wp_vf = tmp_path / "v.txt"
+    wp_vf.write_text("\n".join(WP_VOCAB) + "\n")
+    tok = _BertWordPieceTokenizer(str(wp_vf))
+    ids = tok.tokenize("the quick fox")
+    assert ids and all(isinstance(i, int) for i in ids)
+    assert tok.bos_token_id is not None and tok.cls is not None
+
+    vf, mf = _mini_bpe_files(tmp_path)
+    tok2 = _GPT2BPETokenizer(vf, mf)
+    ids2 = tok2.tokenize("hello world")
+    assert ids2 and tok2.detokenize(ids2) == "hello world"
+    assert tok2.eod == tok2.vocab["<|endoftext|>"]
