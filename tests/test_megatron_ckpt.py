@@ -149,3 +149,39 @@ def test_fix_qkv_ordering_skips_gqa():
     w = np.arange(4 * 3 * 2 * 5, dtype=np.float32).reshape(-1, 5)
     out = fix_qkv_ordering(w, 1.0, num_heads=4, num_heads_kv=2, head_dim=2)
     np.testing.assert_array_equal(w, out)
+
+
+def test_checkpoint_util_format_bridge(tmp_path):
+    """tools/checkpoint_util.py converts megatron torch <-> orbax in one
+    CLI call: megatron -> orbax -> megatron with identical weights."""
+    import os
+    import subprocess
+    import sys
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg, model = _tiny_model()
+    params = model.init(jax.random.PRNGKey(0))
+    meg1 = tmp_path / "meg1"
+    save_reference_checkpoint(str(meg1), 7, params, cfg)
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def run(src_fmt, dst_fmt, src, dst):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "checkpoint_util.py"),
+             "--load_dir", str(src), "--save_dir", str(dst),
+             "--input_format", src_fmt, "--output_format", dst_fmt],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+
+    orb = tmp_path / "orb"
+    run("megatron", "orbax", meg1, orb)
+    meg2 = tmp_path / "meg2"
+    run("orbax", "megatron", orb, meg2)
+
+    got, _, meta = load_reference_checkpoint(str(meg2))
+    _leaves_equal(got, params)
+    assert int(meta["iteration"]) == 7

@@ -33,6 +33,12 @@ def main():
     p.add_argument("--dtype", choices=["fp32", "bf16", "fp16"], default=None)
     p.add_argument("--release", action="store_true",
                    help="write as a release checkpoint (iteration 0)")
+    p.add_argument("--input_format", choices=["orbax", "megatron"],
+                   default="orbax",
+                   help="megatron = reference-layout torch mp_rank "
+                        "checkpoint (weights_conversion/megatron_ckpt.py)")
+    p.add_argument("--output_format", choices=["orbax", "megatron"],
+                   default="orbax")
     args = p.parse_args()
 
     import jax.numpy as jnp
@@ -40,11 +46,28 @@ def main():
 
     from megatron_llm_tpu import checkpointing
 
-    params, opt_state, meta = checkpointing.load_checkpoint(args.load_dir)
-    if params is None:
-        params, opt_state, meta = checkpointing.load_checkpoint(
-            args.load_dir, release=True
+    if args.input_format == "megatron":
+        from weights_conversion.megatron_ckpt import (
+            load_reference_checkpoint,
         )
+
+        params, cfg_over, meta = load_reference_checkpoint(args.load_dir)
+        opt_state = None
+        meta = dict(meta)
+        # megatron checkpoints record args as a namespace; normalize to a
+        # plain dict and fold in the recovered config overrides
+        rec = meta.get("args") or {}
+        if not isinstance(rec, dict):
+            rec = dict(vars(rec))
+        rec.update(cfg_over)
+        meta["args"] = rec
+    else:
+        params, opt_state, meta = checkpointing.load_checkpoint(
+            args.load_dir)
+        if params is None:
+            params, opt_state, meta = checkpointing.load_checkpoint(
+                args.load_dir, release=True
+            )
     if params is None:
         raise SystemExit(f"no checkpoint found under {args.load_dir}")
 
@@ -62,15 +85,25 @@ def main():
         if v is not None:
             ckpt_args[k] = v
 
-    iteration = 0 if args.release else meta.get("iteration", 0)
-    checkpointing.save_checkpoint(
-        args.save_dir, iteration, params, opt_state,
-        args=ckpt_args,
-        consumed_samples=meta.get("consumed_samples", 0),
-        release=args.release,
-    )
-    print(f" resharded {args.load_dir} -> {args.save_dir} "
-          f"(layout-independent; target sizes recorded in args)")
+    iteration = 0 if args.release else int(meta.get("iteration") or 0)
+    if args.output_format == "megatron":
+        from weights_conversion.megatron_ckpt import (
+            save_reference_checkpoint,
+        )
+
+        save_reference_checkpoint(
+            args.save_dir, iteration, params, ckpt_args,
+            tensor_parallel=args.target_tensor_parallel_size or 1)
+    else:
+        checkpointing.save_checkpoint(
+            args.save_dir, iteration, params, opt_state,
+            args=ckpt_args,
+            consumed_samples=meta.get("consumed_samples", 0),
+            release=args.release,
+        )
+    print(f" resharded {args.load_dir} ({args.input_format}) -> "
+          f"{args.save_dir} ({args.output_format}); target sizes recorded "
+          f"in args")
 
 
 if __name__ == "__main__":
