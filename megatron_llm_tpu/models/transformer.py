@@ -277,7 +277,52 @@ def attention(
         k = apply_rotary_emb(k, cos, sin, position_ids)
 
     new_cache = None
-    if kv_cache is not None:
+    if kv_cache is not None and "rolling" in kv_cache:
+        # ROLLING cache (sliding-window models): a ring buffer of exactly
+        # window slots — decode memory O(window), not O(total).  Slot
+        # j holds the newest position == j (mod W) written so far; the
+        # mask recovers each slot's position and applies the same
+        # causal+window validity as the linear cache.  Beyond-reference:
+        # the reference's inference cache is always [b, total]
+        # (transformer.py:433-505).  Constraint (documented in
+        # init_kv_caches): any single forward writes <= W tokens.
+        idx = kv_cache["index"]
+        W = kv_cache["k"].shape[1]
+        n = k.shape[1]
+        # attend over [pre-chunk ring || current chunk]: the ring is only
+        # read for positions < idx, so in-chunk writes can never clobber
+        # keys the chunk's own queries still need (any chunk length works)
+        slot = jnp.arange(W)
+        last_pre = idx - 1
+        # newest position == slot (mod W) written before this chunk;
+        # negative = never written (all slots at idx == 0)
+        cache_pos = last_pre - ((last_pre - slot) % W)
+        pos = idx + jnp.arange(n)                # query positions
+        key_pos = jnp.concatenate([cache_pos, pos])
+        valid = (key_pos[None, :] >= 0) & (key_pos[None, :] <= pos[:, None])
+        window = cfg.sliding_window_size
+        assert window is not None, \
+            "rolling KV caches require a sliding-window model"
+        valid &= key_pos[None, :] > pos[:, None] - window
+        mask = ~valid[None, None]
+        # write the chunk into the ring AFTER the read view is formed; for
+        # chunks longer than the ring only the last W tokens survive —
+        # writing all n would scatter duplicate slot indices (unspecified
+        # winner) where only the newest must win
+        if n >= W:
+            w_pos, wk, wv = pos[-W:], k[:, -W:], v[:, -W:]
+        else:
+            w_pos, wk, wv = pos, k, v
+        write = w_pos % W
+        ck = kv_cache["k"].at[:, write].set(wk)
+        cv = kv_cache["v"].at[:, write].set(wv)
+        k = jnp.concatenate([kv_cache["k"], k], axis=1)
+        v = jnp.concatenate([kv_cache["v"], v], axis=1)
+        attention_mask = jnp.broadcast_to(mask,
+                                          (x.shape[0],) + mask.shape[1:])
+        new_cache = {"k": ck, "v": cv, "index": idx + q.shape[1],
+                     "rolling": None}
+    elif kv_cache is not None:
         # incremental decode: write current k/v at cache index, attend over
         # the full cache (reference: transformer.py:433-505)
         idx = kv_cache["index"]

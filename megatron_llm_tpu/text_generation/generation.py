@@ -30,14 +30,31 @@ NEG_INF_LOGIT = -1e10
 
 
 def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int,
-                   dtype=None):
+                   dtype=None, rolling: bool = False):
+    """Per-layer decode caches.  ``rolling=True`` (sliding-window models
+    only) allocates a ring buffer of exactly ``sliding_window_size``
+    slots instead of ``max_len`` — decode memory O(window) rather than
+    O(total), a beyond-reference memory mode (the reference's inference
+    cache is always full-length).  Forwards of any chunk length are
+    exact: attention reads [pre-chunk ring || current chunk] and the
+    ring is written after (models/transformer.py rolling branch)."""
     dtype = dtype or cfg.compute_jnp_dtype
     ng, d = cfg.num_query_groups, cfg.head_dim
+    if rolling:
+        assert cfg.sliding_window_size is not None, \
+            "rolling caches need a sliding-window model"
+        size = min(max_len, cfg.sliding_window_size)
+    else:
+        size = max_len
     return [
         {
-            "k": jnp.zeros((batch, max_len, ng, d), dtype),
-            "v": jnp.zeros((batch, max_len, ng, d), dtype),
+            "k": jnp.zeros((batch, size, ng, d), dtype),
+            "v": jnp.zeros((batch, size, ng, d), dtype),
             "index": jnp.int32(0),
+            # presence marker (value None = empty pytree node): the flag
+            # must be STRUCTURAL, not a leaf, so the decode while-loop
+            # carry doesn't trace it into a bool array
+            **({"rolling": None} if rolling else {}),
         }
         for _ in range(cfg.num_layers)
     ]
@@ -77,7 +94,7 @@ def _prefill_chunks(b: int, n: int, threshold: Optional[int]) -> int:
                      "top_p", "temperature", "greedy", "eod_id",
                      "return_log_probs", "batch_times_seqlen_threshold",
                      "top_p_decay", "top_p_bound", "extra_stop_ids",
-                     "stop_pairs", "ban_pairs"),
+                     "stop_pairs", "ban_pairs", "rolling_cache"),
 )
 def generate_tokens(
     model,
@@ -100,6 +117,7 @@ def generate_tokens(
     extra_stop_ids: tuple = (),
     stop_pairs: tuple = (),
     ban_pairs: tuple = (),
+    rolling_cache: bool = False,
 ):
     """Returns (tokens [b, total], gen_lengths [b], log_probs [b, total]).
 
@@ -118,7 +136,7 @@ def generate_tokens(
     cfg = model.cfg
     b, max_prompt = prompt_tokens.shape
     total = max_prompt + max_new_tokens
-    caches = init_kv_caches(cfg, b, total)
+    caches = init_kv_caches(cfg, b, total, rolling=rolling_cache)
 
     tokens = jnp.concatenate(
         [prompt_tokens,
@@ -152,7 +170,10 @@ def generate_tokens(
         caches_c = [
             {"k": c["k"].reshape(C, bc, *c["k"].shape[1:]),
              "v": c["v"].reshape(C, bc, *c["v"].shape[1:]),
-             "index": jnp.broadcast_to(c["index"], (C,))}
+             "index": jnp.broadcast_to(c["index"], (C,)),
+             # preserve the structural rolling marker, or the chunked
+             # prefill would silently fall back to linear-cache semantics
+             **({"rolling": None} if "rolling" in c else {})}
             for c in caches
         ]
 

@@ -1,0 +1,131 @@
+"""Rolling (ring-buffer) KV cache for sliding-window models: O(window)
+decode memory with logits identical to the full-length cache (positions
+outside the window are masked in both)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu.models.mistral import mistral_config
+from megatron_llm_tpu.models.gpt import GPTModel
+from megatron_llm_tpu.text_generation.generation import (
+    _forward_with_cache,
+    init_kv_caches,
+)
+
+WINDOW = 8
+
+
+def _model():
+    cfg = mistral_config(
+        "tiny", num_layers=2, hidden_size=64, num_attention_heads=4,
+        ffn_hidden_size=176, padded_vocab_size=64, seq_length=64,
+        max_position_embeddings=64, sliding_window_size=WINDOW,
+        use_flash_attn=False)
+    model = GPTModel(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def test_rolling_cache_matches_full_cache():
+    """Decode 24 positions (3x the window) step by step: every step's
+    logits from the W-slot ring buffer equal the full-length cache's."""
+    model, params = _model()
+    total = 24
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 64, (2, total)))
+
+    full = init_kv_caches(model.cfg, 2, total)
+    ring = init_kv_caches(model.cfg, 2, total, rolling=True)
+    assert ring[0]["k"].shape[1] == WINDOW          # O(window) memory
+    assert full[0]["k"].shape[1] == total
+
+    # prefill 4 (<= window), then single-token steps
+    lf, full = _forward_with_cache(model, params, toks[:, :4], full, 0)
+    lr, ring = _forward_with_cache(model, params, toks[:, :4], ring, 0)
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(lf), atol=2e-4)
+
+    for t in range(4, total):
+        lf, full = _forward_with_cache(model, params, toks[:, t:t + 1],
+                                       full, t)
+        lr, ring = _forward_with_cache(model, params, toks[:, t:t + 1],
+                                       ring, t)
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                                   atol=2e-4, err_msg=f"step {t}")
+
+
+def test_rolling_cache_multi_token_chunks():
+    """Chunked writes (n > 1, n <= window) wrap correctly across the
+    ring boundary."""
+    model, params = _model()
+    total = 20
+    rng = np.random.RandomState(1)
+    toks = jnp.asarray(rng.randint(0, 64, (1, total)))
+
+    full = init_kv_caches(model.cfg, 1, total)
+    ring = init_kv_caches(model.cfg, 1, total, rolling=True)
+    # chunks of 5: boundaries at 5, 10, 15 cross the 8-slot ring wrap
+    for lo in range(0, total, 5):
+        lf, full = _forward_with_cache(model, params, toks[:, lo:lo + 5],
+                                       full, lo)
+        lr, ring = _forward_with_cache(model, params, toks[:, lo:lo + 5],
+                                       ring, lo)
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                                   atol=2e-4, err_msg=f"chunk@{lo}")
+
+
+def test_rolling_requires_sliding_window():
+    from megatron_llm_tpu.models.llama import llama_config, LlamaModel
+
+    cfg = llama_config("tiny", num_layers=1, hidden_size=64,
+                       num_attention_heads=4, ffn_hidden_size=176,
+                       padded_vocab_size=64, seq_length=16,
+                       max_position_embeddings=16)
+    model = LlamaModel(cfg)
+    try:
+        init_kv_caches(model.cfg, 1, 16, rolling=True)
+        assert False, "expected AssertionError"
+    except AssertionError:
+        pass
+
+
+def test_rolling_chunk_longer_than_window():
+    """n > W single forward: output exact, and the ring afterwards holds
+    only the last W positions (no duplicate-scatter corruption) so
+    subsequent decode steps stay exact."""
+    model, params = _model()
+    total = 20
+    rng = np.random.RandomState(2)
+    toks = jnp.asarray(rng.randint(0, 64, (1, total)))
+
+    full = init_kv_caches(model.cfg, 1, total)
+    ring = init_kv_caches(model.cfg, 1, total, rolling=True)
+    # one 12-token prefill (12 > W=8), then single-token decode
+    lf, full = _forward_with_cache(model, params, toks[:, :12], full, 0)
+    lr, ring = _forward_with_cache(model, params, toks[:, :12], ring, 0)
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(lf), atol=2e-4)
+    for t in range(12, total):
+        lf, full = _forward_with_cache(model, params, toks[:, t:t + 1],
+                                       full, t)
+        lr, ring = _forward_with_cache(model, params, toks[:, t:t + 1],
+                                       ring, t)
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lf),
+                                   atol=2e-4, err_msg=f"step {t}")
+
+
+def test_generate_tokens_rolling_matches_linear():
+    """End-to-end greedy decode with rolling_cache=True equals the
+    full-cache decode."""
+    from megatron_llm_tpu.text_generation.generation import generate_tokens
+
+    model, params = _model()
+    toks = jnp.asarray([[1, 2, 3, 4]])
+    lens = jnp.asarray([4])
+    want, n_want, _ = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(0),
+        max_new_tokens=16, min_prompt_len=4, greedy=True)
+    got, n_got, _ = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(0),
+        max_new_tokens=16, min_prompt_len=4, greedy=True,
+        rolling_cache=True)
+    assert int(n_got) == int(n_want)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
