@@ -319,6 +319,7 @@ def main():
             # main builds it
             scheduler_ if scheduler_ is not None else scheduler,
             args=checkpointing.config_to_args(getattr(model, "cfg", None)),
+            async_save=getattr(args, "async_save", False),
         )
 
     if args.fp16 or args.bf16:
@@ -463,6 +464,7 @@ def main():
         log_validation_ppl=args.log_validation_ppl_to_tensorboard,
         log_interval=args.log_interval,
         save_interval=args.save_interval,
+        async_save=getattr(args, "async_save", False),
         save_dir=args.save,
         eval_iterator=None if pipelined else eval_iter,
         eval_interval=(args.eval_interval
@@ -478,6 +480,9 @@ def main():
 
     if args.save:
         save_natural(args.save, it, params, opt_state)
+        # flush a final --async_save before the interpreter starts tearing
+        # down orbax's executor (a dangling dispatch races shutdown)
+        checkpointing.finalize_async_saves()
         print(f" saved final checkpoint at iteration {it}")
 
 

@@ -191,6 +191,9 @@ def _add_checkpointing_args(parser):
     g = parser.add_argument_group("checkpointing")
     g.add_argument("--save", type=str, default=None)
     g.add_argument("--save_interval", type=int, default=None)
+    g.add_argument("--async_save", action="store_true",
+                   help="background tensorstore writes; the tracker file "
+                        "lands only once the data is durable")
     g.add_argument("--no_save_optim", action="store_true")
     g.add_argument("--no_save_rng", action="store_true")
     g.add_argument("--load", type=str, default=None)

@@ -78,6 +78,41 @@ def config_to_args(cfg) -> dict:
     return {}
 
 
+# Async-save state: two AsyncCheckpointers (model + optim proceed
+# concurrently), one at-most-one pending tracker slot, and an inflight
+# flag so finalize waits for the checkpointers even if a dispatch died
+# before the slot was recorded.
+_ASYNC = {"model": None, "optim": None, "slot": None, "inflight": False}
+
+
+def _async_checkpointers():
+    ocp = _orbax()
+    if _ASYNC["model"] is None:
+        _ASYNC["model"] = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        _ASYNC["optim"] = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    return _ASYNC["model"], _ASYNC["optim"]
+
+
+def finalize_async_saves() -> None:
+    """Block until the in-flight async save is durable, THEN write its
+    tracker file — a crash mid-async-save must never leave the tracker
+    pointing at an incomplete checkpoint.  No-op when nothing is
+    pending; the train loop calls this in a finally block so every exit
+    path (incl. exceptions) flushes."""
+    if not (_ASYNC["inflight"] or _ASYNC["slot"]):
+        return
+    for key in ("model", "optim"):
+        if _ASYNC[key] is not None:
+            _ASYNC[key].wait_until_finished()
+    _ASYNC["inflight"] = False
+    if _ASYNC["slot"] is not None:
+        save_dir, iteration, release = _ASYNC["slot"]
+        _ASYNC["slot"] = None
+        if jax.process_index() == 0:
+            with open(get_checkpoint_tracker_filename(save_dir), "w") as f:
+                f.write("release" if release else str(iteration))
+
+
 def save_checkpoint(
     save_dir: str,
     iteration: int,
@@ -88,18 +123,34 @@ def save_checkpoint(
     args: Optional[dict] = None,
     consumed_samples: int = 0,
     release: bool = False,
+    async_save: bool = False,
 ) -> str:
-    """Reference: save_checkpoint (checkpointing.py:243-337)."""
+    """Reference: save_checkpoint (checkpointing.py:243-337).
+
+    ``async_save`` (beyond-reference): the tensorstore writes proceed in
+    the background while training continues; the tracker file is written
+    only at ``finalize_async_saves()`` (called automatically before the
+    next async save, and by the train loop on every exit path).  jax
+    arrays are snapshot at call time, so the training step may donate/
+    overwrite the live buffers immediately."""
     ocp = _orbax()
     ckpt_dir = Path(get_checkpoint_name(save_dir, iteration, release)).absolute()
     ckpt_dir.mkdir(parents=True, exist_ok=True)
 
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(ckpt_dir / "model", params, force=True)
+    if async_save:
+        # at most one outstanding save: the previous one becomes durable
+        # (and gets its tracker) before this one starts; inflight is set
+        # BEFORE dispatch so a failure below still makes finalize wait
+        finalize_async_saves()
+        m_ckptr, o_ckptr = _async_checkpointers()
+        _ASYNC["inflight"] = True
+    else:
+        m_ckptr = o_ckptr = ocp.PyTreeCheckpointer()
+    m_ckptr.save(ckpt_dir / "model", params, force=True)
     if opt_state is not None:
         # drop None subtrees (sgd has no exp_avg_sq etc.)
-        flat = _opt_state_to_tree(opt_state)
-        ckptr.save(ckpt_dir / "optim", flat, force=True)
+        o_ckptr.save(ckpt_dir / "optim", _opt_state_to_tree(opt_state),
+                     force=True)
 
     meta = {
         "checkpoint_version": CHECKPOINT_VERSION,
@@ -111,7 +162,9 @@ def save_checkpoint(
     with open(ckpt_dir / "meta.json", "w") as f:
         json.dump(meta, f, indent=1)
 
-    if jax.process_index() == 0:
+    if async_save:
+        _ASYNC["slot"] = (save_dir, iteration, release)
+    elif jax.process_index() == 0:
         with open(get_checkpoint_tracker_filename(save_dir), "w") as f:
             f.write("release" if release else str(iteration))
     return str(ckpt_dir)

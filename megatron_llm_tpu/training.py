@@ -233,6 +233,7 @@ def pretrain(
     log_num_zeros_in_grad: bool = False,
     writer=None,
     tensorboard_log_interval: int = 1,
+    async_save: bool = False,
     log_memory: bool = False,
     log_batch_size: bool = False,
     log_world_size: bool = False,
@@ -337,136 +338,143 @@ def pretrain(
                 consumed_samples=counters.get("samples", 0),
                 args=checkpointing.config_to_args(
                     getattr(model, "cfg", None)),
+                async_save=async_save,
             )
         timers("save-checkpoint").stop()
 
-    while iteration < train_cfg.train_iters:
-        timers("batch-generator", log_level=1).start()
-        batch = next(batch_iterator)
-        timers("batch-generator").stop()
-        lr, wd = scheduler.step(1)
-        step_key = jax.random.fold_in(base_key, iteration)
-        if (iteration + 1) in skip_iters:
-            # reference training.py:397-399: forward-only, no update
-            print(" IMPORTANT! skipping backprop for this iteration!",
-                  flush=True)
-            if custom_step:
-                # a custom (e.g. pipelined) step has no forward-only
-                # program; skip means "consume data, update nothing"
-                metrics = {"lm loss": jnp.float32(float("nan")),
-                           "skipped_iter": 1}
+    try:
+        while iteration < train_cfg.train_iters:
+            timers("batch-generator", log_level=1).start()
+            batch = next(batch_iterator)
+            timers("batch-generator").stop()
+            lr, wd = scheduler.step(1)
+            step_key = jax.random.fold_in(base_key, iteration)
+            if (iteration + 1) in skip_iters:
+                # reference training.py:397-399: forward-only, no update
+                print(" IMPORTANT! skipping backprop for this iteration!",
+                      flush=True)
+                if custom_step:
+                    # a custom (e.g. pipelined) step has no forward-only
+                    # program; skip means "consume data, update nothing"
+                    metrics = {"lm loss": jnp.float32(float("nan")),
+                               "skipped_iter": 1}
+                else:
+                    if skip_step is None:
+                        # eval_step is the same forward-only program; reuse
+                        # its compilation when available
+                        skip_step = eval_step or build_train_step(
+                            model, optimizer, parallel_cfg, num_micro,
+                            loss_func, forward_only=True)
+                    # fresh metrics: grad_norm/loss_scale/aux losses from the
+                    # previous step must not masquerade as this iteration's
+                    metrics = {"lm loss": skip_step(params, batch, step_key),
+                               "skipped_iter": 1}
             else:
-                if skip_step is None:
-                    # eval_step is the same forward-only program; reuse
-                    # its compilation when available
-                    skip_step = eval_step or build_train_step(
-                        model, optimizer, parallel_cfg, num_micro,
-                        loss_func, forward_only=True)
-                # fresh metrics: grad_norm/loss_scale/aux losses from the
-                # previous step must not masquerade as this iteration's
-                metrics = {"lm loss": skip_step(params, batch, step_key),
-                           "skipped_iter": 1}
-        else:
-            timers("train-step", log_level=1).start()
-            params, opt_state, metrics = train_step(
-                params, opt_state, batch, step_key, lr, wd
-            )
-            timers("train-step").stop()
-        iteration += 1
-        tokens = batch["tokens"].size
-        counters["tokens"] += tokens
+                timers("train-step", log_level=1).start()
+                params, opt_state, metrics = train_step(
+                    params, opt_state, batch, step_key, lr, wd
+                )
+                timers("train-step").stop()
+            iteration += 1
+            tokens = batch["tokens"].size
+            counters["tokens"] += tokens
 
-        if log_interval and iteration % log_interval == 0:
-            if log_params_norm:     # reference --log_params_norm
-                metrics = dict(metrics)
-                metrics["params norm"] = global_grad_norm(params)
-            timers("train-step-sync", log_level=1).start()
-            jax.block_until_ready(metrics["lm loss"])
-            timers("train-step-sync").stop()
-            now = time.perf_counter()
-            elapsed = (now - last_time) / log_interval
-            last_time = now
-            # --tensorboard_log_interval is an absolute iteration
-            # interval (reference semantics); metrics only exist at log
-            # boundaries, so the effective cadence is their intersection
-            use_writer = (writer if writer is not None
-                          and iteration % max(tensorboard_log_interval, 1)
-                          == 0 else None)
-            if use_writer is not None:
-                # reference --log_*_to_tensorboard extras
-                # (training.py:509-589)
-                if log_batch_size:
-                    use_writer.add_scalar("batch-size",
-                                          train_cfg.global_batch_size,
+            if log_interval and iteration % log_interval == 0:
+                if log_params_norm:     # reference --log_params_norm
+                    metrics = dict(metrics)
+                    metrics["params norm"] = global_grad_norm(params)
+                timers("train-step-sync", log_level=1).start()
+                jax.block_until_ready(metrics["lm loss"])
+                timers("train-step-sync").stop()
+                now = time.perf_counter()
+                elapsed = (now - last_time) / log_interval
+                last_time = now
+                # --tensorboard_log_interval is an absolute iteration
+                # interval (reference semantics); metrics only exist at log
+                # boundaries, so the effective cadence is their intersection
+                use_writer = (writer if writer is not None
+                              and iteration % max(tensorboard_log_interval, 1)
+                              == 0 else None)
+                if use_writer is not None:
+                    # reference --log_*_to_tensorboard extras
+                    # (training.py:509-589)
+                    if log_batch_size:
+                        use_writer.add_scalar("batch-size",
+                                              train_cfg.global_batch_size,
+                                              iteration)
+                    if log_world_size:
+                        use_writer.add_scalar("world-size",
+                                              jax.device_count(), iteration)
+                    if log_memory:
+                        stats = jax.local_devices()[0].memory_stats() or {}
+                        use_writer.add_scalar(
+                            "mem-bytes-in-use",
+                            stats.get("bytes_in_use", 0), iteration)
+                training_log(
+                    iteration, train_cfg.train_iters,
+                    {k: float(v) for k, v in metrics.items()},
+                    elapsed, tokens, lr,
+                    writer=use_writer,
+                )
+                if use_writer is not None:
+                    # write() before log(): log() resets the accumulators
+                    timers.write(timers.names(), use_writer, iteration,
+                                 normalizer=log_interval)
+                timers.log(normalizer=log_interval)
+                if use_writer is not None and hasattr(use_writer, "flush"):
+                    use_writer.flush()
+                if on_metrics is not None:
+                    on_metrics(iteration, metrics)
+
+            if eval_step is not None and eval_interval and iteration % eval_interval == 0:
+                timers("eval-time", log_level=0).start()
+                losses = []
+                for _ in range(eval_iters):
+                    eval_batch = next(eval_iterator)
+                    losses.append(float(eval_step(params, eval_batch, None)))
+                timers("eval-time").stop()
+                val = sum(losses) / len(losses)
+                print(f" validation loss at iteration {iteration}: {val:.6E}")
+                if writer is not None:
+                    writer.add_scalar("validation loss", val, iteration)
+                    if log_validation_ppl:   # reference --log_validation_ppl...
+                        import math
+                        writer.add_scalar("validation ppl", math.exp(min(val, 20.0)),
                                           iteration)
-                if log_world_size:
-                    use_writer.add_scalar("world-size",
-                                          jax.device_count(), iteration)
-                if log_memory:
-                    stats = jax.local_devices()[0].memory_stats() or {}
-                    use_writer.add_scalar(
-                        "mem-bytes-in-use",
-                        stats.get("bytes_in_use", 0), iteration)
-            training_log(
-                iteration, train_cfg.train_iters,
-                {k: float(v) for k, v in metrics.items()},
-                elapsed, tokens, lr,
-                writer=use_writer,
-            )
-            if use_writer is not None:
-                # write() before log(): log() resets the accumulators
-                timers.write(timers.names(), use_writer, iteration,
-                             normalizer=log_interval)
-            timers.log(normalizer=log_interval)
-            if use_writer is not None and hasattr(use_writer, "flush"):
-                use_writer.flush()
-            if on_metrics is not None:
-                on_metrics(iteration, metrics)
+                    if hasattr(writer, "flush"):
+                        writer.flush()
 
-        if eval_step is not None and eval_interval and iteration % eval_interval == 0:
-            timers("eval-time", log_level=0).start()
-            losses = []
-            for _ in range(eval_iters):
-                eval_batch = next(eval_iterator)
-                losses.append(float(eval_step(params, eval_batch, None)))
-            timers("eval-time").stop()
-            val = sum(losses) / len(losses)
-            print(f" validation loss at iteration {iteration}: {val:.6E}")
-            if writer is not None:
-                writer.add_scalar("validation loss", val, iteration)
-                if log_validation_ppl:   # reference --log_validation_ppl...
-                    import math
-                    writer.add_scalar("validation ppl", math.exp(min(val, 20.0)),
-                                      iteration)
-                if hasattr(writer, "flush"):
-                    writer.flush()
-
-        saved = False
-        if save_interval and save_dir and iteration % save_interval == 0:
-            _save(iteration)
-            saved = True
-
-        if exit_signal_handler is not None and exit_signal_handler.signals_received():
-            print("exiting on termination signal: saving checkpoint")
-            if save_dir and not saved:
+            saved = False
+            if save_interval and save_dir and iteration % save_interval == 0:
                 _save(iteration)
-            sys.exit(0)
+                saved = True
 
-        # exit based on duration (reference training.py:746-758)
-        if exit_duration_in_mins:
-            train_mins = (time.perf_counter() - train_start) / 60.0
-            if train_mins > exit_duration_in_mins:
+            if exit_signal_handler is not None and exit_signal_handler.signals_received():
+                print("exiting on termination signal: saving checkpoint")
                 if save_dir and not saved:
                     _save(iteration)
-                print(f" exiting program after {train_mins:.1f} minutes",
-                      flush=True)
                 sys.exit(0)
 
-        # exit based on iterations (reference training.py:761-767)
-        if exit_interval and iteration % exit_interval == 0:
-            if save_dir and not saved:
-                _save(iteration)
-            print(f" exiting program at iteration {iteration}", flush=True)
-            sys.exit(0)
+            # exit based on duration (reference training.py:746-758)
+            if exit_duration_in_mins:
+                train_mins = (time.perf_counter() - train_start) / 60.0
+                if train_mins > exit_duration_in_mins:
+                    if save_dir and not saved:
+                        _save(iteration)
+                    print(f" exiting program after {train_mins:.1f} minutes",
+                          flush=True)
+                    sys.exit(0)
 
+            # exit based on iterations (reference training.py:761-767)
+            if exit_interval and iteration % exit_interval == 0:
+                if save_dir and not saved:
+                    _save(iteration)
+                print(f" exiting program at iteration {iteration}", flush=True)
+                sys.exit(0)
+
+    finally:
+        # every exit path — normal completion, sys.exit (raises
+        # SystemExit), or an exception — flushes in-flight async
+        # saves so a durable checkpoint always gets its tracker
+        checkpointing.finalize_async_saves()
     return params, opt_state, iteration

@@ -46,3 +46,39 @@ def test_restore_on_different_mesh(utils, tmp_path):
         assert got.sharding.is_equivalent_to(want_sharding, got.ndim), (
             f"restored {got.sharding} != requested {want_sharding}")
         np.testing.assert_array_equal(np.asarray(got), want_val)
+
+
+def test_async_save_tracker_deferred_until_finalize(tmp_path):
+    """async_save: tensorstore writes go to the background; the tracker
+    file appears ONLY at finalize (crash mid-save can never point the
+    tracker at an incomplete checkpoint), and the loaded tree matches."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from megatron_llm_tpu import checkpointing as ck
+
+    params = {"w": jnp.arange(8.0), "b": jnp.ones((3, 4))}
+    d = str(tmp_path / "async_ck")
+    ck.save_checkpoint(d, 5, params, async_save=True)
+    tracker = ck.get_checkpoint_tracker_filename(d)
+    import os
+
+    assert not os.path.exists(tracker), \
+        "tracker must not exist before finalize"
+    ck.finalize_async_saves()
+    assert os.path.exists(tracker)
+    loaded, _, meta = ck.load_checkpoint(d)
+    np.testing.assert_array_equal(np.asarray(loaded["w"]),
+                                  np.asarray(params["w"]))
+    assert int(meta["iteration"]) == 5
+
+    # a second async save finalizes the first automatically
+    params2 = {"w": jnp.arange(8.0) * 2, "b": jnp.zeros((3, 4))}
+    ck.save_checkpoint(d, 6, params2, async_save=True)
+    ck.save_checkpoint(d, 7, params2, async_save=True)
+    with open(tracker) as f:
+        assert f.read().strip() == "6"   # first save finalized by second
+    ck.finalize_async_saves()
+    with open(tracker) as f:
+        assert f.read().strip() == "7"
