@@ -60,15 +60,25 @@ def generate(
     stop_on_eol: bool = False,
     stop_on_double_eol: bool = False,
     prevent_newline_after_colon: bool = False,
+    rolling_cache: Optional[bool] = None,
 ):
     """Returns (texts, token_lists, log_probs or None).
 
     ``batch_times_seqlen_threshold``: micro-batch the prefill forward
     above this batch*seqlen (reference
-    ``--inference_batch_times_seqlen_threshold``, default 512)."""
+    ``--inference_batch_times_seqlen_threshold``, default 512).
+
+    ``rolling_cache``: None (default) auto-enables the O(window) ring
+    KV cache exactly when it saves memory — a sliding-window model
+    decoding past its window; logits are identical either way
+    (tests/test_rolling_kv_cache.py)."""
     pad = getattr(tokenizer, "pad", 0) or 0
     eod = getattr(tokenizer, "eod", None)
     toks, lens = _tokenize_prompts(tokenizer, prompts, pad, add_bos)
+    if rolling_cache is None:
+        window = model.cfg.sliding_window_size
+        rolling_cache = (window is not None
+                         and toks.shape[1] + tokens_to_generate > window)
 
     def one_tok(text):
         ids = tokenizer.tokenize(text)
@@ -99,7 +109,7 @@ def generate(
         batch_times_seqlen_threshold=batch_times_seqlen_threshold,
         top_p_decay=top_p_decay, top_p_bound=top_p_bound,
         extra_stop_ids=tuple(extra_stop), stop_pairs=tuple(stop_pairs),
-        ban_pairs=tuple(ban_pairs),
+        ban_pairs=tuple(ban_pairs), rolling_cache=bool(rolling_cache),
     )
     out_tokens = np.asarray(out_tokens)
     stop_set = set(extra_stop)

@@ -129,3 +129,47 @@ def test_generate_tokens_rolling_matches_linear():
         rolling_cache=True)
     assert int(n_got) == int(n_want)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_api_generate_auto_enables_rolling(monkeypatch):
+    """api.generate auto-enables the ring cache exactly when a
+    sliding-window model decodes past its window, and output text is
+    unchanged."""
+    import megatron_llm_tpu.text_generation.generation as G
+    from megatron_llm_tpu.text_generation.api import generate
+
+    model, params = _model()
+
+    class Tok:
+        vocab_size = 64
+        eod = 63
+        pad = 0
+
+        def tokenize(self, text):
+            return [int(t) % 64 for t in text.split()]
+
+        def detokenize(self, ids):
+            return " ".join(str(i) for i in ids)
+
+    seen = {}
+    real = G.generate_tokens
+
+    def spy(*a, **kw):
+        seen["rolling"] = kw.get("rolling_cache")
+        return real(*a, **kw)
+
+    import megatron_llm_tpu.text_generation.api as api_mod
+
+    monkeypatch.setattr(api_mod, "generate_tokens", spy)
+
+    # 4-token prompt + 16 new > window 8 -> rolling auto-on
+    texts_r, _, _ = generate(model, params, Tok(), ["1 2 3 4"], 16,
+                             greedy=True)
+    assert seen["rolling"] is True
+    # 2 new tokens stays within the window -> off
+    generate(model, params, Tok(), ["1 2 3 4"], 2, greedy=True)
+    assert seen["rolling"] is False
+    # and the auto-on output equals the explicit full-cache decode
+    texts_f, _, _ = generate(model, params, Tok(), ["1 2 3 4"], 16,
+                             greedy=True, rolling_cache=False)
+    assert texts_r == texts_f
