@@ -1,0 +1,83 @@
+"""Worker for tests/test_multihost_cpu.py — runs as one of two REAL
+processes (jax.distributed over localhost gloo, one CPU device each).
+Not collected by pytest (underscore prefix)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    # the documented launch contract: torchrun-style env vars
+    # (docs/guide/faq.md "Multi-host launch?")
+    import jax
+
+    from megatron_llm_tpu import topology
+
+    topology.initialize_distributed()
+    rank = jax.process_index()
+    assert jax.process_count() == 2
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from megatron_llm_tpu import random as mrandom
+    from megatron_llm_tpu.config import ParallelConfig, TrainConfig
+    from megatron_llm_tpu.data.data_samplers import place_host_batch
+    from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+    from megatron_llm_tpu.optimizer import MegatronOptimizer
+    from megatron_llm_tpu.parallel import sharding as sh
+    from megatron_llm_tpu.training import build_train_step
+
+    mesh = topology.initialize_model_parallel()   # dp = 2 (1 dev/process)
+    assert topology.get_data_parallel_world_size() == 2
+
+    cfg = llama_config("tiny", num_layers=2, seq_length=32,
+                       max_position_embeddings=32, padded_vocab_size=128)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))     # same seed -> identical
+    params = sh.shard_params(params, model.param_specs(params))
+
+    M, dp = 2, 2
+    tc = TrainConfig(micro_batch_size=1, global_batch_size=M * dp, lr=1e-3)
+    pc = ParallelConfig(data_parallel_size=dp)
+    opt = MegatronOptimizer(tc)
+    opt_state = opt.init(params)
+    step = build_train_step(model, opt, pc, M)
+
+    # every process builds the SAME global batch (the multi-host data
+    # contract); place_host_batch transfers only addressable shards
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 128, (M, dp, 32)).astype(np.int32)
+    dsh = NamedSharding(mesh, P(None, "dp", None))
+    batch = {
+        "tokens": place_host_batch(toks, dsh),
+        "labels": place_host_batch(np.roll(toks, -1, axis=-1), dsh),
+        "loss_mask": place_host_batch(
+            np.ones_like(toks, np.float32), dsh),
+    }
+    _, _, metrics = step(params, opt_state, batch, jax.random.PRNGKey(0),
+                         1e-3, 0.0)
+    loss = float(metrics["lm loss"])
+    assert np.isfinite(loss)
+    print(f"RANK{rank} LOSS {loss:.6f}", flush=True)
+
+    # cross-host checksum guard: identical batches pass...
+    os.environ["MEGATRON_TPU_DATA_CHECKSUM"] = "1"
+    place_host_batch(toks, dsh)
+    print(f"RANK{rank} CHECKSUM_OK", flush=True)
+    # ...and a rank-divergent batch is caught on every process
+    bad = toks + rank
+    try:
+        place_host_batch(bad, dsh)
+        print(f"RANK{rank} DIVERGENCE_MISSED", flush=True)
+        sys.exit(2)
+    except RuntimeError as e:
+        assert "DIVERGE" in str(e)
+        print(f"RANK{rank} DIVERGENCE_CAUGHT", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""REAL multi-host integration: two OS processes with one CPU device
+each, rendezvoused by jax.distributed over localhost (gloo collectives)
+through the torchrun-style env contract — upgrading the multi-host
+evidence from single-process fakes to an actual 2-process run of
+initialize_distributed -> mesh -> place_host_batch -> dp=2 train step
+-> cross-host checksum (incl. a real divergence catch)."""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_multihost_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_dp_train_step():
+    port = _free_port()
+    base = dict(os.environ)
+    base.pop("PALLAS_AXON_POOL_IPS", None)
+    base["JAX_PLATFORMS"] = "cpu"
+    # one device per process: drop the 8-virtual-device conftest flags
+    base["XLA_FLAGS"] = " ".join(
+        f for f in base.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    base.update(MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port),
+                WORLD_SIZE="2")
+
+    procs = []
+    for rank in range(2):
+        env = dict(base, RANK=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"rank {rank} rc={rc}\n{err[-3000:]}"
+        assert f"RANK{rank} CHECKSUM_OK" in out
+        assert f"RANK{rank} DIVERGENCE_CAUGHT" in out
+
+    # data-parallel consistency: both processes computed the same loss
+    losses = [re.search(r"LOSS ([0-9.]+)", out).group(1)
+              for _, out, _ in outs]
+    assert losses[0] == losses[1], losses
