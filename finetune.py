@@ -2,7 +2,7 @@
 """Pretrain / finetune / instruct-tune GPT-family models on TPU.
 
 Reference: ``/root/reference/finetune.py`` — the fork's primary entry
-point: ``--model_name={gpt,llama,llama2,codellama,falcon,mistral}``
+point: ``--model_name={gpt,llama,llama2,codellama,falcon,mistral,mixtral,qwen2}``
 selects architecture defaults, data comes from packed GPT or instruction
 datasets, and the loop runs under 3-way parallelism.
 
@@ -66,6 +66,10 @@ MODEL_DEFAULTS = {
                     use_rms_norm=True, use_bias=False, tie_embed_logits=False,
                     num_experts=8, moe_top_k=2, rope_theta=1e6,
                     hidden_dropout=0.0, attention_dropout=0.0),
+    "qwen2": dict(position_embedding_type="rotary", glu_activation="swiglu",
+                  use_rms_norm=True, use_bias=False, add_qkv_bias=True,
+                  tie_embed_logits=False, rope_theta=1e6,
+                  hidden_dropout=0.0, attention_dropout=0.0),
     "gpt": dict(),
 }
 
@@ -217,6 +221,8 @@ _CKPT_ARG_MAP = {
     "moe_top_k": "moe_top_k",
     "moe_capacity_factor": "moe_capacity_factor",
     "moe_min_capacity": "moe_min_capacity",
+    # qwen2's QKV-only bias changes the param tree like the MoE fields do
+    "add_qkv_bias": "add_qkv_bias",
 }
 
 

@@ -190,6 +190,10 @@ class TransformerConfig:
     # between (round-3 advisor finding); "expert" / "replicated" force it.
     moe_expert_axis: str = "auto"
 
+    # QKV-projection-only bias (Qwen2-style: attention in-projections
+    # carry biases while every other linear is bias-free)
+    add_qkv_bias: bool = False
+
     # --- context parallelism algorithm (TPU-native extension; the
     # reference has neither): "ring" = K/V ppermute around the cp axis
     # (parallel/ring_attention.py, any head count); "ulysses" = all-to-all

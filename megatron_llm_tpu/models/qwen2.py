@@ -1,0 +1,62 @@
+"""Qwen2 family wrapper (beyond-reference model family).
+
+Architecture = llama-style (RoPE, RMSNorm, SwiGLU, GQA, no linear
+biases) with ONE structural novelty: biases on the QKV in-projections
+only (``add_qkv_bias``).  The 0.5B/1.5B sizes tie embeddings with the
+LM head; 7B unties.  HF conversion in
+``weights_conversion/hf_to_megatron.convert_qwen2``.
+"""
+
+from __future__ import annotations
+
+from megatron_llm_tpu.config import TransformerConfig, PositionEmbeddingType
+from megatron_llm_tpu.models.gpt import GPTModel
+
+
+class Qwen2Model(GPTModel):
+    def __init__(self, cfg: TransformerConfig):
+        assert cfg.position_embedding_type == PositionEmbeddingType.rotary, \
+            "qwen2 requires rotary position embeddings"
+        assert cfg.glu_activation == "swiglu", "qwen2 requires swiglu"
+        assert cfg.normalization == "rmsnorm", "qwen2 requires RMSNorm"
+        assert not cfg.add_bias_linear, \
+            "qwen2 has no linear biases outside QKV"
+        assert cfg.add_qkv_bias, "qwen2 requires QKV biases"
+        assert not cfg.parallel_attn, "qwen2 uses sequential attn/mlp"
+        assert not cfg.use_post_ln, "qwen2 is pre-LN"
+        super().__init__(cfg)
+
+
+def qwen2_config(size: str = "7B", **overrides) -> TransformerConfig:
+    """Qwen2 shapes (HF Qwen2 configs; tied embeddings below 7B)."""
+    shapes = {
+        "tiny": dict(num_layers=2, hidden_size=128, num_attention_heads=4,
+                     num_attention_heads_kv=2, ffn_hidden_size=352,
+                     padded_vocab_size=32000, tie_embed_logits=False),
+        "0.5B": dict(num_layers=24, hidden_size=896, num_attention_heads=14,
+                     num_attention_heads_kv=2, ffn_hidden_size=4864,
+                     padded_vocab_size=151936, tie_embed_logits=True),
+        "1.5B": dict(num_layers=28, hidden_size=1536,
+                     num_attention_heads=12, num_attention_heads_kv=2,
+                     ffn_hidden_size=8960, padded_vocab_size=151936,
+                     tie_embed_logits=True),
+        "7B": dict(num_layers=28, hidden_size=3584, num_attention_heads=28,
+                   num_attention_heads_kv=4, ffn_hidden_size=18944,
+                   padded_vocab_size=152064, tie_embed_logits=False),
+    }
+    base = dict(
+        position_embedding_type=PositionEmbeddingType.rotary,
+        normalization="rmsnorm",
+        glu_activation="swiglu",
+        add_bias_linear=False,
+        add_qkv_bias=True,
+        rope_theta=1e6,
+        layernorm_epsilon=1e-6,
+        seq_length=4096,
+        max_position_embeddings=32768,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+    )
+    base.update(shapes[size])
+    base.update(overrides)
+    return TransformerConfig(**base)

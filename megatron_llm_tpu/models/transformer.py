@@ -71,10 +71,13 @@ def init_attention_params(key, cfg: TransformerConfig, dtype):
     )
     return {
         # packed grouped-QKV column-parallel projection
-        # (reference: transformer.py:334-365)
+        # (reference: transformer.py:334-365); add_qkv_bias gives the
+        # in-projection a bias even in an otherwise bias-free model
+        # (Qwen2)
         "query_key_value": init_linear_params(
             k1, cfg.hidden_size, _qkv_out_dim(cfg),
-            bias=cfg.add_bias_linear, init_method=init, dtype=dtype,
+            bias=cfg.add_bias_linear or cfg.add_qkv_bias,
+            init_method=init, dtype=dtype,
         ),
         # row-parallel output projection (reference: transformer.py:372-380)
         "dense": init_linear_params(

@@ -185,3 +185,20 @@ def test_checkpoint_util_format_bridge(tmp_path):
     got, _, meta = load_reference_checkpoint(str(meg2))
     _leaves_equal(got, params)
     assert int(meta["iteration"]) == 7
+
+
+def test_qkv_bias_export_import_round_trip(tmp_path):
+    """qwen2-style QKV biases survive the reference-layout export/import
+    (TP-sharded both ways)."""
+    from megatron_llm_tpu.models.qwen2 import Qwen2Model, qwen2_config
+
+    cfg = qwen2_config("tiny", num_layers=2, hidden_size=64,
+                       num_attention_heads=4, num_attention_heads_kv=4,
+                       ffn_hidden_size=96, padded_vocab_size=128,
+                       seq_length=32, max_position_embeddings=32)
+    model = Qwen2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    d = tmp_path / "meg"
+    save_reference_checkpoint(str(d), 3, params, cfg, tensor_parallel=2)
+    got, conf, meta = load_reference_checkpoint(str(d))
+    _leaves_equal(got, params)

@@ -256,3 +256,130 @@ def test_hf_mixtral_logit_parity_and_roundtrip():
     with torch.no_grad():
         rt_logits = hf2(torch.tensor(toks)).logits.numpy()
     np.testing.assert_allclose(rt_logits, hf_logits, atol=1e-5)
+
+
+def test_hf_qwen2_logit_parity():
+    """Qwen2 golden test: QKV-bias packing (incl. the rotary bias
+    permutation) reproduces HF logits exactly."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    from megatron_llm_tpu.models.qwen2 import Qwen2Model
+    from weights_conversion.hf_to_megatron import convert_qwen2
+
+    torch.manual_seed(0)
+    hf_cfg = Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        tie_word_embeddings=False, rope_theta=1e6,
+    )
+    hf = Qwen2ForCausalLM(hf_cfg).eval()
+    params, config = convert_qwen2(hf)
+    assert config["add_qkv_bias"] is True
+    assert config["sliding_window_size"] is None
+    cfg = TransformerConfig(**config, use_flash_attn=False)
+    model = Qwen2Model(cfg)
+    # the packed QKV carries a bias, nothing else does
+    layers = params["transformer"]["layers"]
+    assert "bias" in layers["attention"]["query_key_value"]
+    assert "bias" not in layers["attention"]["dense"]
+    assert "bias" not in layers["mlp"]["dense_h_to_4h"]
+
+    toks = np.random.RandomState(0).randint(0, 128, (2, 16))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(toks)).logits.numpy()
+    my_logits = np.asarray(model(params, jnp.asarray(toks), train=False))
+    assert np.abs(hf_logits - my_logits).max() < 1e-5
+
+
+def test_qwen2_fresh_init_matches_converted_structure():
+    """A fresh qwen2_config init has the same pytree structure as the
+    HF conversion (so checkpoints/optimizers line up)."""
+    import jax
+
+    from megatron_llm_tpu.models.qwen2 import Qwen2Model, qwen2_config
+
+    cfg = qwen2_config("tiny", seq_length=32, max_position_embeddings=32)
+    model = Qwen2Model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    qkv = params["transformer"]["layers"]["attention"]["query_key_value"]
+    assert "bias" in qkv and qkv["bias"].shape[-1] == qkv["kernel"].shape[-1]
+    assert "bias" not in params["transformer"]["layers"]["mlp"]["dense_h_to_4h"]
+
+
+def test_hf_qwen2_tied_embeddings_conversion():
+    """Tied Qwen2 (0.5B-style) converts WITHOUT an lm_head leaf, matching
+    the tied fresh-init structure, and still reproduces HF logits."""
+    import jax
+
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    from megatron_llm_tpu.models.qwen2 import Qwen2Model, qwen2_config
+    from weights_conversion.hf_to_megatron import convert_qwen2
+
+    torch.manual_seed(1)
+    hf_cfg = Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, rope_theta=1e6,
+    )
+    hf = Qwen2ForCausalLM(hf_cfg).eval()
+    params, config = convert_qwen2(hf)
+    assert "lm_head" not in params
+    assert config["tie_embed_logits"] is True
+    cfg = TransformerConfig(**config, use_flash_attn=False)
+    model = Qwen2Model(cfg)
+    # structure identical to a tied fresh init
+    fresh = Qwen2Model(qwen2_config(
+        "tiny", num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_attention_heads_kv=2, ffn_hidden_size=176,
+        padded_vocab_size=128, seq_length=64, max_position_embeddings=64,
+        tie_embed_logits=True)).init(jax.random.PRNGKey(0))
+    import jax.tree_util as jtu
+
+    assert (jtu.tree_structure(params) == jtu.tree_structure(fresh))
+
+    toks = np.random.RandomState(0).randint(0, 128, (2, 16))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(toks)).logits.numpy()
+    my_logits = np.asarray(model(params, jnp.asarray(toks), train=False))
+    assert np.abs(hf_logits - my_logits).max() < 1e-5
+
+
+def test_qwen2_hf_export_round_trip(tmp_path):
+    """ours -> HF state dict (with QKV biases) -> back through
+    convert_qwen2: logits identical."""
+    import jax
+
+    from transformers import Qwen2ForCausalLM
+
+    from megatron_llm_tpu.models.qwen2 import Qwen2Model, qwen2_config
+    from weights_conversion.hf_to_megatron import convert_qwen2
+    from weights_conversion.megatron_to_hf import (
+        hf_config_for,
+        llama_family_state_dict,
+    )
+    from megatron_llm_tpu.checkpointing import config_to_args
+
+    cfg = qwen2_config("tiny", num_layers=2, hidden_size=64,
+                       num_attention_heads=4, num_attention_heads_kv=2,
+                       ffn_hidden_size=176, padded_vocab_size=128,
+                       seq_length=64, max_position_embeddings=64,
+                       use_flash_attn=False)
+    model = Qwen2Model(cfg)
+    params = model.init(jax.random.PRNGKey(3))
+    conf = config_to_args(cfg)
+
+    hf_cfg = hf_config_for("qwen2", conf)
+    hf = Qwen2ForCausalLM(hf_cfg).eval()
+    sd = llama_family_state_dict(params, conf)
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not [m for m in missing if "rotary" not in m], missing
+    assert not unexpected, unexpected
+
+    back, _ = convert_qwen2(hf)
+    toks = np.random.RandomState(0).randint(0, 128, (1, 16))
+    a = np.asarray(model(params, jnp.asarray(toks), train=False))
+    b = np.asarray(model(back, jnp.asarray(toks), train=False))
+    assert np.abs(a - b).max() < 1e-5

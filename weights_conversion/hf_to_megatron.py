@@ -27,7 +27,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from weights_conversion.util import (
     pack_glu_ffn,
     pack_qkv,
+    pack_qkv_bias,
     rotary_hf_to_interleaved,
+    rotary_hf_to_interleaved_bias,
 )
 
 
@@ -54,12 +56,14 @@ def _dense_glu_mlp(sd, p):
     }
 
 
-def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None):
+def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None,
+                         qkv_bias=False):
     """LlamaForCausalLM / MistralForCausalLM -> param pytree + config dict.
 
     reference: hf_to_megatron.py:117-258 (llama), :185-258 (mistral).
     ``layer_mlp(sd, prefix)``: per-layer mlp-subtree converter hook —
     defaults to the dense GLU mlp; convert_mixtral swaps in the MoE one.
+    ``qkv_bias``: pack the per-projection biases too (Qwen2).
     """
     hf_cfg = hf_model.config
     nh = hf_cfg.num_attention_heads
@@ -74,12 +78,20 @@ def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None):
         q = rotary_hf_to_interleaved(_np(sd[p + "self_attn.q_proj.weight"]), d)
         k = rotary_hf_to_interleaved(_np(sd[p + "self_attn.k_proj.weight"]), d)
         v = _np(sd[p + "self_attn.v_proj.weight"])
+        qkv = {"kernel": pack_qkv(q, k, v, nh, ng, d)}
+        if qkv_bias:
+            qb = rotary_hf_to_interleaved_bias(
+                _np(sd[p + "self_attn.q_proj.bias"]), d)
+            kb = rotary_hf_to_interleaved_bias(
+                _np(sd[p + "self_attn.k_proj.bias"]), d)
+            vb = _np(sd[p + "self_attn.v_proj.bias"])
+            qkv["bias"] = pack_qkv_bias(qb, kb, vb, nh, ng, d)
         layers.append({
             "input_norm": {
                 "scale": _np(sd[p + "input_layernorm.weight"])
             },
             "attention": {
-                "query_key_value": {"kernel": pack_qkv(q, k, v, nh, ng, d)},
+                "query_key_value": qkv,
                 "dense": {
                     "kernel": np.ascontiguousarray(
                         _np(sd[p + "self_attn.o_proj.weight"]).T)
@@ -105,6 +117,7 @@ def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None):
         return jnp.asarray(np.stack([get(l, path) for l in layers]), dtype)
 
     layer_tree = stack_tree(layers[0])
+    tied = bool(getattr(hf_cfg, "tie_word_embeddings", False))
     params = {
         "embedding": {
             "word": {"embedding": jnp.asarray(
@@ -115,9 +128,13 @@ def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None):
             "final_norm": {"scale": jnp.asarray(
                 _np(sd["model.norm.weight"]), dtype)},
         },
-        "lm_head": {"weight": jnp.asarray(
-            _np(sd["lm_head.weight"]), dtype)},
     }
+    if not tied:
+        # tied HF models (Qwen2-0.5B/1.5B, small llamas) share the head
+        # with the embedding — the pytree must match the tied fresh-init
+        # structure (no lm_head leaf) or checkpoints won't line up
+        params["lm_head"] = {"weight": jnp.asarray(
+            _np(sd["lm_head.weight"]), dtype)}
     config = {
         "num_layers": hf_cfg.num_hidden_layers,
         "hidden_size": hf_cfg.hidden_size,
@@ -132,13 +149,32 @@ def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None):
         "glu_activation": "swiglu",
         "normalization": "rmsnorm",
         "add_bias_linear": False,
-        "tie_embed_logits": False,
+        "tie_embed_logits": tied,
         "layernorm_epsilon": hf_cfg.rms_norm_eps,
         "rope_theta": getattr(hf_cfg, "rope_theta", 10000.0),
         "sliding_window_size": getattr(hf_cfg, "sliding_window", None),
+        "add_qkv_bias": qkv_bias,
         "hidden_dropout": 0.0,
         "attention_dropout": 0.0,
     }
+    return params, config
+
+
+def convert_qwen2(hf_model, dtype=np.float32):
+    """Qwen2ForCausalLM -> param pytree + config dict: the llama-family
+    path with QKV biases packed (weights_conversion/util.pack_qkv_bias).
+    Qwen2Config carries a sliding_window value even when
+    use_sliding_window is False (the default) — honor the switch."""
+    hf_cfg = hf_model.config
+    if getattr(hf_cfg, "use_sliding_window", False) and \
+            getattr(hf_cfg, "max_window_layers", 0) < hf_cfg.num_hidden_layers:
+        raise NotImplementedError(
+            "Qwen2 per-layer sliding windows (max_window_layers < "
+            "num_hidden_layers) are not supported — a global window would "
+            "silently change the lower layers' attention")
+    params, config = convert_llama_family(hf_model, dtype, qkv_bias=True)
+    if not getattr(hf_cfg, "use_sliding_window", False):
+        config["sliding_window_size"] = None
     return params, config
 
 
@@ -317,6 +353,7 @@ CONVERTERS = {
     "codellama": convert_llama_family,
     "mistral": convert_llama_family,
     "mixtral": convert_mixtral,
+    "qwen2": convert_qwen2,
     "falcon": convert_falcon,
 }
 

@@ -139,9 +139,12 @@ def _merge_tp(tensors: List[np.ndarray], kind: str) -> np.ndarray:
 
 
 _LAYER_KEYS = {
-    # megatron encoder key suffix -> (our path, tp kind)
+    # megatron encoder key suffix -> (our path, tp kind); the qkv bias key
+    # is optional (qwen2-style models only)
     "attention.query_key_value.weight": (
         ("attention", "query_key_value", "kernel"), "column"),
+    "attention.query_key_value.bias": (
+        ("attention", "query_key_value", "bias"), "column"),
     "attention.dense.weight": (("attention", "dense", "kernel"), "row"),
     "mlp.dense_h_to_4h.weight": (
         ("mlp", "dense_h_to_4h", "kernel"), "glu"),
@@ -225,16 +228,23 @@ def load_reference_checkpoint(load_dir: str,
                     continue
                 _, kind = _LAYER_KEYS[suffix]
                 shards = [_np32(e[f"layers.{li}.{suffix}"]) for e in encs]
-                if suffix == "attention.query_key_value.weight" and nh:
+                if suffix in ("attention.query_key_value.weight",
+                              "attention.query_key_value.bias") and nh:
                     # the v<2.0 reordering is per-rank (each shard holds
-                    # nh/tp heads in the old layout), so fix before merging
+                    # nh/tp heads in the old layout), so fix before
+                    # merging; applies to weight AND bias (the reference
+                    # fixes both, checkpointing.py:388-391)
                     nh_local = nh // len(shards)
                     # GQA (ng != nh) skips the fixup entirely; signal that
                     # by passing unequal local head counts
                     ng_local = nh_local if ng == nh else 0
+                    if shards[0].ndim > 1:       # weight [3*nh_l*d, hid]
+                        d_fix = (hidden or shards[0].shape[1]) // nh
+                    else:                        # bias [3*nh_l*d]
+                        d_fix = shards[0].shape[0] // (3 * nh_local)
                     shards = [fix_qkv_ordering(
-                        s, version, nh_local, ng_local,
-                        (hidden or s.shape[1]) // nh) for s in shards]
+                        s, version, nh_local, ng_local, d_fix)
+                        for s in shards]
                 merged[f"layers.{layer_offset + li}.{suffix}"] = _merge_tp(
                     shards, kind)
         if stage == pp_stages[0] and "embedding" in lms[0]:
@@ -273,8 +283,15 @@ def load_reference_checkpoint(load_dir: str,
                 "input_norm": {
                     "scale": stack("input_layernorm.weight", lambda w: w)},
                 "attention": {
-                    "query_key_value": {"kernel": stack(
-                        "attention.query_key_value.weight", to_kernel)},
+                    "query_key_value": {
+                        "kernel": stack(
+                            "attention.query_key_value.weight", to_kernel),
+                        # optional (qwen2-style models)
+                        **({"bias": stack(
+                            "attention.query_key_value.bias", lambda w: w)}
+                           if "layers.0.attention.query_key_value.bias"
+                           in merged else {}),
+                    },
                     "dense": {"kernel": stack(
                         "attention.dense.weight", to_kernel)},
                 },
@@ -302,6 +319,8 @@ def load_reference_checkpoint(load_dir: str,
         "padded_vocab_size": merged["word_embeddings"].shape[0],
         "ffn_hidden_size": ffn,
         "tie_embed_logits": "lm_head" not in merged,
+        "add_qkv_bias":
+            "layers.0.attention.query_key_value.bias" in merged,
     }
     for field, attr in [
         ("num_attention_heads", "num_attention_heads"),
@@ -381,6 +400,11 @@ def save_reference_checkpoint(save_dir: str, iteration, params, cfg,
             "attention.query_key_value.weight": _split_tp(
                 kernel_to_w(layers["attention"]["query_key_value"]["kernel"][li]),
                 tp, "column"),
+            **({"attention.query_key_value.bias": _split_tp(
+                np.asarray(
+                    layers["attention"]["query_key_value"]["bias"][li],
+                    np.float32), tp, "column")}
+               if "bias" in layers["attention"]["query_key_value"] else {}),
             "attention.dense.weight": _split_tp(
                 kernel_to_w(layers["attention"]["dense"]["kernel"][li]),
                 tp, "row"),

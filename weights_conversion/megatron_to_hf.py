@@ -22,8 +22,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from weights_conversion.util import (
     rotary_interleaved_to_hf,
+    rotary_interleaved_to_hf_bias,
     unpack_glu_ffn,
     unpack_qkv,
+    unpack_qkv_bias,
 )
 
 
@@ -53,9 +55,14 @@ def llama_family_state_dict(params, config, *, mlp_writer=None):
         "model.embed_tokens.weight": t(
             params["embedding"]["word"]["embedding"]),
         "model.norm.weight": t(params["transformer"]["final_norm"]["scale"]),
-        "lm_head.weight": t(params["lm_head"]["weight"]),
     }
+    if "lm_head" in params:
+        sd["lm_head.weight"] = t(params["lm_head"]["weight"])
+    else:
+        # tied head (Qwen2-0.5B/1.5B): HF re-ties from the embedding
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
     layers = params["transformer"]["layers"]
+    has_qkv_bias = "bias" in layers["attention"]["query_key_value"]
     for i in range(L):
         g = lambda *path: np.asarray(_index(layers, path, i), np.float32)
         p = f"model.layers.{i}."
@@ -64,6 +71,14 @@ def llama_family_state_dict(params, config, *, mlp_writer=None):
         sd[p + "self_attn.q_proj.weight"] = t(rotary_interleaved_to_hf(q, d))
         sd[p + "self_attn.k_proj.weight"] = t(rotary_interleaved_to_hf(k, d))
         sd[p + "self_attn.v_proj.weight"] = t(v)
+        if has_qkv_bias:
+            qb, kb, vb = unpack_qkv_bias(
+                g("attention", "query_key_value", "bias"), nh, ng, d)
+            sd[p + "self_attn.q_proj.bias"] = t(
+                rotary_interleaved_to_hf_bias(qb, d))
+            sd[p + "self_attn.k_proj.bias"] = t(
+                rotary_interleaved_to_hf_bias(kb, d))
+            sd[p + "self_attn.v_proj.bias"] = t(vb)
         sd[p + "self_attn.o_proj.weight"] = t(
             np.ascontiguousarray(g("attention", "dense", "kernel").T))
         mlp_writer(sd, p, g, t)
@@ -223,6 +238,23 @@ def hf_config_for(model_name: str, config: dict):
             bias=bool(config.get("add_bias_linear", False)),
             layer_norm_epsilon=config.get("layernorm_epsilon", 1e-5),
             tie_word_embeddings=True,
+        )
+    if model_name == "qwen2":
+        from transformers import Qwen2Config
+
+        return Qwen2Config(
+            vocab_size=config["padded_vocab_size"],
+            hidden_size=config["hidden_size"],
+            intermediate_size=config["ffn_hidden_size"],
+            num_hidden_layers=config["num_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            num_key_value_heads=config.get("num_attention_heads_kv"),
+            max_position_embeddings=config["max_position_embeddings"],
+            rms_norm_eps=config.get("layernorm_epsilon", 1e-6),
+            rope_theta=config.get("rope_theta", 1e6),
+            use_sliding_window=config.get("sliding_window_size") is not None,
+            sliding_window=config.get("sliding_window_size"),
+            tie_word_embeddings=bool(config.get("tie_embed_logits", False)),
         )
     raise NotImplementedError(f"HF export for {model_name!r}")
 

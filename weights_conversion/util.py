@@ -84,3 +84,41 @@ def unpack_glu_ffn(kernel: np.ndarray):
     w = np.ascontiguousarray(kernel.T)
     ffn = w.shape[0] // 2
     return w[:ffn], w[ffn:]
+
+
+def rotary_hf_to_interleaved_bias(b: np.ndarray, head_dim: int) -> np.ndarray:
+    """Bias analogue of ``rotary_hf_to_interleaved`` ([out_dim] vector)."""
+    return rotary_hf_to_interleaved(b[:, None], head_dim)[:, 0]
+
+
+def pack_qkv_bias(
+    qb: np.ndarray, kb: np.ndarray, vb: np.ndarray,
+    num_heads: int, num_kv_heads: int, head_dim: int,
+) -> np.ndarray:
+    """Bias analogue of ``pack_qkv``: [*] vectors -> packed
+    [ng*(qpg+2)*d] matching the grouped kernel column order."""
+    ng, qpg = num_kv_heads, num_heads // num_kv_heads
+    d = head_dim
+    qg = qb.reshape(ng, qpg, d)
+    kg = kb.reshape(ng, 1, d)
+    vg = vb.reshape(ng, 1, d)
+    return np.ascontiguousarray(
+        np.concatenate([qg, kg, vg], axis=1).reshape(ng * (qpg + 2) * d))
+
+
+def rotary_interleaved_to_hf_bias(b: np.ndarray, head_dim: int) -> np.ndarray:
+    """Inverse of ``rotary_hf_to_interleaved_bias``."""
+    return rotary_interleaved_to_hf(b[:, None], head_dim)[:, 0]
+
+
+def unpack_qkv_bias(
+    packed: np.ndarray, num_heads: int, num_kv_heads: int, head_dim: int,
+):
+    """Inverse of ``pack_qkv_bias``: [ng*(qpg+2)*d] -> (qb, kb, vb)."""
+    ng, qpg = num_kv_heads, num_heads // num_kv_heads
+    d = head_dim
+    w = packed.reshape(ng, qpg + 2, d)
+    qb = w[:, :qpg].reshape(ng * qpg * d)
+    kb = w[:, qpg].reshape(ng * d)
+    vb = w[:, qpg + 1].reshape(ng * d)
+    return qb, kb, vb
