@@ -70,6 +70,9 @@ MODEL_DEFAULTS = {
                   use_rms_norm=True, use_bias=False, add_qkv_bias=True,
                   tie_embed_logits=False, rope_theta=1e6,
                   hidden_dropout=0.0, attention_dropout=0.0),
+    "gemma": dict(position_embedding_type="rotary", glu_activation="geglu",
+                  use_rms_norm=True, use_bias=False, layernorm_epsilon=1e-6,
+                  hidden_dropout=0.0, attention_dropout=0.0),
     "gpt": dict(),
 }
 
@@ -83,6 +86,13 @@ def extra_args(parser):
 
 
 def model_provider(args):
+    if args.model_name == "gemma" and \
+            getattr(args, "embedding_multiplier", None) is None:
+        # gemma's sqrt(hidden) embedding normalizer depends on the
+        # parsed hidden size, so the static preset table can't carry it
+        import math
+
+        args.embedding_multiplier = math.sqrt(args.hidden_size)
     cfg = transformer_config_from_args(args, args.model_name)
     return MODEL_REGISTRY[args.model_name](cfg)
 
@@ -223,6 +233,8 @@ _CKPT_ARG_MAP = {
     "moe_min_capacity": "moe_min_capacity",
     # qwen2's QKV-only bias changes the param tree like the MoE fields do
     "add_qkv_bias": "add_qkv_bias",
+    # gemma's embedding normalizer changes forward math, not the tree
+    "embedding_multiplier": "embedding_multiplier",
 }
 
 

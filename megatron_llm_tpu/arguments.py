@@ -112,6 +112,8 @@ def _add_network_size_args(parser):
     g.add_argument("--sliding_window_size", type=int, default=None)
     g.add_argument("--add_qkv_bias", action="store_true",
                    help="bias on the QKV projection only (Qwen2-style)")
+    g.add_argument("--embedding_multiplier", type=float, default=None,
+                   help="scale embedding output (Gemma: sqrt(hidden))")
     g.add_argument("--no_tie_embed_logits", action="store_false",
                    dest="tie_embed_logits")
     g.add_argument("--onnx_safe", action="store_true")  # compat
@@ -536,6 +538,7 @@ def transformer_config_from_args(args, model_name: Optional[str] = None
         moe_z_loss_coeff=args.moe_z_loss_coeff,
         context_parallel_algo=args.context_parallel_algo,
         add_qkv_bias=getattr(args, "add_qkv_bias", False),
+        embedding_multiplier=getattr(args, "embedding_multiplier", None),
     )
 
 

@@ -193,6 +193,9 @@ class TransformerConfig:
     # QKV-projection-only bias (Qwen2-style: attention in-projections
     # carry biases while every other linear is bias-free)
     add_qkv_bias: bool = False
+    # scale the word-embedding output by this factor (Gemma multiplies by
+    # sqrt(hidden_size); the tied LM head uses the UNSCALED table)
+    embedding_multiplier: Optional[float] = None
 
     # --- context parallelism algorithm (TPU-native extension; the
     # reference has neither): "ring" = K/V ppermute around the cp axis

@@ -1,0 +1,67 @@
+"""Gemma (v1) family wrapper (beyond-reference model family).
+
+Llama-like decoder with three quirks, all expressible in the existing
+config space plus one knob:
+
+* RMSNorm computes ``x_hat * (1 + w)`` — folded into CONVERSION (the
+  stored scale is ``1 + hf_weight``, identical math, no runtime flag;
+  a fresh init's ones-scale equals gemma's zeros-offset convention).
+* The word-embedding output is scaled by ``sqrt(hidden_size)`` while the
+  tied LM head reads the raw table — ``embedding_multiplier``.
+* ``head_dim`` is decoupled from ``hidden/heads`` (7B: 256 vs 192) —
+  already covered by ``kv_channels``; GeGLU uses the tanh-approximate
+  gelu (``ops/activations.geglu``), matching HF ``gelu_pytorch_tanh``.
+"""
+
+from __future__ import annotations
+
+import math
+
+from megatron_llm_tpu.config import TransformerConfig, PositionEmbeddingType
+from megatron_llm_tpu.models.gpt import GPTModel
+
+
+class GemmaModel(GPTModel):
+    def __init__(self, cfg: TransformerConfig):
+        assert cfg.position_embedding_type == PositionEmbeddingType.rotary, \
+            "gemma requires rotary position embeddings"
+        assert cfg.glu_activation == "geglu", "gemma requires GeGLU"
+        assert cfg.normalization == "rmsnorm", "gemma requires RMSNorm"
+        assert not cfg.add_bias_linear, "gemma has no linear biases"
+        assert cfg.tie_embed_logits, "gemma ties embeddings with the head"
+        assert cfg.embedding_multiplier is not None, \
+            "gemma scales embeddings by sqrt(hidden_size)"
+        super().__init__(cfg)
+
+
+def gemma_config(size: str = "2B", **overrides) -> TransformerConfig:
+    """Gemma-1 shapes (HF GemmaConfig; both sizes tie the head)."""
+    shapes = {
+        "tiny": dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+                     num_attention_heads_kv=1, kv_channels=32,
+                     ffn_hidden_size=176, padded_vocab_size=256),
+        "2B": dict(num_layers=18, hidden_size=2048, num_attention_heads=8,
+                   num_attention_heads_kv=1, kv_channels=256,
+                   ffn_hidden_size=16384, padded_vocab_size=256000),
+        "7B": dict(num_layers=28, hidden_size=3072, num_attention_heads=16,
+                   num_attention_heads_kv=16, kv_channels=256,
+                   ffn_hidden_size=24576, padded_vocab_size=256000),
+    }
+    base = dict(
+        position_embedding_type=PositionEmbeddingType.rotary,
+        normalization="rmsnorm",
+        glu_activation="geglu",
+        add_bias_linear=False,
+        tie_embed_logits=True,
+        rope_theta=10000.0,
+        layernorm_epsilon=1e-6,
+        seq_length=4096,
+        max_position_embeddings=8192,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+    )
+    base.update(shapes[size])
+    base.update(overrides)
+    base.setdefault("embedding_multiplier",
+                    math.sqrt(base["hidden_size"]))
+    return TransformerConfig(**base)

@@ -269,6 +269,10 @@ def embedding_forward(
         h = vocab_parallel_embedding(
             tokens, params["word"], compute_dtype=cfg.compute_jnp_dtype
         )
+    if cfg.embedding_multiplier is not None:
+        # Gemma-style sqrt(hidden) normalizer on the embedding OUTPUT only
+        # (the tied logits head reads the raw table)
+        h = h * jnp.asarray(cfg.embedding_multiplier, h.dtype)
     if "position" in params:
         if position_ids is None:
             position_ids = jnp.arange(tokens.shape[1])[None, :]

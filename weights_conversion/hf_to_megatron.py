@@ -57,18 +57,22 @@ def _dense_glu_mlp(sd, p):
 
 
 def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None,
-                         qkv_bias=False):
+                         qkv_bias=False, norm_add_one=False):
     """LlamaForCausalLM / MistralForCausalLM -> param pytree + config dict.
 
     reference: hf_to_megatron.py:117-258 (llama), :185-258 (mistral).
     ``layer_mlp(sd, prefix)``: per-layer mlp-subtree converter hook —
     defaults to the dense GLU mlp; convert_mixtral swaps in the MoE one.
     ``qkv_bias``: pack the per-projection biases too (Qwen2).
+    ``norm_add_one``: store RMSNorm scales as ``1 + hf_weight`` (Gemma's
+    zero-centered convention folded into the weights — identical math).
     """
     hf_cfg = hf_model.config
     nh = hf_cfg.num_attention_heads
     ng = getattr(hf_cfg, "num_key_value_heads", nh)
-    d = hf_cfg.hidden_size // nh
+    # gemma decouples head_dim from hidden/heads
+    d = getattr(hf_cfg, "head_dim", None) or hf_cfg.hidden_size // nh
+    norm = (lambda w: w + 1.0) if norm_add_one else (lambda w: w)
     sd = dict(hf_model.state_dict())
     layer_mlp = layer_mlp or _dense_glu_mlp
 
@@ -88,7 +92,7 @@ def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None,
             qkv["bias"] = pack_qkv_bias(qb, kb, vb, nh, ng, d)
         layers.append({
             "input_norm": {
-                "scale": _np(sd[p + "input_layernorm.weight"])
+                "scale": norm(_np(sd[p + "input_layernorm.weight"]))
             },
             "attention": {
                 "query_key_value": qkv,
@@ -98,7 +102,7 @@ def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None,
                 },
             },
             "post_attention_norm": {
-                "scale": _np(sd[p + "post_attention_layernorm.weight"])
+                "scale": norm(_np(sd[p + "post_attention_layernorm.weight"]))
             },
             "mlp": layer_mlp(sd, p),
         })
@@ -126,7 +130,7 @@ def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None,
         "transformer": {
             "layers": layer_tree,
             "final_norm": {"scale": jnp.asarray(
-                _np(sd["model.norm.weight"]), dtype)},
+                norm(_np(sd["model.norm.weight"])), dtype)},
         },
     }
     if not tied:
@@ -140,6 +144,7 @@ def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None,
         "hidden_size": hf_cfg.hidden_size,
         "num_attention_heads": nh,
         "num_attention_heads_kv": ng,
+        "kv_channels": d,
         "ffn_hidden_size": hf_cfg.intermediate_size,
         "padded_vocab_size": hf_cfg.vocab_size,
         "seq_length": getattr(hf_cfg, "max_position_embeddings", 4096),
@@ -157,6 +162,20 @@ def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None,
         "hidden_dropout": 0.0,
         "attention_dropout": 0.0,
     }
+    return params, config
+
+
+def convert_gemma(hf_model, dtype=np.float32):
+    """GemmaForCausalLM -> param pytree + config dict: llama-family path
+    with the ``1 + w`` RMSNorm convention folded into the stored scales,
+    GeGLU activation, decoupled head_dim, tied head, and the
+    sqrt(hidden) embedding multiplier recorded in the config."""
+    import math
+
+    params, config = convert_llama_family(hf_model, dtype,
+                                          norm_add_one=True)
+    config["glu_activation"] = "geglu"
+    config["embedding_multiplier"] = math.sqrt(config["hidden_size"])
     return params, config
 
 
@@ -354,6 +373,7 @@ CONVERTERS = {
     "mistral": convert_llama_family,
     "mixtral": convert_mixtral,
     "qwen2": convert_qwen2,
+    "gemma": convert_gemma,
     "falcon": convert_falcon,
 }
 

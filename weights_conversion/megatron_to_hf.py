@@ -46,7 +46,8 @@ def llama_family_state_dict(params, config, *, mlp_writer=None):
 
     nh = config["num_attention_heads"]
     ng = config.get("num_attention_heads_kv") or nh
-    d = config["hidden_size"] // nh
+    # gemma decouples head_dim from hidden/heads
+    d = config.get("kv_channels") or config["hidden_size"] // nh
     L = config["num_layers"]
     t = lambda a: torch.tensor(np.asarray(a, np.float32))
     mlp_writer = mlp_writer or _dense_glu_mlp_writer
@@ -85,6 +86,17 @@ def llama_family_state_dict(params, config, *, mlp_writer=None):
         sd[p + "input_layernorm.weight"] = t(g("input_norm", "scale"))
         sd[p + "post_attention_layernorm.weight"] = t(
             g("post_attention_norm", "scale"))
+    return sd
+
+
+def gemma_state_dict(params, config):
+    """param pytree -> HF GemmaForCausalLM state dict: the llama-family
+    writer with the stored ``1 + w`` RMSNorm scales converted back to
+    HF's zero-centered weights; the tied head is re-tied by HF."""
+    sd = llama_family_state_dict(params, config)
+    for k in list(sd):
+        if k.endswith("layernorm.weight") or k == "model.norm.weight":
+            sd[k] = sd[k] - 1.0
     return sd
 
 
@@ -239,6 +251,23 @@ def hf_config_for(model_name: str, config: dict):
             layer_norm_epsilon=config.get("layernorm_epsilon", 1e-5),
             tie_word_embeddings=True,
         )
+    if model_name == "gemma":
+        from transformers import GemmaConfig
+
+        return GemmaConfig(
+            vocab_size=config["padded_vocab_size"],
+            hidden_size=config["hidden_size"],
+            intermediate_size=config["ffn_hidden_size"],
+            num_hidden_layers=config["num_layers"],
+            num_attention_heads=config["num_attention_heads"],
+            num_key_value_heads=config.get("num_attention_heads_kv"),
+            head_dim=config.get("kv_channels"),
+            max_position_embeddings=config["max_position_embeddings"],
+            rms_norm_eps=config.get("layernorm_epsilon", 1e-6),
+            rope_theta=config.get("rope_theta", 10000.0),
+            hidden_act="gelu_pytorch_tanh",
+            tie_word_embeddings=True,
+        )
     if model_name == "qwen2":
         from transformers import Qwen2Config
 
@@ -286,7 +315,8 @@ def main():
     hf_cfg = hf_config_for(model_name, config)
     hf = AutoModelForCausalLM.from_config(hf_cfg)
     writer = {"falcon": falcon_state_dict,
-              "mixtral": mixtral_state_dict}.get(
+              "mixtral": mixtral_state_dict,
+              "gemma": gemma_state_dict}.get(
         model_name, llama_family_state_dict)
     sd = writer(params, config)
     missing, unexpected = hf.load_state_dict(sd, strict=False)
