@@ -68,6 +68,17 @@ CONFIGS = {
         hbm_gb=95, tp=8, pp=4, vpp=None, seq=4096, micro_batch=1,
         num_micro=8, zero1=True,
     ),
+    # beyond-reference families at scale: Qwen2-7B and Gemma-7B
+    "qwen2-7b-tp8": dict(
+        family="qwen2", size="7B", topology="v5p:2x2x2", accel="v5p-16",
+        hbm_gb=95, tp=8, pp=1, vpp=None, seq=4096, micro_batch=1,
+        num_micro=1, zero1=False,
+    ),
+    "gemma-7b-tp8": dict(
+        family="gemma", size="7B", topology="v5p:2x2x2", accel="v5p-16",
+        hbm_gb=95, tp=8, pp=1, vpp=None, seq=4096, micro_batch=1,
+        num_micro=1, zero1=False,
+    ),
     # SC21 weak-scaling suite rows (reference examples/sc21/run_table_1.sh
     # + arXiv 2104.04473 Table 1) mapped onto v5p topologies — GPT-2
     # architecture, seq 2048, same tp/pp split, dp fills the slice
@@ -110,6 +121,14 @@ def _model_for(spec):
         return GPTModel(gpt2_config(
             "tiny", **spec["shape"], padded_vocab_size=51200,
             hidden_dropout=0.0, attention_dropout=0.0, **common))
+    if spec["family"] == "qwen2":
+        from megatron_llm_tpu.models.qwen2 import Qwen2Model, qwen2_config
+
+        return Qwen2Model(qwen2_config(spec["size"], **common))
+    if spec["family"] == "gemma":
+        from megatron_llm_tpu.models.gemma import GemmaModel, gemma_config
+
+        return GemmaModel(gemma_config(spec["size"], **common))
     if spec["family"] == "llama2":
         from megatron_llm_tpu.models.llama import LlamaModel, llama_config
 
