@@ -73,6 +73,16 @@ MODEL_DEFAULTS = {
     "gemma": dict(position_embedding_type="rotary", glu_activation="geglu",
                   use_rms_norm=True, use_bias=False, layernorm_epsilon=1e-6,
                   hidden_dropout=0.0, attention_dropout=0.0),
+    "gpt_neox": dict(position_embedding_type="rotary", use_bias=True,
+                     parallel_attn=True, parallel_layernorm=True,
+                     rotary_percent=0.25, tie_embed_logits=False,
+                     gelu_variant="exact",
+                     hidden_dropout=0.0, attention_dropout=0.0),
+    "pythia": dict(position_embedding_type="rotary", use_bias=True,
+                   parallel_attn=True, parallel_layernorm=True,
+                   rotary_percent=0.25, tie_embed_logits=False,
+                   gelu_variant="exact",
+                   hidden_dropout=0.0, attention_dropout=0.0),
     "gpt": dict(),
 }
 
@@ -235,6 +245,9 @@ _CKPT_ARG_MAP = {
     "add_qkv_bias": "add_qkv_bias",
     # gemma's embedding normalizer changes forward math, not the tree
     "embedding_multiplier": "embedding_multiplier",
+    # forward-math fields for the NeoX family
+    "rotary_percent": "rotary_percent",
+    "gelu_variant": "gelu_variant",
 }
 
 

@@ -114,6 +114,13 @@ def _add_network_size_args(parser):
                    help="bias on the QKV projection only (Qwen2-style)")
     g.add_argument("--embedding_multiplier", type=float, default=None,
                    help="scale embedding output (Gemma: sqrt(hidden))")
+    g.add_argument("--rotary_percent", type=float, default=1.0,
+                   help="fraction of head dims that rotate "
+                        "(GPT-NeoX/Pythia rotary_pct)")
+    g.add_argument("--gelu_variant", default="tanh",
+                   choices=["tanh", "exact"],
+                   help="non-GLU MLP gelu: tanh-approximate (GPT-2) or "
+                        "exact erf (Falcon/NeoX)")
     g.add_argument("--no_tie_embed_logits", action="store_false",
                    dest="tie_embed_logits")
     g.add_argument("--onnx_safe", action="store_true")  # compat
@@ -539,6 +546,8 @@ def transformer_config_from_args(args, model_name: Optional[str] = None
         context_parallel_algo=args.context_parallel_algo,
         add_qkv_bias=getattr(args, "add_qkv_bias", False),
         embedding_multiplier=getattr(args, "embedding_multiplier", None),
+        rotary_percent=getattr(args, "rotary_percent", 1.0),
+        gelu_variant=getattr(args, "gelu_variant", "tanh"),
     )
 
 

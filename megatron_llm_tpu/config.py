@@ -196,6 +196,9 @@ class TransformerConfig:
     # scale the word-embedding output by this factor (Gemma multiplies by
     # sqrt(hidden_size); the tied LM head uses the UNSCALED table)
     embedding_multiplier: Optional[float] = None
+    # fraction of each head's dims that rotate (GPT-NeoX/Pythia
+    # rotary_pct; 1.0 = full rotary)
+    rotary_percent: float = 1.0
 
     # --- context parallelism algorithm (TPU-native extension; the
     # reference has neither): "ring" = K/V ppermute around the cp axis

@@ -11,6 +11,7 @@ from megatron_llm_tpu.models.mistral import MistralModel, mistral_config
 from megatron_llm_tpu.models.mixtral import MixtralModel, mixtral_config
 from megatron_llm_tpu.models.qwen2 import Qwen2Model, qwen2_config
 from megatron_llm_tpu.models.gemma import GemmaModel, gemma_config
+from megatron_llm_tpu.models.gpt_neox import GPTNeoXModel, gpt_neox_config
 from megatron_llm_tpu.models.gpt2 import gpt2_config
 from megatron_llm_tpu.models.bert import BertModel, bert_config
 from megatron_llm_tpu.models.t5 import T5Model, t5_config
@@ -29,6 +30,8 @@ MODEL_REGISTRY = {
     "mixtral": MixtralModel,
     "qwen2": Qwen2Model,
     "gemma": GemmaModel,
+    "gpt_neox": GPTNeoXModel,
+    "pythia": GPTNeoXModel,
 }
 # BERT/T5 train through their own entry points (pretrain_bert.py /
 # pretrain_t5.py), mirroring the reference; they are not finetune.py models.

@@ -768,8 +768,10 @@ def transformer_stack(
 def rotary_freqs(cfg: TransformerConfig, seq_len: Optional[int] = None):
     if cfg.position_embedding_type != PositionEmbeddingType.rotary:
         return None
+    rot_d = int(cfg.head_dim * cfg.rotary_percent)
+    rot_d -= rot_d % 2
     return precompute_freqs_cis(
-        cfg.head_dim,
+        rot_d,
         seq_len or cfg.max_position_embeddings,
         theta=cfg.rope_theta,
         scaling_factor=cfg.rope_scaling_factor,

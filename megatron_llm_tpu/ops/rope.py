@@ -57,6 +57,12 @@ def apply_rotary_emb(
     """
     orig_dtype = x.dtype
     *lead, s, h, d = x.shape
+    rot_d = 2 * cos.shape[-1]
+    if rot_d < d:
+        # partial rotary (GPT-NeoX/Pythia rotary_pct): rotate the first
+        # rot_d dims of each head, pass the rest through unchanged
+        out_rot = apply_rotary_emb(x[..., :rot_d], cos, sin, position_ids)
+        return jnp.concatenate([out_rot, x[..., rot_d:]], axis=-1)
     if position_ids is None:
         c = cos[:s]  # [s, d/2]
         sn = sin[:s]

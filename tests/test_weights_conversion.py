@@ -449,3 +449,40 @@ def test_gemma_hf_export_round_trip():
     a = np.asarray(model(params, jnp.asarray(toks), train=False))
     b = np.asarray(model(back, jnp.asarray(toks), train=False))
     assert np.abs(a - b).max() < 2e-5
+
+
+def test_hf_gpt_neox_logit_parity():
+    """Pythia/GPT-NeoX golden test: per-head [nh,3,d] QKV packing with
+    the rotate-half -> interleaved permutation on the PARTIAL rotary dims
+    (rotary_pct=0.25), parallel residual with separate MLP norm,
+    LayerNorm biases, exact gelu."""
+    import jax
+
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    from megatron_llm_tpu.models.gpt_neox import GPTNeoXModel
+    from weights_conversion.hf_to_megatron import convert_gpt_neox
+
+    torch.manual_seed(0)
+    hf_cfg = GPTNeoXConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=True, layer_norm_eps=1e-5,
+        hidden_act="gelu",
+    )
+    hf = GPTNeoXForCausalLM(hf_cfg).eval()
+    params, config = convert_gpt_neox(hf)
+    assert config["rotary_percent"] == 0.25
+    layers = params["transformer"]["layers"]
+    assert "bias" in layers["attention"]["query_key_value"]
+    assert "bias" in layers["mlp"]["dense_h_to_4h"]
+    assert "mlp_norm" in layers
+    cfg = TransformerConfig(**config, use_flash_attn=False)
+    model = GPTNeoXModel(cfg)
+
+    toks = np.random.RandomState(0).randint(0, 256, (2, 16))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(toks)).logits.numpy()
+    my_logits = np.asarray(model(params, jnp.asarray(toks), train=False))
+    assert np.abs(hf_logits - my_logits).max() < 2e-5

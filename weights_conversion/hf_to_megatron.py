@@ -33,6 +33,24 @@ from weights_conversion.util import (
 )
 
 
+def stack_layer_tree(layers, dtype):
+    """Per-layer param dicts (identical structure) -> one stacked pytree
+    with a leading [num_layers] axis on every leaf."""
+    import jax.numpy as jnp
+
+    def rec(template, *path):
+        if isinstance(template, dict):
+            return {k: rec(v, *path, k) for k, v in template.items()}
+
+        def get(lp, keys):
+            for kk in keys:
+                lp = lp[kk]
+            return lp
+        return jnp.asarray(np.stack([get(l, path) for l in layers]), dtype)
+
+    return rec(layers[0])
+
+
 def _np(t):
     # .copy() is load-bearing: .float() on an fp32 tensor is a no-op view,
     # so without it the numpy array aliases the live HF parameter and the
@@ -109,18 +127,7 @@ def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None,
 
     import jax.numpy as jnp
 
-    def stack_tree(template, *path):
-        """Stack every leaf of the (per-layer identical) subtree."""
-        if isinstance(template, dict):
-            return {k: stack_tree(v, *path, k) for k, v in template.items()}
-
-        def get(lp, keys):
-            for kk in keys:
-                lp = lp[kk]
-            return lp
-        return jnp.asarray(np.stack([get(l, path) for l in layers]), dtype)
-
-    layer_tree = stack_tree(layers[0])
+    layer_tree = stack_layer_tree(layers, dtype)
     tied = bool(getattr(hf_cfg, "tie_word_embeddings", False))
     params = {
         "embedding": {
@@ -176,6 +183,128 @@ def convert_gemma(hf_model, dtype=np.float32):
                                           norm_add_one=True)
     config["glu_activation"] = "geglu"
     config["embedding_multiplier"] = math.sqrt(config["hidden_size"])
+    return params, config
+
+
+def convert_gpt_neox(hf_model, dtype=np.float32):
+    """GPTNeoXForCausalLM (Pythia) -> param pytree + config dict.
+
+    HF packs QKV rows per head as [nh, 3, d] — identical to this
+    framework's grouped layout at ng == nh — so only the rotate-half ->
+    interleaved permutation of each head's ROTARY dims (rotary_pct of d)
+    is needed, applied to the q and k sub-blocks of weights and biases."""
+    import jax.numpy as jnp
+
+    hf_cfg = hf_model.config
+    if not getattr(hf_cfg, "use_parallel_residual", True):
+        raise NotImplementedError(
+            "GPT-NeoX with use_parallel_residual=False maps to the "
+            "sequential layer layout; convert is only wired for the "
+            "parallel-residual (Pythia) form")
+    act = getattr(hf_cfg, "hidden_act", "gelu")
+    if act == "gelu":
+        gelu_variant = "exact"
+    elif act in ("gelu_new", "gelu_fast", "gelu_pytorch_tanh"):
+        gelu_variant = "tanh"
+    else:
+        raise NotImplementedError(f"gpt-neox hidden_act {act!r}")
+    nh = hf_cfg.num_attention_heads
+    h = hf_cfg.hidden_size
+    d = h // nh
+    rot_d = int(d * hf_cfg.rotary_pct)
+    rot_d -= rot_d % 2
+    sd = dict(hf_model.state_dict())
+
+    def permute_qkv(w):
+        """w [3*h(, hid)] in [nh, 3, d] row layout: permute the first
+        rot_d dims of the q and k sub-blocks."""
+        vec = w.ndim == 1
+        if vec:
+            w = w[:, None]
+        x = w.reshape(nh, 3, d, w.shape[1]).copy()
+        for j in (0, 1):                      # q and k, not v
+            blk = x[:, j, :rot_d].reshape(nh * rot_d, w.shape[1])
+            x[:, j, :rot_d] = rotary_hf_to_interleaved(
+                blk, rot_d).reshape(nh, rot_d, w.shape[1])
+        out = x.reshape(3 * h, w.shape[1])
+        return out[:, 0] if vec else out
+
+    layers = []
+    for i in range(hf_cfg.num_hidden_layers):
+        p = f"gpt_neox.layers.{i}."
+        qkv_w = permute_qkv(_np(sd[p + "attention.query_key_value.weight"]))
+        qkv_b = permute_qkv(_np(sd[p + "attention.query_key_value.bias"]))
+        layers.append({
+            "input_norm": {
+                "scale": _np(sd[p + "input_layernorm.weight"]),
+                "bias": _np(sd[p + "input_layernorm.bias"]),
+            },
+            "mlp_norm": {
+                "scale": _np(sd[p + "post_attention_layernorm.weight"]),
+                "bias": _np(sd[p + "post_attention_layernorm.bias"]),
+            },
+            "attention": {
+                "query_key_value": {
+                    "kernel": np.ascontiguousarray(qkv_w.T),
+                    "bias": qkv_b,
+                },
+                "dense": {
+                    "kernel": np.ascontiguousarray(
+                        _np(sd[p + "attention.dense.weight"]).T),
+                    "bias": _np(sd[p + "attention.dense.bias"]),
+                },
+            },
+            "mlp": {
+                "dense_h_to_4h": {
+                    "kernel": np.ascontiguousarray(
+                        _np(sd[p + "mlp.dense_h_to_4h.weight"]).T),
+                    "bias": _np(sd[p + "mlp.dense_h_to_4h.bias"]),
+                },
+                "dense_4h_to_h": {
+                    "kernel": np.ascontiguousarray(
+                        _np(sd[p + "mlp.dense_4h_to_h.weight"]).T),
+                    "bias": _np(sd[p + "mlp.dense_4h_to_h.bias"]),
+                },
+            },
+        })
+
+    params = {
+        "embedding": {"word": {"embedding": jnp.asarray(
+            _np(sd["gpt_neox.embed_in.weight"]), dtype)}},
+        "transformer": {
+            "layers": stack_layer_tree(layers, dtype),
+            "final_norm": {
+                "scale": jnp.asarray(
+                    _np(sd["gpt_neox.final_layer_norm.weight"]), dtype),
+                "bias": jnp.asarray(
+                    _np(sd["gpt_neox.final_layer_norm.bias"]), dtype),
+            },
+        },
+        "lm_head": {"weight": jnp.asarray(
+            _np(sd["embed_out.weight"]), dtype)},
+    }
+    config = {
+        "num_layers": hf_cfg.num_hidden_layers,
+        "hidden_size": h,
+        "num_attention_heads": nh,
+        "ffn_hidden_size": hf_cfg.intermediate_size,
+        "padded_vocab_size": hf_cfg.vocab_size,
+        "seq_length": hf_cfg.max_position_embeddings,
+        "max_position_embeddings": hf_cfg.max_position_embeddings,
+        "position_embedding_type": "rotary",
+        "glu_activation": None,
+        "gelu_variant": gelu_variant,
+        "normalization": "layernorm",
+        "add_bias_linear": True,
+        "parallel_attn": bool(hf_cfg.use_parallel_residual),
+        "parallel_layernorm": bool(hf_cfg.use_parallel_residual),
+        "tie_embed_logits": False,
+        "rotary_percent": hf_cfg.rotary_pct,
+        "rope_theta": getattr(hf_cfg, "rotary_emb_base", 10000.0),
+        "layernorm_epsilon": hf_cfg.layer_norm_eps,
+        "hidden_dropout": 0.0,
+        "attention_dropout": 0.0,
+    }
     return params, config
 
 
@@ -374,6 +503,8 @@ CONVERTERS = {
     "mixtral": convert_mixtral,
     "qwen2": convert_qwen2,
     "gemma": convert_gemma,
+    "gpt_neox": convert_gpt_neox,
+    "pythia": convert_gpt_neox,
     "falcon": convert_falcon,
 }
 
