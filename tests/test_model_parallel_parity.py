@@ -15,13 +15,19 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from megatron_llm_tpu import topology
 from megatron_llm_tpu.models import (
     FalconModel,
+    GemmaModel,
     GPTModel,
+    GPTNeoXModel,
     LlamaModel,
     MistralModel,
+    Qwen2Model,
     falcon_config,
+    gemma_config,
     gpt2_config,
+    gpt_neox_config,
     llama_config,
     mistral_config,
+    qwen2_config,
 )
 from megatron_llm_tpu.parallel import sharding as sh
 
@@ -30,6 +36,9 @@ CASES = [
     ("gpt2", GPTModel, gpt2_config),
     ("falcon", FalconModel, falcon_config),
     ("mistral", MistralModel, mistral_config),
+    ("qwen2", Qwen2Model, qwen2_config),
+    ("gemma", GemmaModel, gemma_config),
+    ("gpt_neox", GPTNeoXModel, gpt_neox_config),
 ]
 
 
