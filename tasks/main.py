@@ -84,6 +84,10 @@ def main():
         from tasks.glue.finetune import main as task_main
     elif args.task in ("LAMBADA", "WIKITEXT103"):
         from tasks.zeroshot_gpt.evaluate import main as task_main
+    elif args.task in ("PIQA", "HELLASWAG", "ARC-EASY", "ARC-CHALLENGE",
+                       "BOOLQ", "WINOGRANDE"):
+        # beyond-reference: multiple-choice loglikelihood-ranking tasks
+        from tasks.zeroshot_gpt.mc_tasks import main as task_main
     elif args.task in ("ICT-ZEROSHOT-NQ", "RETRIEVER-EVAL"):
         from tasks.orqa.evaluate_orqa import main as task_main
     elif args.task in ("MSDP-PROMPT-KNWL", "MSDP-PROMPT-RESP"):

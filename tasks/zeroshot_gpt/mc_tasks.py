@@ -1,0 +1,202 @@
+"""Multiple-choice zero-shot tasks: PIQA, HellaSwag, ARC, BoolQ,
+Winogrande (beyond-reference — the reference's zero-shot harness covers
+LAMBADA and WIKITEXT103 only).
+
+Standard log-likelihood ranking (the lm-eval-harness protocol): each
+sample is a context plus N candidate continuations; the score of a
+candidate is the sum of its tokens' log-probs conditioned on
+context+prefix (optionally length-normalized), and the prediction is
+the argmax.  Data: the tasks' public jsonl files, read locally.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# per-task jsonl parsers -> {context, choices: [str], gold: int}
+# ---------------------------------------------------------------------------
+
+def _parse_piqa(rec):
+    return {"context": f"Question: {rec['goal']}\nAnswer:",
+            "choices": [" " + rec["sol1"], " " + rec["sol2"]],
+            "gold": int(rec["label"])}
+
+
+def _parse_hellaswag(rec):
+    ctx = rec.get("ctx") or (rec.get("ctx_a", "") + " " + rec.get("ctx_b", ""))
+    return {"context": ctx.strip(),
+            "choices": [" " + e for e in rec["endings"]],
+            "gold": int(rec["label"])}
+
+
+def _parse_arc(rec):
+    ch = rec["choices"]
+    labels = list(ch["label"])
+    return {"context": f"Question: {rec['question']}\nAnswer:",
+            "choices": [" " + t for t in ch["text"]],
+            "gold": labels.index(rec["answerKey"])}
+
+
+def _parse_boolq(rec):
+    ans = rec["answer"]
+    if isinstance(ans, str):
+        ans = ans.strip().lower() == "true"
+    return {"context": f"{rec['passage']}\nQuestion: {rec['question']}?\n"
+                       f"Answer:",
+            "choices": [" no", " yes"],
+            "gold": int(bool(ans))}
+
+
+def _parse_winogrande(rec):
+    """lm-eval 'partial evaluation': context = sentence up to the blank
+    with each option substituted; only the COMMON suffix after the blank
+    is scored, so option-token likelihoods never enter the comparison."""
+    sent = rec["sentence"]
+    cut = sent.index("_")
+    suffix = sent[cut + 1:]
+    opts = [rec["option1"], rec["option2"]]
+    if not suffix.strip():
+        # blank at the very end: nothing shared to score; fall back to
+        # ranking the substituted sentences themselves
+        return {"context": sent[:cut].rstrip(),
+                "choices": [" " + o for o in opts],
+                "gold": int(rec["answer"]) - 1}
+    return {"contexts": [sent[:cut] + o for o in opts],
+            "choices": [suffix, suffix],
+            "gold": int(rec["answer"]) - 1}
+
+
+PARSERS: Dict[str, Callable] = {
+    "PIQA": _parse_piqa,
+    "HELLASWAG": _parse_hellaswag,
+    "ARC-EASY": _parse_arc,
+    "ARC-CHALLENGE": _parse_arc,
+    "BOOLQ": _parse_boolq,
+    "WINOGRANDE": _parse_winogrande,
+}
+# length-normalized accuracy (acc_norm) is standard for these two
+LENGTH_NORMALIZED = {"HELLASWAG", "ARC-EASY", "ARC-CHALLENGE"}
+
+
+def load_mc_samples(task: str, path: str) -> List[dict]:
+    parse = PARSERS[task]
+    samples = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                samples.append(parse(json.loads(line)))
+    return samples
+
+
+# ---------------------------------------------------------------------------
+# scoring
+# ---------------------------------------------------------------------------
+
+def score_choices(model, params, tokenizer, samples, seq_len: int,
+                  batch_size: int = 8, length_normalize: bool = False,
+                  pad_id: int = 0):
+    """Accuracy of argmax_choice sum-logprob(continuation | context).
+
+    Every (sample, choice) pair becomes one row [seq_len + 1]; rows are
+    batched through one jitted scorer that returns the summed (or
+    length-averaged) continuation log-prob with pad/context positions
+    masked out."""
+
+    @jax.jit
+    def row_scores(params, tokens, cont_mask):
+        inp, labels = tokens[:, :-1], tokens[:, 1:]
+        # labels=None => the model returns plain logits (MoE aux is
+        # already dropped inside GPTModel on the generation path)
+        logits = model(params, inp)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            lp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        m = cont_mask[:, 1:].astype(jnp.float32)
+        s = jnp.sum(picked * m, axis=-1)
+        if length_normalize:
+            s = s / jnp.maximum(jnp.sum(m, axis=-1), 1.0)
+        return s
+
+    rows, meta = [], []
+    for si, s in enumerate(samples):
+        for ci, choice in enumerate(s["choices"]):
+            ctx = (s["contexts"][ci] if "contexts" in s
+                   else s["context"])
+            ctx_ids = tokenizer.tokenize(ctx)
+            cont_ids = tokenizer.tokenize(choice)
+            if not cont_ids:
+                cont_ids = [pad_id]
+            ids = (ctx_ids + cont_ids)[-(seq_len + 1):]
+            n_cont = min(len(cont_ids), len(ids))
+            row = np.full(seq_len + 1, pad_id, np.int32)
+            row[:len(ids)] = ids
+            cmask = np.zeros(seq_len + 1, np.int32)
+            cmask[len(ids) - n_cont:len(ids)] = 1
+            rows.append((row, cmask))
+            meta.append((si, ci))
+
+    scores = np.full((len(samples), max(len(s["choices"])
+                                        for s in samples)), -np.inf)
+    for lo in range(0, len(rows), batch_size):
+        chunk = rows[lo:lo + batch_size]
+        k = len(chunk)
+        if k < batch_size:  # pad to the compiled shape
+            chunk = chunk + [chunk[-1]] * (batch_size - k)
+        toks = jnp.asarray(np.stack([c[0] for c in chunk]))
+        cmask = jnp.asarray(np.stack([c[1] for c in chunk]))
+        out = np.asarray(row_scores(params, toks, cmask))[:k]
+        for j, sc in enumerate(out):
+            si, ci = meta[lo + j]
+            scores[si, ci] = sc
+
+    correct = sum(
+        int(np.argmax(scores[i, :len(s["choices"])]) == s["gold"])
+        for i, s in enumerate(samples))
+    return correct / max(len(samples), 1), scores
+
+
+def main():
+    """tasks/main.py entry: --task PIQA|HELLASWAG|ARC-*|BOOLQ|WINOGRANDE
+    --valid_data file.jsonl."""
+    from megatron_llm_tpu import checkpointing
+    from megatron_llm_tpu.arguments import transformer_config_from_args
+    from megatron_llm_tpu.global_vars import get_args, get_tokenizer
+    from megatron_llm_tpu.models.gpt import GPTModel
+
+    args = get_args()
+    tokenizer = get_tokenizer()
+    cfg = transformer_config_from_args(args, "gpt")
+    model = GPTModel(cfg)
+    params = None
+    if args.load:
+        params, _, _ = checkpointing.load_checkpoint(args.load,
+                                                     finetune=True)
+    if params is None:
+        print(" > WARNING: evaluating a randomly initialized model",
+              flush=True)
+        params = model.init(jax.random.PRNGKey(args.seed))
+
+    task = args.task
+    path = args.valid_data[0] if isinstance(args.valid_data, list) \
+        else args.valid_data
+    from megatron_llm_tpu.parallel import sharding as sh
+
+    params = sh.shard_params(params, model.param_specs(params))
+    samples = load_mc_samples(task, path)
+    acc, _ = score_choices(
+        model, params, tokenizer, samples, cfg.seq_length,
+        batch_size=args.micro_batch_size,
+        length_normalize=task in LENGTH_NORMALIZED,
+        pad_id=getattr(tokenizer, "pad", 0) or 0)
+    kind = "acc_norm" if task in LENGTH_NORMALIZED else "acc"
+    print(f" > {task}: {kind} = {acc * 100:.2f}% over {len(samples)} "
+          f"samples", flush=True)
+    return acc
