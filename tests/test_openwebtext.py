@@ -1,0 +1,390 @@
+"""Corpus-curation suite e2e: synthetic jsonl in -> filtered/deduped out.
+
+Covers the pipeline the reference ships in ``tools/openwebtext/``
+(README workflow): URL blacklist, cleanup (mojibake/language/length),
+MinHash-LSH dedup (find -> group -> remove), and task-ngram
+decontamination.  Pure Python/numpy — no jax, no tunnel.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OWT = os.path.join(REPO, "tools", "openwebtext")
+sys.path.insert(0, OWT)
+
+from blacklist_urls import (classify, domain_is_blacklisted,  # noqa: E402
+                            extension_is_blacklisted, registered_domain,
+                            url_is_malformed)
+from cleanup_dataset import (filter_corpus, fix_text,  # noqa: E402
+                             is_english, word_count)
+from find_duplicates import main as find_duplicates_main  # noqa: E402
+from group_duplicate_urls import group_pairs  # noqa: E402
+from remove_group_duplicates import ids_to_remove  # noqa: E402
+from filter_ngrams import build_ngrams, scrub_text  # noqa: E402
+from minhash_lsh import LSHCache, MinHasher, jaccard, shingles  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+
+# ---------------------------------------------------------------- helpers
+
+_EN = ("The quick brown fox jumps over the lazy dog and then it runs to "
+       "the forest where all of the other animals have been waiting for "
+       "a long time because they wanted to see what the fox would do ")
+
+
+def _en_doc(salt="", words=200):
+    base = (_EN + salt + " ") * (words // len(_EN.split()) + 1)
+    return " ".join(base.split()[:words])
+
+
+# ---------------------------------------------------------------- minhash
+
+class TestMinHashLSH:
+    def test_identical_fingerprints(self):
+        h = MinHasher(seeds=np.arange(1, 101))
+        a = h.fingerprint(_en_doc())
+        b = h.fingerprint(_en_doc())
+        assert np.array_equal(a, b)
+
+    def test_similar_docs_share_buckets(self):
+        h = MinHasher(seeds=np.arange(1, 101))
+        cache = LSHCache(num_bands=10, hasher=h)
+        doc = _en_doc()
+        near = doc.replace("fox", "cat")  # high jaccard
+        far = ("completely different content about tensor meshes and "
+               "sharded collectives on many chips ") * 10
+        cache.add_doc(doc, "a")
+        cache.add_doc(near, "b")
+        cache.add_doc(far, "c")
+        pairs = cache.candidate_pairs()
+        assert ("a", "b") in pairs
+        assert ("a", "c") not in pairs and ("b", "c") not in pairs
+
+    def test_jaccard_modes(self):
+        sa, sb = {1, 2, 3, 4}, {3, 4, 5}
+        assert jaccard(sa, sb, "union") == pytest.approx(2 / 5)
+        assert jaccard(sa, sb, "min") == pytest.approx(2 / 3)
+        assert jaccard(sa, sb, "max") == pytest.approx(2 / 4)
+        assert jaccard(set(), sb) == 0.0
+
+    def test_worker_params_round_trip(self):
+        h = MinHasher(seeds=np.arange(1, 101))
+        h2 = MinHasher.from_params(*h.params())
+        assert np.array_equal(h.fingerprint(_en_doc()),
+                              h2.fingerprint(_en_doc()))
+
+    def test_shingles(self):
+        assert shingles("abcdef", 5) == {"abcde", "bcdef"}
+        assert shingles("abc", 5) == set()
+
+
+# ------------------------------------------------------------------- urls
+
+class TestBlacklistUrls:
+    def test_domain(self):
+        assert domain_is_blacklisted("https://www.youtube.com/watch?v=x")
+        assert domain_is_blacklisted("http://imgur.com/a/b")
+        assert not domain_is_blacklisted("https://arxiv.org/abs/1909.08053")
+
+    def test_two_level_suffix(self):
+        assert registered_domain("https://www.youtube.co.uk/x") == "youtube"
+        assert registered_domain("https://news.bbc.co.uk/") == "bbc"
+        assert registered_domain("https://example.com/") == "example"
+        assert registered_domain("http://10.0.0.1/x") == ""
+
+    def test_extension(self):
+        assert extension_is_blacklisted("http://x.org/file.JPG?dl=1")
+        assert extension_is_blacklisted("http://x.org/a.tar.gz")
+        assert extension_is_blacklisted("http://x.org/photo.jpg#section")
+        assert not extension_is_blacklisted("http://x.org/article.html")
+
+    def test_malformed(self):
+        assert url_is_malformed("notaurl")
+        assert url_is_malformed("ftp://x.org/a")
+        assert not url_is_malformed("https://example.org/path?q=1")
+
+    def test_classify_order_and_dupes(self):
+        seen = set()
+        url = "https://example.org/article-one"
+        assert classify(url, seen) is None
+        seen.add(url)
+        assert classify(url, seen) == "duplicate"
+        assert classify("http://x", seen) == "short"  # len <= 8
+
+
+# ---------------------------------------------------------------- cleanup
+
+class TestCleanup:
+    def test_fix_mojibake(self):
+        broken = "Itâ€™s a test â€“ really"
+        fixed = fix_text(broken)
+        assert "’s" in fixed and "–" in fixed
+
+    def test_fix_double_mojibake(self):
+        once = "café".encode("utf-8").decode("cp1252")
+        twice = once.encode("utf-8").decode("cp1252")
+        assert fix_text(twice) == "café"
+
+    def test_fix_controls_and_newlines(self):
+        assert fix_text("a\r\nb\x00c") == "a\nbc"
+
+    def test_clean_text_unchanged(self):
+        assert fix_text(_en_doc()) == _en_doc()
+
+    def test_is_english(self):
+        assert is_english(_en_doc())
+        assert not is_english(
+            "Der schnelle braune Fuchs springt über den faulen Hund "
+            "und dann läuft er schnell weg weil er etwas gesehen hat "
+            "das ihm große Angst gemacht hat und niemand wusste warum")
+        assert not is_english("快速の茅色狐" * 30)
+
+    def test_filter_corpus(self, tmp_path):
+        src = tmp_path / "in.jsonl"
+        docs = [
+            {"url": "u1", "text": _en_doc(words=200)},          # keep
+            {"url": "u2", "text": _en_doc(words=40)},           # small
+            {"url": "u3", "text": "El rápido zorro marrón salta "
+             "sobre el perro perezoso y luego corre hacia el bosque donde "
+             "todos los animales esperaban desde hace mucho tiempo " * 5},
+        ]
+        with open(src, "w") as f:
+            for d in docs:
+                f.write(json.dumps(d) + "\n")
+        out = tmp_path / "out.jsonl"
+        counts = filter_corpus(str(src), str(out), min_words=128)
+        kept = [json.loads(l) for l in open(out)]
+        assert [d["url"] for d in kept] == ["u1"]
+        assert counts["small"] == 1 and counts["non_english"] == 1
+
+    def test_word_count(self):
+        assert word_count("a b  c\nd") == 4
+
+
+# ------------------------------------------------------- dedup end-to-end
+
+class TestDedupE2E:
+    def test_find_group_remove(self, tmp_path):
+        corpus = tmp_path / "corpus.jsonl"
+        doc = _en_doc(words=300)
+        near = doc.replace("fox", "wolf")
+        docs = [
+            {"url": "http://a.org/1", "text": doc},
+            {"url": "http://b.org/2", "text": near},
+            {"url": "http://c.org/3", "text": "all about pallas kernels "
+             "and mesh shardings on tpu pods with ring collectives " * 20},
+        ]
+        with open(corpus, "w") as f:
+            for d in docs:
+                f.write(json.dumps(d) + "\n")
+
+        pairs = tmp_path / "pairs.jsonl"
+        find_duplicates_main([
+            "--inputs", str(corpus), "url",
+            "--output", str(pairs),
+            "--heuristic_iter", "-1",
+        ])
+        pair_lines = [l for l in open(pairs)]
+        assert pair_lines, "near-duplicate pair not detected"
+        flagged = set()
+        for line in pair_lines:
+            rec = json.loads(line)
+            for main_id, dups in rec.items():
+                flagged.add(main_id)
+                for e in dups:
+                    flagged.update(e)
+        assert flagged == {"http://a.org/1", "http://b.org/2"}
+
+        groups = group_pairs(pair_lines, threshold=0.7)
+        assert len(groups) == 1 and len(groups[0]) == 2
+
+        group_lines = [json.dumps({"0": groups[0]})]
+        remove = ids_to_remove(group_lines)
+        assert len(remove) == 1 and remove < flagged
+
+        survivors = [d["url"] for d in docs if d["url"] not in remove]
+        assert "http://c.org/3" in survivors and len(survivors) == 2
+
+    def test_union_find_long_chain(self):
+        # A chained pair file thousands of links deep must not hit the
+        # recursion limit (boilerplate pages produce such chains).
+        lines = [json.dumps({str(i + 1): [{str(i): 0.9}]})
+                 for i in range(3000)]
+        groups = group_pairs(lines, threshold=0.7)
+        assert len(groups) == 1 and len(groups[0]) == 3001
+
+    def test_parallel_modes_match_sequential(self, tmp_path):
+        corpus = tmp_path / "c.jsonl"
+        doc = _en_doc(words=300)
+        with open(corpus, "w") as f:
+            for i, text in enumerate([doc, doc.replace("fox", "wolf"),
+                                      "pallas mesh kernels " * 60]):
+                f.write(json.dumps({"url": f"u{i}", "text": text}) + "\n")
+
+        def edges(path):
+            out = set()
+            for line in open(path):
+                for m, dups in json.loads(line).items():
+                    for e in dups:
+                        out.add(frozenset([m, next(iter(e))]))
+            return out
+
+        seq, par = tmp_path / "seq.jsonl", tmp_path / "par.jsonl"
+        find_duplicates_main(["--inputs", str(corpus), "url",
+                              "--output", str(seq),
+                              "--heuristic_iter", "-1"])
+        find_duplicates_main(["--inputs", str(corpus), "url",
+                              "--num_workers", "2",
+                              "--output", str(par), "--jaccard_parallel",
+                              "--heuristic_iter", "-1"])
+        assert edges(seq) == edges(par) == {frozenset(["u0", "u1"])}
+
+    def test_fingerprint_save_load_cross_process(self, tmp_path):
+        # Save and load run in SEPARATE interpreters (different hash
+        # randomization salts): catches any process-salted state in the
+        # pickled LSH index, which in-process round trips can't see.
+        script = os.path.join(OWT, "find_duplicates.py")
+        corpus = tmp_path / "c.jsonl"
+        with open(corpus, "w") as f:
+            f.write(json.dumps({"url": "u1", "text": _en_doc()}) + "\n")
+        fp = tmp_path / "fp.pkl"
+        r = subprocess.run(
+            [sys.executable, script, "--inputs", str(corpus), "url",
+             "--save_fingerprints", str(fp)],
+            capture_output=True, text=True, env={**os.environ,
+                                                 "PYTHONHASHSEED": "11"})
+        assert r.returncode == 0, r.stderr
+        corpus2 = tmp_path / "c2.jsonl"
+        with open(corpus2, "w") as f:
+            f.write(json.dumps(
+                {"url": "u2", "text": _en_doc().replace("fox", "cat")})
+                + "\n")
+        pairs = tmp_path / "p.jsonl"
+        # Dedup the NEW shard against the saved fingerprints (recurrent
+        # dedup: the reference's load_fingerprints workflow).
+        r = subprocess.run(
+            [sys.executable, script, "--load_fingerprints", str(fp),
+             "--inputs", str(corpus2), "url", "--output", str(pairs),
+             "--heuristic_iter", "-1"],
+            capture_output=True, text=True, env={**os.environ,
+                                                 "PYTHONHASHSEED": "22"})
+        assert r.returncode == 0, r.stderr
+        flagged = set()
+        for line in open(pairs):
+            rec = json.loads(line)
+            for k, dups in rec.items():
+                flagged.add(k)
+                for e in dups:
+                    flagged.update(e)
+        assert flagged == {"u1", "u2"}
+
+
+# ----------------------------------------------------------- filter_ngrams
+
+class TestFilterNgrams:
+    def test_scrub_hit_splits_doc(self):
+        secret = ("alpha beta gamma delta epsilon zeta eta theta iota "
+                  "kappa lam mu nu")  # 13 words
+        ngrams = build_ngrams([secret], max_ngram_size=13)
+        assert len(ngrams) == 1
+        text = (_en_doc(words=150) + ". " + secret + " tail words here. "
+                + _en_doc(words=150))
+        pieces, matches = scrub_text(text, ngrams, 13,
+                                     remove_char_each_side=10,
+                                     filter_text_char_len=50)
+        assert matches == 1
+        assert len(pieces) >= 1
+        for p in pieces:
+            assert secret not in p.lower()
+
+    def test_short_task_text_whole_seq(self):
+        ngrams = build_ngrams(["tiny task answer"], max_ngram_size=13)
+        assert "tiny task answer" in ngrams
+        pieces, matches = scrub_text(
+            _en_doc(words=120) + ". tiny task answer! " + _en_doc(words=120),
+            ngrams, 13, remove_char_each_side=5, filter_text_char_len=20)
+        assert matches == 1
+        for p in pieces:
+            assert "tiny task answer" not in p.lower()
+
+    def test_clean_doc_untouched(self):
+        ngrams = build_ngrams(["some unrelated evaluation text here that "
+                               "never appears in the training data at all "
+                               "okay good"], max_ngram_size=13)
+        doc = _en_doc(words=200)
+        pieces, matches = scrub_text(doc, ngrams, 13)
+        assert matches == 0 and pieces == [doc]
+
+    def test_final_hit_past_cap_still_drops(self):
+        # The over-cap check must also fire when the LAST match leaves no
+        # pending tail (cap check after the loop, not only at its top).
+        secret = "one two three four five"
+        ngrams = build_ngrams([secret], max_ngram_size=13)
+        # 4 hits, max_splits=3; final piece ends exactly at the last hit
+        # with nothing re-appended to pending.
+        text = (". aa " + secret + " bb. ") * 4
+        pieces, matches = scrub_text(text, ngrams, 13,
+                                     remove_char_each_side=1,
+                                     filter_text_char_len=3, max_splits=3)
+        assert matches > 3
+        assert pieces == []
+
+    def test_shredded_doc_dropped(self):
+        secret = "one two three four five"
+        ngrams = build_ngrams([secret], max_ngram_size=13)
+        text = (". " + secret + " filler. ") * 30
+        pieces, matches = scrub_text(text, ngrams, 13,
+                                     remove_char_each_side=1,
+                                     filter_text_char_len=5, max_splits=10)
+        assert pieces == [] and matches > 10
+
+
+# ------------------------------------------------------------- CLI smoke
+
+class TestCLIs:
+    def test_blacklist_cli(self, tmp_path):
+        urls = tmp_path / "urls.txt"
+        urls.write_text("\n".join([
+            "https://example.org/good-article",
+            "https://www.youtube.com/watch?v=1",
+            "http://x.org/file.zip",
+            "bad",
+            "https://example.org/good-article",
+        ]) + "\n")
+        out = tmp_path / "clean.txt"
+        r = subprocess.run(
+            [sys.executable, os.path.join(OWT, "blacklist_urls.py"),
+             str(urls), str(out), "--quiet"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert out.read_text().split() == ["https://example.org/good-article"]
+
+    def test_add_id_and_merge(self, tmp_path):
+        a = tmp_path / "a.jsonl"
+        a.write_text(json.dumps({"text": "x"}) + "\n")
+        b = tmp_path / "b.jsonl"
+        b.write_text(json.dumps({"text": "y"}) + "\n")
+        merged = tmp_path / "m.jsonl"
+        r = subprocess.run(
+            [sys.executable, os.path.join(OWT, "merge_jsons.py"),
+             "--json_path", str(tmp_path), "--output_file", str(merged)],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert len(merged.read_text().splitlines()) == 2
+
+        out = tmp_path / "ids.jsonl"
+        r = subprocess.run(
+            [sys.executable, os.path.join(OWT, "add_id.py"),
+             "--input_file", str(merged), "--output_file", str(out),
+             "--id_prefix", "owt"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        recs = [json.loads(l) for l in out.read_text().splitlines()]
+        assert [r["adlr_id"] for r in recs] == ["owt-0000000001",
+                                                "owt-0000000002"]

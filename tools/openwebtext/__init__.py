@@ -1,0 +1,9 @@
+"""Corpus-curation suite (OpenWebText-style), self-contained.
+
+TPU-framework counterpart of the reference's ``tools/openwebtext/``
+pipeline: URL blacklisting, MinHash-LSH near-duplicate detection and
+removal, encoding/language/length cleanup, and downstream-task n-gram
+decontamination — with zero external dependencies (the reference needs
+the ``lsh`` C extension, ``tldextract``, ``ftfy``, ``langdetect``,
+``nltk``).  See README.md here for the end-to-end workflow.
+"""

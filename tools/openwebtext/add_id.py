@@ -1,0 +1,40 @@
+"""Stamp a sequential id onto every record of a jsonl file.
+
+Reference: ``tools/openwebtext/add_id.py:1-54`` (adds ``adlr_id`` of the
+form ``<prefix>-NNNNNNNNNN``); same field + format here so downstream
+tooling that keys on it keeps working.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="add ids to a jsonl dataset")
+    p.add_argument("--input_file", required=True)
+    p.add_argument("--output_file", required=True)
+    p.add_argument("--id_prefix", required=True)
+    p.add_argument("--log_interval", type=int, default=100)
+    args = p.parse_args(argv)
+
+    start = time.time()
+    n = 0
+    with open(args.input_file, "r", encoding="utf-8") as fin, \
+            open(args.output_file, "w", encoding="utf-8") as fout:
+        for line in fin:
+            n += 1
+            rec = json.loads(line)
+            rec["adlr_id"] = f"{args.id_prefix}-{n:010d}"
+            fout.write(json.dumps(rec, ensure_ascii=False) + "\n")
+            if n % args.log_interval == 0:
+                print(f"    processed {n:9d} documents in "
+                      f"{time.time() - start:.2f}s", flush=True)
+    print("done :-)", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""Filter a crawl URL list: blacklisted domains/extensions, malformed,
+short, and duplicate URLs.
+
+Reference: ``tools/openwebtext/blacklist_urls.py:1-299``.  The domain and
+extension blacklists below are the reference pipeline's published filter
+data (they define *what* OpenWebText excludes -- media hosts, social
+networks, binary file types -- and are kept for workflow parity).  The
+code around them is original; in particular ``registered_domain`` replaces
+the reference's ``tldextract`` dependency with a small public-suffix
+heuristic good enough for blacklist matching (it only needs the
+second-level label, e.g. ``youtube`` from ``www.youtube.co.uk``).
+
+Usage::
+
+    python blacklist_urls.py <dir with *.txt url lists | single file> <clean_urls.txt>
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import sys
+import time
+from urllib.parse import urlsplit
+
+
+# The reference pipeline's domain blacklist (media/social/binary hosts).
+_DOMAIN_BLACKLIST = set("""
+500px aapks akamaihd amazon apple artifactfire artstation awwni bandcamp
+battleforthenet coinscalendar dailymotion deviantart discord discordapp
+dlapkandroid dropbox e621 ebay edealinfo erome eroshare explosm facebook
+fbcdn flickr furaffinity futhead gatopardo gfycat gifsound gifsoup giphy
+github google gunprime gyazo hotdealstar imagefap imageshack imgflip imgur
+instagram karmadecay kryptocal kym-cdn liveleak livememe lmgtfy magaimg
+memegenerator minorplanetcenter minus mobafire morejpeg nocookie
+pcpartpicker photobucket pinimg pinterest pixiv pornhub prntscr puu qkme
+quickmeme radd redd reddit reddit-stream redditlog redditmedia
+reddituploads redtube reupp reverb roanoke rollingstone sli soundcloud
+soundgasm spankbang spotify strawpoll streamable timeanddate tinypic
+touhouradio tumblr twimg twitch twitter vid vimeo vine vkaao vocaroo
+voyagefusion walmart wciu wikimedia wikipedia xhamster xkcd xvideos youtu
+youtube youtubedoubler ytimg zillexplorer
+""".split())
+
+# Non-document file extensions (media, archives, binaries, markup assets).
+_EXTENSION_BLACKLIST = tuple("""
+.3gp .7z .ai .aif .apk .app .avi .bin .bmp .bz2 .css .csv .dat .deb .dmg
+.doc .docx .exe .gif .gifv .gz .iso .jar .jpeg .jpg .js .log .mid .midi
+.mkv .mov .mp3 .mp4 .mpeg .mpg .ogg .ogv .otf .pdf .pkg .png .pps .ppt
+.pptx .psd .py .qt .ram .rar .sql .svg .swf .tar .tar.gz .tgz .tiff .ttf
+.txt .wav .webm .wma .wmv .xls .xlsx .xml .xz .zip
+""".split())
+
+# Common multi-label public suffixes; enough to peel ccTLD second levels
+# (co.uk, com.au, ...) so the registered label lands on the actual site
+# name.  Deliberately small: blacklist matching only needs the label, and
+# an unknown exotic suffix just means the label check runs on the suffix's
+# left neighbor, which is still the right label for .com/.org/.net etc.
+_TWO_LEVEL_SUFFIXES = {
+    "co.uk", "ac.uk", "gov.uk", "org.uk", "me.uk", "net.uk",
+    "com.au", "net.au", "org.au", "edu.au", "gov.au",
+    "co.jp", "ne.jp", "or.jp", "ac.jp", "go.jp",
+    "co.kr", "or.kr", "co.in", "net.in", "org.in", "ac.in", "gov.in",
+    "com.br", "net.br", "org.br", "com.cn", "net.cn", "org.cn",
+    "com.mx", "com.tr", "com.tw", "co.za", "co.nz", "com.sg",
+    "com.hk", "co.il", "com.ar", "com.my", "co.th", "com.vn",
+}
+
+
+def registered_domain(url: str) -> str:
+    """Second-level label of the URL's host: ``https://www.youtube.co.uk/x``
+    -> ``youtube``.  Empty string for hosts/IPs with no such label."""
+    try:
+        host = urlsplit(url).hostname or ""
+    except ValueError:
+        return ""
+    if not host or re.fullmatch(r"[\d.]+", host):
+        return ""  # bare IP: no registered label
+    labels = host.lower().split(".")
+    if len(labels) < 2:
+        return labels[0] if labels else ""
+    if len(labels) >= 3 and ".".join(labels[-2:]) in _TWO_LEVEL_SUFFIXES:
+        return labels[-3]
+    return labels[-2]
+
+
+def domain_is_blacklisted(url: str) -> bool:
+    return registered_domain(url) in _DOMAIN_BLACKLIST
+
+
+def extension_is_blacklisted(url: str) -> bool:
+    path = re.split(r"[?#]", url, 1)[0]  # drop query AND fragment
+    return path.lower().endswith(_EXTENSION_BLACKLIST)
+
+
+# Same acceptance contract as the reference's url_regex
+# (``blacklist_urls.py:205-211``): scheme + hostname-or-IP + optional
+# port + optional path.
+_URL_RE = re.compile(
+    r"^https?://"
+    r"(?:[a-z0-9](?:[a-z0-9-]{0,61}[a-z0-9])?"
+    r"(?:\.[a-z0-9](?:[a-z0-9-]{0,61}[a-z0-9])?)+\.?"
+    r"|\d{1,3}(?:\.\d{1,3}){3})"
+    r"(?::\d+)?"
+    r"(?:/?|[/?]\S+)$",
+    re.IGNORECASE)
+
+
+def url_is_malformed(url: str) -> bool:
+    return _URL_RE.match(url) is None
+
+
+def classify(url: str, seen: set) -> str | None:
+    """Rejection reason, or None if the URL should be kept."""
+    if domain_is_blacklisted(url):
+        return "domain"
+    if extension_is_blacklisted(url):
+        return "extension"
+    if len(url) <= 8:
+        return "short"
+    if url_is_malformed(url):
+        return "malformed"
+    if url in seen:
+        return "duplicate"
+    return None
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="remove blacklisted urls")
+    p.add_argument("path", help="directory of *.txt url lists, or one file")
+    p.add_argument("output", help="clean url list out")
+    p.add_argument("--quiet", action="store_true",
+                   help="don't print each rejected url")
+    args = p.parse_args(argv)
+
+    files = (sorted(glob.glob(os.path.join(args.path, "*.txt")))
+             if os.path.isdir(args.path) else [args.path])
+    print(f"> found {len(files)} url file(s)", flush=True)
+
+    seen = set()
+    counts = {"domain": 0, "extension": 0, "short": 0,
+              "malformed": 0, "duplicate": 0, "total": 0}
+    start = time.time()
+    for name in files:
+        with open(name, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                url = line.strip()
+                if not url:
+                    continue
+                counts["total"] += 1
+                why = classify(url, seen)
+                if why is None:
+                    seen.add(url)
+                else:
+                    counts[why] += 1
+                    if not args.quiet:
+                        print(f"[{why.upper()}]: {url}", flush=True)
+
+    print(f"FINAL | {time.time() - start:.2f}s | " +
+          " | ".join(f"{k}: {v}" for k, v in counts.items()) +
+          f" | kept: {len(seen)}", flush=True)
+    with open(args.output, "w", encoding="utf-8") as f:
+        for url in sorted(seen):
+            f.write(url + "\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,147 @@
+"""Clean a loose-jsonl corpus: fix encoding damage, keep English docs,
+drop short docs.
+
+Reference: ``tools/openwebtext/cleanup_dataset.py:1-102``, which leans on
+``ftfy.fix_text`` and ``langdetect.detect`` -- neither shippable here, so
+both are replaced with self-contained equivalents tuned for the same
+filtering decisions:
+
+- ``fix_text``: the high-value ftfy repair is mojibake reversal (UTF-8
+  bytes mis-decoded as cp1252, the classic ``â€™`` class).
+  We detect the cp1252-mojibake signature and reverse it by re-encoding,
+  iterating for doubly-encoded text, then NFC-normalize and strip control
+  characters.
+- ``is_english``: a stopword-hit-rate + latin-letter-ratio heuristic.
+  langdetect builds char-ngram profiles for 55 languages; for a binary
+  keep/drop-English gate, function-word density separates English from
+  other latin-script languages and the letter ratio rejects non-latin
+  scripts.
+
+Doc-length gate: the reference requires >= 128 GPT-2 tokens, short-
+circuited by a ``len(text) < 8 * 128`` char pre-check.  Word count is a
+closer token proxy (GPT-2 averages ~1.3 tokens/word) and needs no vocab
+download; ``--min_words 128`` is the shipped default.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import unicodedata
+
+
+# cp1252 renderings of UTF-8 lead bytes C2/C3/C5/E2/F0 -- their presence
+# is the mojibake signature that makes a reversal attempt worthwhile.
+_MOJIBAKE_CHARS = "ÂÃÅâð"
+_CTRL_RE = re.compile(r"[\x00-\x08\x0b\x0c\x0e-\x1f\x7f]")
+
+
+def _demojibake_once(text: str) -> str:
+    """Reverse one layer of UTF-8-read-as-cp1252, if cleanly reversible
+    and actually an improvement (fewer signature characters)."""
+    try:
+        fixed = text.encode("cp1252").decode("utf-8")
+    except (UnicodeEncodeError, UnicodeDecodeError):
+        return text
+    before = sum(text.count(c) for c in _MOJIBAKE_CHARS)
+    after = sum(fixed.count(c) for c in _MOJIBAKE_CHARS)
+    return fixed if after < before else text
+
+
+def fix_text(text: str) -> str:
+    """Self-contained stand-in for ftfy.fix_text (see module docstring)."""
+    for _ in range(3):  # doubly/triply-encoded text unwinds one layer/pass
+        if not any(c in text for c in _MOJIBAKE_CHARS):
+            break
+        fixed = _demojibake_once(text)
+        if fixed == text:
+            break
+        text = fixed
+    text = unicodedata.normalize("NFC", text)
+    text = text.replace("\r\n", "\n").replace("\r", "\n")
+    return _CTRL_RE.sub("", text)
+
+
+# High-frequency English function words.  Hit-rate on these separates
+# English from other latin-script languages (their function words --
+# le/la/der/die/el/het -- barely intersect).
+_EN_STOPWORDS = frozenset(
+    "the of and to a in is that it was for on are with as his they at be "
+    "this have from or had by not but what all were when we there can an "
+    "your which their said if will each about how up out them she many "
+    "some so these would other into has more her two like him see no way "
+    "could people my than first been who its now did get made".split())
+
+
+def english_score(text: str, sample_chars: int = 4000):
+    """(stopword hit-rate, latin-letter ratio) over a prefix sample."""
+    sample = text[:sample_chars]
+    words = re.findall(r"[^\W\d_]+", sample.lower())
+    if not words:
+        return 0.0, 0.0
+    hits = sum(1 for w in words if w in _EN_STOPWORDS)
+    letters = [c for c in sample if c.isalpha()]
+    latin = sum(1 for c in letters if c.isascii())
+    return hits / len(words), (latin / len(letters)) if letters else 0.0
+
+
+def is_english(text: str) -> bool:
+    stop_rate, latin_ratio = english_score(text)
+    return stop_rate >= 0.08 and latin_ratio >= 0.90
+
+
+def word_count(text: str) -> int:
+    return len(re.findall(r"\S+", text))
+
+
+def filter_corpus(in_name: str, out_name: str, min_words: int = 128,
+                  print_interval: int = 10000) -> dict:
+    counts = {"docs": 0, "written": 0, "fixed": 0,
+              "non_english": 0, "small": 0, "errors": 0}
+    start = time.time()
+    with open(out_name, "w", encoding="utf-8") as fout, \
+            open(in_name, "r", encoding="utf-8", errors="replace") as fin:
+        for line in fin:
+            counts["docs"] += 1
+            try:
+                rec = json.loads(line)
+                text = fix_text(rec["text"])
+                if text != rec["text"]:
+                    counts["fixed"] += 1
+                rec["text"] = text
+                if not is_english(text):
+                    counts["non_english"] += 1
+                    continue
+                if word_count(text) < min_words:
+                    counts["small"] += 1
+                    continue
+                fout.write(json.dumps(rec, ensure_ascii=False) + "\n")
+                counts["written"] += 1
+            except Exception as exc:
+                counts["errors"] += 1
+                print(f"  skipping line: {exc}", flush=True)
+            if counts["docs"] % print_interval == 0:
+                print(f"[PROGRESS] {time.time() - start:.1f}s | " +
+                      " | ".join(f"{k}: {v}" for k, v in counts.items()),
+                      flush=True)
+    print(f"[FINAL] {time.time() - start:.1f}s | " +
+          " | ".join(f"{k}: {v}" for k, v in counts.items()), flush=True)
+    return counts
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="fix + language-filter + length-filter a jsonl corpus")
+    p.add_argument("input")
+    p.add_argument("output")
+    p.add_argument("--min_words", type=int, default=128,
+                   help="min whitespace-word count (~token proxy)")
+    args = p.parse_args(argv)
+    filter_corpus(args.input, args.output, args.min_words)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

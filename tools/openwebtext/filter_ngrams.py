@@ -1,0 +1,210 @@
+"""Scrub downstream-task n-grams out of a training corpus.
+
+Reference: ``tools/openwebtext/filter_ngrams.py:1-476`` (13-gram task
+decontamination, as in the GPT-3 paper): build a dictionary of word
+n-grams from evaluation-task texts; wherever a training document contains
+one, cut the match plus ``--remove_char_each_side`` characters on both
+sides (extending to sentence punctuation), keep the surrounding pieces,
+drop pieces shorter than ``--filter_text_char_len``, and drop the whole
+document once it has been split more than ``--max_splits`` times.
+
+Task ingestion is generalized instead of hardcoded per task: any mix of
+``--task_files path:jsonkey`` (jsonl) or plain ``.txt`` files feeds the
+ngram dictionary; task texts shorter than ``--max_ngram_size`` words
+contribute their full word sequence.  ``--save_ngrams``/``--load_ngrams``
+persist the dictionary for reuse across shards (reference's save/load
+dictionary feature).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pickle
+import re
+import sys
+import time
+
+_PUNCT = ".!?"
+
+
+def get_words(text: str):
+    """Lowercased word tokens + their character offsets."""
+    words, positions = [], []
+    for m in re.finditer(r"\w+", text.lower()):
+        words.append(m.group(0))
+        positions.append(m.start())
+    return words, positions
+
+
+def build_ngrams(task_texts, max_ngram_size: int) -> dict:
+    """Map ngram-string -> word length, from every task text."""
+    ngrams = {}
+    for text in task_texts:
+        words, _ = get_words(text)
+        if not words:
+            continue
+        if len(words) < max_ngram_size:
+            ngrams[" ".join(words)] = len(words)
+        else:
+            for i in range(len(words) - max_ngram_size + 1):
+                ngrams[" ".join(words[i:i + max_ngram_size])] = max_ngram_size
+    return ngrams
+
+
+def _split_around(text: str, match_start: int, match_char_len: int,
+                  pad: int):
+    """Cut ``pad`` chars each side of the match, extending each cut
+    outward to sentence punctuation (reference ``split_text`` semantics:
+    ``filter_ngrams.py:28-48``)."""
+    pos = match_start - pad
+    first = ""
+    while pos > 0 and text[pos] not in _PUNCT:
+        pos -= 1
+    if pos > 0:
+        first = text[:pos + 1]
+    pos = match_start + match_char_len + pad
+    second = ""
+    while pos < len(text) and text[pos] not in _PUNCT:
+        pos += 1
+    if pos + 1 < len(text):
+        second = text[pos + 1:]
+    return first, second
+
+
+def scrub_text(text: str, ngrams: dict, max_ngram_size: int,
+               remove_char_each_side: int = 200,
+               filter_text_char_len: int = 200,
+               max_splits: int = 10):
+    """Return (clean pieces, n_matches) for one document; pieces == []
+    means the document is entirely removed."""
+    sizes = sorted({max_ngram_size} | set(ngrams.values()), reverse=True)
+    pending = [text]
+    clean = []
+    matches = 0
+    while pending:
+        if matches > max_splits:
+            return [], matches  # document shredded: drop it wholesale
+        piece = pending.pop(0)
+        words, positions = get_words(piece)
+        hit = None
+        for i in range(len(words)):
+            for size in sizes:
+                if i + size > len(words):
+                    continue
+                seq = " ".join(words[i:i + size])
+                if seq in ngrams:
+                    last = i + size - 1
+                    char_len = (positions[last] + len(words[last])
+                                - positions[i])
+                    hit = (positions[i], char_len)
+                    break
+            if hit:
+                break
+        if hit is None:
+            clean.append(piece)
+            continue
+        matches += 1
+        first, second = _split_around(piece, hit[0], hit[1],
+                                      remove_char_each_side)
+        if len(first) > filter_text_char_len:
+            clean.append(first)
+        if len(second) > filter_text_char_len:
+            pending.append(second)
+    if matches > max_splits:  # final hit can push past the cap after the
+        return [], matches    # in-loop check last ran
+    return clean, matches
+
+
+def load_task_texts(task_files):
+    texts = []
+    for spec in task_files:
+        if ":" in spec and not spec.endswith(".txt"):
+            path, key = spec.rsplit(":", 1)
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        texts.append(json.loads(line)[key])
+                    except Exception as exc:
+                        print(f"Error reading {path}: {exc}", flush=True)
+        else:
+            with open(spec, "r", encoding="utf-8") as f:
+                texts.append(f.read())
+    return texts
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="remove downstream-task ngrams from a training corpus")
+    p.add_argument("--task_files", nargs="*", default=[],
+                   help="task sources: jsonl as path:key, or plain .txt")
+    p.add_argument("--dedup_dataset", nargs=2,
+                   metavar=("FILE", "KEY"), required=False,
+                   help="training jsonl + its text key")
+    p.add_argument("--output", type=str, default=None)
+    p.add_argument("--max_ngram_size", type=int, default=13)
+    p.add_argument("--remove_char_each_side", type=int, default=200)
+    p.add_argument("--filter_text_char_len", type=int, default=200)
+    p.add_argument("--max_splits", type=int, default=10)
+    p.add_argument("--save_ngrams", type=str, default=None)
+    p.add_argument("--load_ngrams", nargs="*", default=None)
+    args = p.parse_args(argv)
+
+    ngrams = {}
+    if args.load_ngrams:
+        for name in args.load_ngrams:
+            with open(name, "rb") as f:
+                ngrams.update(pickle.load(f))
+            print(f" > loaded ngrams from {name} (total {len(ngrams)})",
+                  flush=True)
+    if args.task_files:
+        texts = load_task_texts(args.task_files)
+        ngrams.update(build_ngrams(texts, args.max_ngram_size))
+        print(f" > built {len(ngrams)} task ngrams from "
+              f"{len(texts)} task texts", flush=True)
+    if args.save_ngrams:
+        with open(args.save_ngrams, "wb") as f:
+            pickle.dump(ngrams, f)
+        print(f" > saved ngrams to {args.save_ngrams}", flush=True)
+
+    if not args.dedup_dataset or not args.output:
+        return 0
+
+    data_file, key = args.dedup_dataset
+    stats = {"docs": 0, "untouched": 0, "trimmed": 0, "removed": 0,
+             "pieces": 0}
+    start = time.time()
+    with open(args.output, "w", encoding="utf-8") as fout, \
+            open(data_file, "r", encoding="utf-8") as fin:
+        for line in fin:
+            stats["docs"] += 1
+            try:
+                rec = json.loads(line)
+                text = rec[key]
+            except Exception as exc:
+                print(f"Error: {exc}", flush=True)
+                continue
+            pieces, matches = scrub_text(
+                text, ngrams, args.max_ngram_size,
+                args.remove_char_each_side, args.filter_text_char_len,
+                args.max_splits)
+            if matches == 0:
+                stats["untouched"] += 1
+                fout.write(json.dumps(rec, ensure_ascii=False) + "\n")
+                continue
+            if not pieces:
+                stats["removed"] += 1
+                continue
+            stats["trimmed"] += 1
+            for piece in pieces:
+                stats["pieces"] += 1
+                out = dict(rec)
+                out[key] = piece
+                fout.write(json.dumps(out, ensure_ascii=False) + "\n")
+    print(f"[FINAL] {time.time() - start:.1f}s | " +
+          " | ".join(f"{k}: {v}" for k, v in stats.items()), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,297 @@
+"""Find near-duplicate documents in loose-jsonl corpora via MinHash LSH.
+
+Workflow + argument parity with the reference
+(``tools/openwebtext/find_duplicates.py:178-292``): fingerprint every
+document of every ``--inputs file key`` pair, optionally save/load the
+fingerprint index for recurrent dedup, then emit one jsonl line per
+retained "main" document listing the bucket-mates whose Jaccard
+similarity exceeds 0.5::
+
+    {"<main_id>": [{"<other_id>": 0.83}, ...]}
+
+Differences from the reference, by design:
+- the LSH engine is the in-repo numpy one (``minhash_lsh.py``), not the
+  external C extension;
+- fingerprinting parallelism uses a bounded process pool only when
+  ``--num_workers > 1`` (the reference hardcodes 40 workers, which on a
+  shared CI box just thrashes);
+- bucket scanning is sequential by default; ``--jaccard_parallel`` fans
+  buckets out across processes like the reference's bin-parallel mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import pickle
+import random
+import sys
+import time
+
+import numpy as np
+
+try:
+    from .minhash_lsh import LSHCache, MinHasher, jaccard, shingles
+except ImportError:  # run as a script: python tools/openwebtext/find_duplicates.py
+    from minhash_lsh import LSHCache, MinHasher, jaccard, shingles
+
+
+def dedup_bucket(bucket_ids, id_text, jaccard_mode, heuristic_iter, rng,
+                 shingle_memo):
+    """Reference heuristic (``find_duplicates.py:50-84``): repeatedly pick
+    a random 'main' doc from the bucket, mark every other member with
+    similarity > 0.5 as its duplicate, drop them all from the bucket, and
+    repeat up to ``heuristic_iter`` rounds (-1 = until the bucket empties,
+    i.e. exact within-bucket)."""
+    def sh(doc_id):
+        s = shingle_memo.get(doc_id)
+        if s is None:
+            s = shingle_memo[doc_id] = shingles(id_text[doc_id])
+        return s
+
+    out = []
+    flagged = set()
+    compared = 0
+    bucket = list(bucket_ids)
+    iteration = 0
+    while len(bucket) > 1:
+        if heuristic_iter != -1 and iteration == heuristic_iter:
+            break
+        main = bucket[rng.randrange(len(bucket))]
+        main_sh = sh(main)
+        dups = []
+        keep = []
+        for other in bucket:
+            if other == main:
+                continue
+            compared += 1
+            sim = jaccard(main_sh, sh(other), jaccard_mode)
+            if sim > 0.5:
+                dups.append({other: round(sim, 4)})
+                flagged.add(other)
+            else:
+                keep.append(other)
+        bucket = keep
+        if dups:
+            out.append({main: dups})
+        iteration += 1
+    return out, flagged, compared
+
+
+# Worker-side corpus state, installed once per worker by the Pool
+# initializer (portable across fork/spawn/forkserver start methods; under
+# fork the dict pages are also shared copy-on-write) instead of pickling
+# the full id_text dict into every per-band payload -- for a large corpus
+# that serialization would dwarf the scan.
+_SCAN_STATE = {}
+
+
+def _init_scan_state(state):
+    _SCAN_STATE.update(state)
+
+
+def _scan_one_bin(payload):
+    bin_index, seed = payload
+    bin_dict = _SCAN_STATE["bins"][bin_index]
+    id_text = _SCAN_STATE["id_text"]
+    jaccard_mode = _SCAN_STATE["jaccard"]
+    heuristic_iter = _SCAN_STATE["heuristic_iter"]
+    skip = _SCAN_STATE["skip"]
+    rng = random.Random(seed)
+    lines = []
+    flagged = set()
+    compared = 0
+    shingle_memo = {}
+    for ids in bin_dict.values():
+        live = [i for i in ids if i not in skip and i not in flagged]
+        if len(live) <= 1:
+            continue
+        recs, f, c = dedup_bucket(live, id_text, jaccard_mode,
+                                  heuristic_iter, rng, shingle_memo)
+        flagged |= f
+        compared += c
+        lines.extend(recs)
+    return lines, flagged, compared
+
+
+def scan_buckets(args, cache, id_text):
+    """Walk every LSH bucket and write the duplicate-pair jsonl.
+
+    A near-duplicate pair collides in most bands, so later bins skip doc
+    ids already flagged as duplicates (sequential mode threads the
+    flagged set through; parallel workers each start from the ids
+    flagged before the pool launched, and the parent drops repeated
+    (main, dup) edges at write time)."""
+    start = time.time()
+    _SCAN_STATE.update({
+        "bins": cache.bins, "id_text": id_text, "jaccard": args.jaccard,
+        "heuristic_iter": args.heuristic_iter, "skip": set(),
+    })
+    total_flagged = set()
+    total_compared = 0
+    seen_edges = set()
+    with open(args.output, "w", encoding="utf-8") as f_out:
+        def emit(lines):
+            for rec in lines:
+                for main_id, dups in rec.items():
+                    fresh = []
+                    for e in dups:
+                        other = next(iter(e))
+                        if (main_id, other) not in seen_edges and \
+                                (other, main_id) not in seen_edges:
+                            seen_edges.add((main_id, other))
+                            fresh.append(e)
+                    if fresh:
+                        f_out.write(json.dumps({main_id: fresh},
+                                               ensure_ascii=False) + "\n")
+
+        if args.jaccard_parallel and len(cache.bins) > 1:
+            payloads = [(i, args.seed + i) for i in range(len(cache.bins))]
+            with multiprocessing.Pool(min(len(payloads),
+                                          multiprocessing.cpu_count()),
+                                      initializer=_init_scan_state,
+                                      initargs=(_SCAN_STATE,)) as pool:
+                for lines, flagged, compared in pool.imap(_scan_one_bin,
+                                                          payloads):
+                    total_flagged |= flagged
+                    total_compared += compared
+                    emit(lines)
+        else:
+            for i in range(len(cache.bins)):
+                _SCAN_STATE["skip"] = total_flagged
+                lines, flagged, compared = _scan_one_bin((i, args.seed + i))
+                total_flagged |= flagged
+                total_compared += compared
+                emit(lines)
+    print(f" > jaccard scan: {total_compared} comparisons, "
+          f"{len(total_flagged)} duplicates flagged in "
+          f"{time.time() - start:.2f}s", flush=True)
+
+
+def _parse_line(line, key):
+    try:
+        rec = json.loads(line)
+        return rec[key], rec["text"]
+    except Exception as exc:  # malformed line: skip, like the reference
+        print(f"Error: {exc}", flush=True)
+        return None, None
+
+
+_WORKER_HASHER = None
+
+
+def _init_worker_hasher(hasher_params):
+    # Rebuild the hasher once per worker from the parent's exact (a, b)
+    # constants so worker fingerprints are byte-identical -- via the Pool
+    # initializer, not per-line payloads (the params are constant).
+    global _WORKER_HASHER
+    _WORKER_HASHER = MinHasher.from_params(*hasher_params)
+
+
+def _fingerprint_line(payload):
+    line, key = payload
+    doc_id, text = _parse_line(line, key)
+    if doc_id is None:
+        return None, None, None
+    return doc_id, text, _WORKER_HASHER.fingerprint(text)
+
+
+def ingest_inputs(args, cache, id_text):
+    hasher = cache.hasher
+    counter = 0
+    start = time.time()
+    for input_file, key in zip(args.inputs[::2], args.inputs[1::2]):
+        print(f" > fingerprinting {input_file} (id key: {key})", flush=True)
+        with open(input_file, "r", encoding="utf-8") as fin:
+            if args.num_workers > 1:
+                with multiprocessing.Pool(
+                        args.num_workers,
+                        initializer=_init_worker_hasher,
+                        initargs=(hasher.params(),)) as pool:
+                    it = pool.imap(
+                        _fingerprint_line,
+                        ((line, key) for line in fin), 256)
+                    for doc_id, text, fp in it:
+                        counter += 1
+                        if doc_id is not None:
+                            id_text[doc_id] = text
+                            cache.add_fingerprint(fp, doc_id)
+            else:
+                for line in fin:
+                    counter += 1
+                    doc_id, text = _parse_line(line, key)
+                    if doc_id is not None:
+                        id_text[doc_id] = text
+                        cache.add_doc(text, doc_id)
+    print(f" > fingerprinted {counter} documents in "
+          f"{time.time() - start:.2f}s", flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="MinHash-LSH near-duplicate finder for jsonl corpora")
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--inputs", nargs="*", default=None,
+                   help="pairwise list: file1 idkey1 file2 idkey2 ...")
+    p.add_argument("--load_fingerprints", nargs="*", default=None,
+                   help="pickle files from --save_fingerprints to merge in")
+    p.add_argument("--save_fingerprints", type=str, default=None,
+                   help="pickle the LSH index + texts for recurrent dedup")
+    p.add_argument("--output", type=str, default=None,
+                   help="jsonl of {main_id: [{dup_id: sim}, ...]} records")
+    p.add_argument("--jaccard", type=str, default="union",
+                   choices=["union", "min", "max"])
+    p.add_argument("--heuristic_iter", type=int, default=1,
+                   help="dedup rounds per bucket; -1 = until empty (exact)")
+    p.add_argument("--num_bands", type=int, default=10)
+    p.add_argument("--num_seeds", type=int, default=100,
+                   help="minhash permutations; must divide by num_bands")
+    p.add_argument("--num_workers", type=int, default=1,
+                   help="fingerprinting processes (>1 enables the pool)")
+    p.add_argument("--jaccard_parallel", action="store_true",
+                   help="scan LSH bins in parallel processes")
+    args = p.parse_args(argv)
+
+    random.seed(args.seed)
+    np.random.seed(args.seed)
+    seeds = np.random.randint(0, 1_000_000, size=args.num_seeds)
+
+    hasher = MinHasher(seeds=seeds, char_ngram=5)
+    cache = LSHCache(num_bands=args.num_bands, hasher=hasher)
+    id_text = {}
+
+    if args.load_fingerprints:
+        for i, name in enumerate(args.load_fingerprints):
+            print(f" > loading fingerprints from {name}", flush=True)
+            with open(name, "rb") as f:
+                loaded_cache = pickle.load(f)
+                loaded_texts = pickle.load(f)
+            if i == 0 and not cache.fingerprints:
+                cache = loaded_cache
+                id_text.update(loaded_texts)
+            else:
+                for doc_id, fp in loaded_cache.fingerprints.items():
+                    id_text[doc_id] = loaded_texts[doc_id]
+                    cache.add_fingerprint(fp, doc_id)
+
+    if args.inputs:
+        if len(args.inputs) % 2 != 0:
+            p.error("--inputs must be file/key pairs")
+        ingest_inputs(args, cache, id_text)
+
+    if args.save_fingerprints:
+        print(f" > saving fingerprints to {args.save_fingerprints}",
+              flush=True)
+        with open(args.save_fingerprints, "wb") as f:
+            pickle.dump(cache, f)
+            pickle.dump(id_text, f)
+
+    if args.output:
+        scan_buckets(args, cache, id_text)
+
+    print("done :-)", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""Concatenate every ``*.json``/``*.jsonl`` shard in a directory into one
+loose-jsonl file (reference: ``tools/openwebtext/merge_jsons.py:1-42``),
+validating each line parses before writing."""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="merge jsonl shards")
+    p.add_argument("--json_path", type=str, default=".")
+    p.add_argument("--output_file", type=str, default="merged_output.json")
+    args = p.parse_args(argv)
+
+    shards = sorted(glob.glob(os.path.join(args.json_path, "*.json"))
+                    + glob.glob(os.path.join(args.json_path, "*.jsonl")))
+    n = 0
+    with open(args.output_file, "w", encoding="utf-8") as out:
+        for name in shards:
+            if os.path.abspath(name) == os.path.abspath(args.output_file):
+                continue
+            with open(name, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    json.loads(line)  # validate, fail loud on corrupt shards
+                    out.write(line + "\n")
+                    n += 1
+    print(f"merged {len(shards)} shard(s), {n} records -> "
+          f"{args.output_file}", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

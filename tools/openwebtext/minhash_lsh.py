@@ -1,0 +1,167 @@
+"""Self-contained MinHash + banded-LSH near-duplicate detection.
+
+The reference's corpus-dedup pipeline (``tools/openwebtext/find_duplicates.py:1-292``)
+depends on the external ``lsh`` C-extension (github.com/mattilyra/LSH) for
+its ``minhash.MinHasher`` / ``cache.Cache``.  This module provides the same
+two objects with zero dependencies beyond numpy, vectorized instead of
+C-accelerated:
+
+- a document's char-ngram shingles are base-hashed once (blake2b -> uint64),
+  then all ``num_seeds`` permutations are applied as one [seeds, shingles]
+  universal-hash broadcast and min-reduced -- one numpy expression per doc
+  rather than a per-shingle C loop;
+- the LSH cache splits each fingerprint into ``num_bands`` bands and buckets
+  documents by the hash of each band, so candidate pairs are only drawn from
+  shared buckets (standard banded Jaccard LSH).
+
+Determinism: base hashes use blake2b (stable across processes/machines,
+unlike Python's salted ``hash``), and the permutation constants derive from
+a caller-provided seed array, so fingerprints computed in different runs or
+processes can be mixed -- which is what makes ``--save_fingerprints`` /
+``--load_fingerprints`` recurrent dedup (reference behavior) work.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+# Mersenne prime 2^61 - 1: universal-hash modulus, big enough that
+# collisions across <= 2^32 shingle hashes are negligible, small enough
+# that (a*h + b) stays inside uint128-free numpy by using Python ints via
+# object arrays -- instead we keep everything in uint64 and rely on
+# wraparound multiply-shift hashing (Dietzfelbinger), which needs no
+# modulus at all.
+_FP_DTYPE = np.uint64
+
+
+def shingles(text: str, char_ngram: int = 5) -> set:
+    """Set of overlapping character n-grams of ``text``.
+
+    Same contract as the reference's ``shingles``
+    (``find_duplicates.py:17-19``) — char 5-grams over the raw string —
+    except the final shingle is included (the reference's range drops it).
+    """
+    return {text[i:i + char_ngram]
+            for i in range(0, len(text) - char_ngram + 1)}
+
+
+def _base_hashes(shingle_set) -> np.ndarray:
+    """Stable 64-bit hash per shingle (blake2b digest -> uint64)."""
+    if not shingle_set:
+        return np.zeros((0,), dtype=_FP_DTYPE)
+    out = np.empty((len(shingle_set),), dtype=_FP_DTYPE)
+    for i, s in enumerate(shingle_set):
+        d = hashlib.blake2b(s.encode("utf-8", "replace"),
+                            digest_size=8).digest()
+        out[i] = int.from_bytes(d, "little")
+    return out
+
+
+class MinHasher:
+    """MinHash fingerprinter (drop-in for ``lsh.minhash.MinHasher``).
+
+    ``seeds`` is an integer array (one per hash function); each seed is
+    expanded to an (odd multiplier, addend) pair for multiply-shift
+    universal hashing.  ``fingerprint(text)`` returns a uint64 vector of
+    length ``len(seeds)``.
+    """
+
+    def __init__(self, seeds, char_ngram: int = 5):
+        seeds = np.asarray(seeds, dtype=np.uint64)
+        rng = np.random.RandomState(
+            np.uint32(np.bitwise_xor.reduce(seeds.astype(np.uint32))) & 0x7FFFFFFF)
+        n = len(seeds)
+        # Odd multipliers + independent addends, one pair per seed.
+        self._a = (rng.randint(1, 2 ** 62, size=n).astype(np.uint64) << np.uint64(1)) | np.uint64(1)
+        self._b = rng.randint(1, 2 ** 62, size=n).astype(np.uint64)
+        self.num_seeds = n
+        self.char_ngram = char_ngram
+
+    @classmethod
+    def from_params(cls, a_bytes: bytes, b_bytes: bytes,
+                    char_ngram: int) -> "MinHasher":
+        """Rebuild a hasher from ``params()`` output (for worker processes:
+        guarantees byte-identical fingerprints to the parent's hasher)."""
+        self = cls.__new__(cls)
+        self._a = np.frombuffer(a_bytes, dtype=_FP_DTYPE).copy()
+        self._b = np.frombuffer(b_bytes, dtype=_FP_DTYPE).copy()
+        self.num_seeds = len(self._a)
+        self.char_ngram = char_ngram
+        return self
+
+    def params(self):
+        return self._a.tobytes(), self._b.tobytes(), self.char_ngram
+
+    def fingerprint(self, text: str) -> np.ndarray:
+        base = _base_hashes(shingles(text, self.char_ngram))
+        if base.size == 0:
+            # Degenerate (too-short) document: constant fingerprint so it
+            # buckets with other degenerates instead of crashing.
+            return np.zeros((self.num_seeds,), dtype=_FP_DTYPE)
+        with np.errstate(over="ignore"):
+            # [seeds, 1] * [1, shingles] + [seeds, 1], uint64 wraparound.
+            table = self._a[:, None] * base[None, :] + self._b[:, None]
+        return table.min(axis=1)
+
+
+class LSHCache:
+    """Banded LSH index (drop-in for ``lsh.cache.Cache``).
+
+    ``bins`` is a list of ``num_bands`` dicts mapping bucket-key -> set of
+    doc ids; documents sharing any bucket are near-duplicate candidates.
+    Pickles cleanly (pure dict/set state) for fingerprint save/load.
+    """
+
+    def __init__(self, num_bands: int, hasher: MinHasher):
+        if hasher.num_seeds % num_bands != 0:
+            raise ValueError(
+                f"num_seeds ({hasher.num_seeds}) must be divisible by "
+                f"num_bands ({num_bands})")
+        self.num_bands = num_bands
+        self.rows_per_band = hasher.num_seeds // num_bands
+        self.hasher = hasher
+        self.bins = [dict() for _ in range(num_bands)]
+        self.fingerprints = {}
+
+    def add_fingerprint(self, fingerprint: np.ndarray, doc_id) -> None:
+        self.fingerprints[doc_id] = fingerprint
+        r = self.rows_per_band
+        for band, bucket in enumerate(self.bins):
+            # blake2b, NOT the builtin hash(): bucket keys must be stable
+            # across processes (hash() is salted per interpreter), or a
+            # pickled index could never match keys computed after load.
+            key = hashlib.blake2b(
+                fingerprint[band * r:(band + 1) * r].tobytes(),
+                digest_size=8).digest()
+            bucket.setdefault(key, set()).add(doc_id)
+
+    def add_doc(self, text: str, doc_id) -> None:
+        self.add_fingerprint(self.hasher.fingerprint(text), doc_id)
+
+    def candidate_pairs(self):
+        """All unordered candidate pairs across every bucket (exact small-
+        corpus path; the CLI uses per-bucket heuristics instead)."""
+        pairs = set()
+        for bucket in self.bins:
+            for ids in bucket.values():
+                if len(ids) > 1:
+                    items = sorted(ids)
+                    for i in range(len(items)):
+                        for j in range(i + 1, len(items)):
+                            pairs.add((items[i], items[j]))
+        return pairs
+
+
+def jaccard(set_a: set, set_b: set, mode: str = "union") -> float:
+    """Jaccard similarity with the reference's three normalizations
+    (``find_duplicates.py:24-36``): 'union' (true Jaccard), 'min', 'max'."""
+    if len(set_a) < 1 or len(set_b) < 1:
+        return 0.0
+    inter = len(set_a & set_b)
+    if mode == "min":
+        return inter / min(len(set_a), len(set_b))
+    if mode == "max":
+        return inter / max(len(set_a), len(set_b))
+    return inter / len(set_a | set_b)

@@ -1,0 +1,62 @@
+"""Drop all-but-one document of every duplicate group from a jsonl corpus.
+
+Stage 4 of the dedup pipeline (reference:
+``tools/openwebtext/remove_group_duplicates.py:1-56``): for each group
+line ``{"idx": [id, id, ...]}`` keep the first id and mark the rest for
+removal, then stream the corpus and drop marked documents.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def ids_to_remove(group_lines):
+    remove = set()
+    for line in group_lines:
+        rec = json.loads(line)
+        for ids in rec.values():
+            remove.update(ids[1:])  # keep the first member of each group
+    return remove
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="remove grouped duplicate docs from a jsonl corpus")
+    p.add_argument("groups", help="group jsonl from group_duplicate_urls.py")
+    p.add_argument("data", help="input corpus jsonl")
+    p.add_argument("output", help="deduplicated corpus jsonl out")
+    p.add_argument("--key", default="url",
+                   help="json field holding the doc id (default: url)")
+    args = p.parse_args(argv)
+
+    with open(args.groups, "r", encoding="utf-8") as f:
+        remove = ids_to_remove(f)
+    print(f"will be removing {len(remove)} documents", flush=True)
+
+    written = removed = removed_chars = 0
+    start = time.time()
+    with open(args.output, "w", encoding="utf-8") as fout, \
+            open(args.data, "r", encoding="utf-8") as fin:
+        for line in fin:
+            try:
+                rec = json.loads(line)
+                if rec[args.key] in remove:
+                    removed += 1
+                    removed_chars += len(rec.get("text", ""))
+                    continue
+                fout.write(json.dumps(rec, ensure_ascii=False) + "\n")
+                written += 1
+            except Exception as exc:
+                print(f"[SKIPPING] {exc}", flush=True)
+
+    print(f"written: {written} | removed: {removed} "
+          f"({removed_chars} chars) in {time.time() - start:.2f}s",
+          flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
