@@ -460,6 +460,10 @@ def _load_cached_tpu(failures):
             rec = json.load(f)
         age_h = (time.time() - rec.get("measured_at_unix", 0)) / 3600.0
         rec["measured_live"] = False
+        # Top-level staleness marker for consumers that grab the last
+        # JSON line without reading measured_live/measured_at_commit:
+        # this number is a replayed earlier-commit measurement, not HEAD.
+        rec["stale"] = True
         rec["tpu_fallback_reason"] = (
             "live TPU attempts failed ("
             + "; ".join(failures)

@@ -80,8 +80,34 @@ def generate(
         rolling_cache = (window is not None
                          and toks.shape[1] + tokens_to_generate > window)
 
-    def one_tok(text):
+    def one_tok(text, quiet=False):
+        # Resolve ``text`` to the single token id it produces
+        # mid-sequence.  BPE vocabs encode '\n' to one id; sentencepiece-
+        # style tokenizers can encode it to [] (stripped) or to multiple /
+        # context-dependent ids, where blindly taking ids[-1] would make
+        # the stop/ban target the wrong id and silently never fire.
         ids = tokenizer.tokenize(text)
+        if len(ids) == 1:
+            return ids[0]
+        # Retry with a leading anchor: if 'a'+text adds exactly one id
+        # over 'a', that id is the real mid-sequence encoding.  Guarded:
+        # int-only tokenizers (NullTokenizer) raise on alphabetic input,
+        # and the graceful answer there is the old None-disable.
+        try:
+            anchor = tokenizer.tokenize("a")
+            ctx = tokenizer.tokenize("a" + text)
+        except Exception:
+            anchor = ctx = None
+        if ctx is not None and len(ctx) == len(anchor) + 1 \
+                and ctx[:len(anchor)] == anchor:
+            return ctx[-1]
+        if not quiet:  # "\n\n" callers expect multi-token encodings
+            import warnings
+            warnings.warn(
+                f"tokenizer encodes {text!r} to {len(ids)} ids "
+                f"({ids}); stop/ban rules targeting it are "
+                + ("disabled" if not ids
+                   else "approximate (using last id)"))
         return ids[-1] if ids else None
 
     extra_stop, stop_pairs, ban_pairs = [], [], []
@@ -90,7 +116,9 @@ def generate(
         if stop_on_eol and eol is not None:
             extra_stop.append(eol)
         if stop_on_double_eol:
-            dbl = one_tok("\n\n")
+            # quiet: "\n\n" legitimately encodes to two eol ids on many
+            # tokenizers, and that case is fully handled by stop_pairs.
+            dbl = one_tok("\n\n", quiet=True)
             if dbl is not None and dbl != eol:
                 extra_stop.append(dbl)      # single '\n\n' merge token
             if eol is not None:

@@ -108,7 +108,15 @@ def score_choices(model, params, tokenizer, samples, seq_len: int,
     Every (sample, choice) pair becomes one row [seq_len + 1]; rows are
     batched through one jitted scorer that returns the summed (or
     length-averaged) continuation log-prob with pad/context positions
-    masked out."""
+    masked out.
+
+    Tokenization boundary convention: context and continuation are
+    tokenized independently and concatenated -- the lm-eval-harness
+    convention, so accuracies are comparable with published numbers even
+    though tokenize(ctx)+tokenize(cont) can differ from tokenize(ctx+cont)
+    at BPE merge boundaries.  Rows longer than seq_len+1 are
+    left-truncated; how many lost context (and whether any continuation
+    was clipped) is counted and reported instead of truncating silently."""
 
     @jax.jit
     def row_scores(params, tokens, cont_mask):
@@ -126,6 +134,7 @@ def score_choices(model, params, tokenizer, samples, seq_len: int,
         return s
 
     rows, meta = [], []
+    ctx_truncated = ctx_gone = cont_clipped = 0
     for si, s in enumerate(samples):
         for ci, choice in enumerate(s["choices"]):
             ctx = (s["contexts"][ci] if "contexts" in s
@@ -134,6 +143,13 @@ def score_choices(model, params, tokenizer, samples, seq_len: int,
             cont_ids = tokenizer.tokenize(choice)
             if not cont_ids:
                 cont_ids = [pad_id]
+            total = len(ctx_ids) + len(cont_ids)
+            if total > seq_len + 1:
+                ctx_truncated += 1
+                if len(cont_ids) >= seq_len + 1:
+                    ctx_gone += 1
+                    if len(cont_ids) > seq_len + 1:
+                        cont_clipped += 1
             ids = (ctx_ids + cont_ids)[-(seq_len + 1):]
             n_cont = min(len(cont_ids), len(ids))
             row = np.full(seq_len + 1, pad_id, np.int32)
@@ -142,6 +158,14 @@ def score_choices(model, params, tokenizer, samples, seq_len: int,
             cmask[len(ids) - n_cont:len(ids)] = 1
             rows.append((row, cmask))
             meta.append((si, ci))
+
+    if ctx_truncated:
+        print(f" > WARNING: {ctx_truncated}/{len(rows)} rows were "
+              f"left-truncated to seq_len+1={seq_len + 1} "
+              f"({ctx_gone} lost their entire context, "
+              f"{cont_clipped} had the continuation itself clipped); "
+              f"accuracies may drift from full-context reference numbers",
+              flush=True)
 
     scores = np.full((len(samples), max(len(s["choices"])
                                         for s in samples)), -np.inf)

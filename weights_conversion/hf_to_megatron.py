@@ -300,7 +300,11 @@ def convert_gpt_neox(hf_model, dtype=np.float32):
         "parallel_layernorm": bool(hf_cfg.use_parallel_residual),
         "tie_embed_logits": False,
         "rotary_percent": hf_cfg.rotary_pct,
-        "rope_theta": getattr(hf_cfg, "rotary_emb_base", 10000.0),
+        # transformers renamed rotary_emb_base -> rope_theta across
+        # versions; chain the lookup so neither spelling silently falls
+        # back to 10000 for models trained with a different base.
+        "rope_theta": (getattr(hf_cfg, "rotary_emb_base", None)
+                       or getattr(hf_cfg, "rope_theta", 10000.0)),
         "layernorm_epsilon": hf_cfg.layer_norm_eps,
         "hidden_dropout": 0.0,
         "attention_dropout": 0.0,
