@@ -273,6 +273,13 @@ def greedy_generate(model, params, prompt_tokens, prompt_lengths,
 # Beam search (reference: generation.py:288-416 + beam_utils.py)
 # ---------------------------------------------------------------------------
 
+@functools.partial(
+    jax.jit,
+    # length_penalty is deliberately TRACED (it only feeds a trailing
+    # scalar power): the server reads it per request, and a static arg
+    # would recompile the whole decode for every new value.
+    static_argnames=("model", "beam_size", "max_new_tokens", "eod_id"),
+)
 def beam_search(
     model,
     params,
@@ -285,34 +292,57 @@ def beam_search(
 ):
     """Single-prompt beam search.  Beams ride the batch axis; the KV cache
     is gathered along batch on every reorder (the reference mutates
-    per-layer cache tensors in place, generation.py:288-416)."""
+    per-layer cache tensors in place, generation.py:288-416).
+
+    Jitted with a ``lax.while_loop`` decode (early-exits when every beam
+    hit EOD), like ``generate_tokens``: one compile instead of a Python
+    step loop — and with a mesh active the GSPMD activation constraints
+    compile for any beam count, so beams serve under tp-sharded params
+    exactly like sampling (the reference serves beams through the same
+    TP x PP path, api.py:147-201)."""
     cfg = model.cfg
     _, prompt_len = prompt_tokens.shape
     total = prompt_len + max_new_tokens
     B = beam_size
 
+    # prefill ONCE at batch 1, then broadcast the caches across the beam
+    # axis (all beams share the prompt; a tiled prefill would do B-fold
+    # redundant FLOPs and cache writes)
+    caches = init_kv_caches(cfg, 1, total)
+    logits, caches = _forward_with_cache(
+        model, params, prompt_tokens, caches, 0
+    )
+    caches = [dict(c,
+                   k=jnp.broadcast_to(c["k"], (B,) + c["k"].shape[1:]),
+                   v=jnp.broadcast_to(c["v"], (B,) + c["v"].shape[1:]))
+              for c in caches]
+    lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+
     tokens = jnp.tile(prompt_tokens, (B, 1))
     tokens = jnp.concatenate(
         [tokens, jnp.zeros((B, max_new_tokens), tokens.dtype)], axis=1
     )
-    caches = init_kv_caches(cfg, B, total)
-    logits, caches = _forward_with_cache(
-        model, params, tokens[:, :prompt_len], caches, 0
-    )
-    lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
 
     # first expansion: take top beam_size from beam 0 only
     top_lp, top_idx = jax.lax.top_k(lp[0], B)
     scores = top_lp
     tokens = tokens.at[:, prompt_len].set(top_idx.astype(tokens.dtype))
     done = top_idx == eod_id
+    # per-hypothesis token count (prompt + own generated tokens, incl. a
+    # closing EOD; NOT the filler EODs finished beams keep appending)
+    hyp_len = jnp.full((B,), prompt_len + 1, jnp.int32)
 
     V = lp.shape[-1]
-    for step in range(1, max_new_tokens):
-        pos = prompt_len + step - 1
-        logits, caches = _forward_with_cache(
-            model, params, tokens[:, pos][:, None], caches, pos
-        )
+
+    def cond(state):
+        pos, _, _, _, done, _ = state
+        return (pos < total - 1) & ~jnp.all(done)
+
+    def body(state):
+        pos, tokens, caches, scores, done, hyp_len = state
+        cur = jax.lax.dynamic_index_in_dim(tokens, pos, 1, keepdims=True)
+        logits, caches = _forward_with_cache(model, params, cur, caches,
+                                             pos)
         lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
         # finished beams only propose EOD with frozen score
         lp = jnp.where(done[:, None],
@@ -322,19 +352,22 @@ def beam_search(
         beam_src = flat_idx // V
         tok_next = (flat_idx % V).astype(tokens.dtype)
 
-        tokens = tokens[beam_src]
-        tokens = tokens.at[:, pos + 1].set(tok_next)
-        caches = [
-            {"k": c["k"][beam_src], "v": c["v"][beam_src], "index": c["index"]}
-            for c in caches
-        ]
-        scores = flat_scores
-        done = done[beam_src] | (tok_next == eod_id)
-        if bool(jnp.all(done)):
-            break
+        tokens = jax.lax.dynamic_update_slice(
+            tokens[beam_src], tok_next[:, None], (0, pos + 1)
+        )
+        caches = [dict(c, k=c["k"][beam_src], v=c["v"][beam_src])
+                  for c in caches]
+        was_done = done[beam_src]
+        hyp_len = hyp_len[beam_src] + jnp.where(was_done, 0, 1)
+        done = was_done | (tok_next == eod_id)
+        return (pos + 1, tokens, caches, flat_scores, done, hyp_len)
 
-    # length-penalised final ranking (reference beam_utils score/len**alpha)
-    lengths = jnp.sum(tokens != 0, axis=1).astype(jnp.float32)
-    final = scores / (lengths ** length_penalty)
+    state = (jnp.int32(prompt_len), tokens, caches, scores, done, hyp_len)
+    _, tokens, _, scores, _, hyp_len = jax.lax.while_loop(cond, body, state)
+
+    # length-penalised final ranking (reference beam_utils score/len**alpha),
+    # normalized by each hypothesis's OWN length so a beam's rank never
+    # depends on when the other beams finished
+    final = scores / (hyp_len.astype(jnp.float32) ** length_penalty)
     order = jnp.argsort(-final)
     return tokens[order], final[order]

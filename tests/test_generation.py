@@ -304,6 +304,36 @@ def test_sharded_generation_matches_unsharded(model_and_params, utils,
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.parametrize("tp", [2])
+def test_sharded_beam_search_matches_unsharded(model_and_params, utils, tp):
+    """Beam search with tp-sharded params must return the same beams and
+    scores as the unsharded run (the reference serves beams through the
+    same TP x PP path as sampling: megatron/text_generation/api.py:147-201
+    -> forward_step.py)."""
+    model, params = model_and_params
+    toks = jnp.asarray([[1, 2, 3]])
+
+    want_beams, want_scores = beam_search(
+        model, params, toks, beam_size=3, max_new_tokens=5, eod_id=63)
+
+    from megatron_llm_tpu.parallel import sharding as sh
+
+    utils.initialize_model_parallel(tp=tp)
+    try:
+        params_sh = sh.shard_params(params, model.param_specs(params))
+        got_beams, got_scores = beam_search(
+            model, params_sh, toks, beam_size=3, max_new_tokens=5,
+            eod_id=63)
+        spec = params_sh["lm_head"]["weight"].sharding.spec
+        assert "tp" in spec, f"head not vocab-sharded: {spec}"
+    finally:
+        utils.destroy_model_parallel()
+    np.testing.assert_array_equal(np.asarray(got_beams),
+                                  np.asarray(want_beams))
+    np.testing.assert_allclose(np.asarray(got_scores),
+                               np.asarray(want_scores), atol=2e-5)
+
+
 def test_microbatched_prefill_matches_monolithic(model_and_params):
     """batch_times_seqlen_threshold splits the prefill forward into
     micro-batches (reference forward_step.py:17-204); the generated
