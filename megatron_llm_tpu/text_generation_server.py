@@ -13,6 +13,7 @@ broadcast loop — one controller drives all chips.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -58,6 +59,17 @@ class MegatronGenerate:
         if tokens_to_generate > MAX_TOKENS:
             return 400, {"message": f"maximum tokens_to_generate is {MAX_TOKENS}"}
         logprobs = bool(payload.get("logprobs", False))
+        try:
+            return self._handle_sampling(payload, prompts,
+                                         tokens_to_generate, logprobs,
+                                         add_BOS)
+        except (TypeError, ValueError) as exc:
+            # e.g. a null/None knob from a UI with a cleared field:
+            # int(None)/float(None) must be a 400, not a dead socket
+            return 400, {"message": f"malformed parameter: {exc}"}
+
+    def _handle_sampling(self, payload, prompts, tokens_to_generate,
+                         logprobs, add_BOS):
         top_k = int(payload.get("top_k", 0))
         if top_k < 0 or top_k > 1000:
             return 400, {"message": "top_k must be in [0, 1000]"}
@@ -152,9 +164,33 @@ class MegatronServer:
 
             do_POST = do_PUT
 
+            def do_GET(self):
+                # Demo page (reference serves megatron/static/index.html
+                # through Flask; here it rides the same stdlib server).
+                if self.path in ("/", "/index.html"):
+                    page = os.path.join(os.path.dirname(
+                        os.path.abspath(__file__)), "static", "index.html")
+                    try:
+                        with open(page, "rb") as f:
+                            data = f.read()
+                    except OSError:
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                else:
+                    self.send_error(404)
+
             def log_message(self, fmt, *args):
                 pass
 
         server = ThreadingHTTPServer((host, port), Handler)
-        print(f" * serving on http://{host}:{port}/api", flush=True)
+        # exposed for tests / embedding (port may be ephemeral: port=0)
+        self.httpd = server
+        print(f" * serving on http://{host}:{server.server_address[1]}/"
+              f" (demo page) and /api", flush=True)
         server.serve_forever()

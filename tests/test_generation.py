@@ -184,6 +184,64 @@ def test_server_contract(model_and_params):
     httpd_holder["srv"].shutdown()
 
 
+def test_server_demo_page_and_real_handler(model_and_params):
+    """The REAL MegatronServer.run handler (not a test stub): GET /
+    serves the demo page (reference serves megatron/static/index.html),
+    PUT /api generates, unknown paths 404."""
+    from megatron_llm_tpu.text_generation_server import MegatronServer
+
+    model, params = model_and_params
+    server = MegatronServer(model, params, _FakeTokenizer())
+    t = threading.Thread(
+        target=server.run, kwargs={"host": "127.0.0.1", "port": 0},
+        daemon=True)
+    t.start()
+    import time
+
+    for _ in range(100):
+        if getattr(server, "httpd", None) is not None:
+            break
+        time.sleep(0.05)
+    assert getattr(server, "httpd", None) is not None, \
+        "server.run() never bound (thread died during startup?)"
+    port = server.httpd.server_address[1]
+
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/html")
+            page = resp.read().decode()
+        assert "playground" in page and '"api"' in page
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api",
+            data=json.dumps({"prompts": ["1 2 3"],
+                             "tokens_to_generate": 4}).encode(),
+            method="PUT")
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())
+        assert "text" in out and len(out["text"]) == 1
+
+        # a null knob (cleared UI field) must be a 400, not a dead socket
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api",
+            data=json.dumps({"prompts": ["1 2 3"], "top_k": None}).encode(),
+            method="PUT")
+        try:
+            urllib.request.urlopen(req)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.httpd.shutdown()
+
+
 def test_extra_stop_ids_and_pairs(model_and_params):
     """stop_on_eol/double-eol semantics: a row stops at an extra stop id
     or a (prev, cur) bigram exactly like eod."""
