@@ -55,6 +55,15 @@ CONFIGS = {
         hbm_gb=16, tp=8, pp=1, vpp=None, seq=4096, micro_batch=1,
         num_micro=1, zero1=False, recompute="full",
     ),
+    # trainable-batch 7B on v5e (VERDICT r4 #6): the tp8/mb1/M1 row above
+    # is an existence proof with 0.17 GB headroom; this one is a config
+    # you could actually train — v5e-16, tp=8 x dp=2, ZeRO-1 over dp,
+    # M=8 microbatches (16 seqs/step at seq 4096), full recompute
+    "llama2-7b-v5e16-m8": dict(
+        family="llama2", size="7B", topology="v5e:4x4", accel="v5litepod-16",
+        hbm_gb=16, tp=8, pp=1, vpp=None, seq=4096, micro_batch=1,
+        num_micro=8, zero1=True, recompute="full",
+    ),
     # milestone 4: Falcon-40B TP=8 x PP=4 (32 x v5p, 95 GB HBM/chip)
     "falcon-40b-tp8pp4": dict(
         family="falcon", size="40B", topology="v5p:4x4x2", accel="v5p-64",
