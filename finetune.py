@@ -51,6 +51,10 @@ MODEL_DEFAULTS = {
     "llama2": dict(position_embedding_type="rotary", glu_activation="swiglu",
                    use_rms_norm=True, use_bias=False, tie_embed_logits=False,
                    hidden_dropout=0.0, attention_dropout=0.0),
+    "llama3": dict(position_embedding_type="rotary", glu_activation="swiglu",
+                   use_rms_norm=True, use_bias=False, tie_embed_logits=False,
+                   rope_theta=500000.0,
+                   hidden_dropout=0.0, attention_dropout=0.0),
     "codellama": dict(position_embedding_type="rotary", glu_activation="swiglu",
                       use_rms_norm=True, use_bias=False,
                       tie_embed_logits=False, rope_theta=1e6,
@@ -235,6 +239,7 @@ _CKPT_ARG_MAP = {
     "layernorm_epsilon": "layernorm_epsilon",
     "rope_theta": "rope_theta",
     "rope_scaling_factor": "rope_scaling_factor",
+    "rope_llama3_scaling": "rope_llama3_scaling",
     # MoE architecture fields: a dense rebuild of an MoE checkpoint (or
     # vice versa) fails orbax restore on the param-tree mismatch
     "num_experts": "num_experts",

@@ -97,6 +97,12 @@ def _add_network_size_args(parser):
                    choices=["learned_absolute", "rotary"])
     g.add_argument("--rope_scaling_factor", type=float, default=1.0)
     g.add_argument("--rope_theta", type=float, default=10000.0)
+    g.add_argument("--rope_llama3_scaling", type=float, nargs=4,
+                   default=None,
+                   metavar=("FACTOR", "LOW_FREQ", "HIGH_FREQ", "ORIG_MAX"),
+                   help="Llama-3.1 NTK-by-parts rope remap: factor "
+                        "low_freq_factor high_freq_factor "
+                        "original_max_position (e.g. 8 1 4 8192)")
     g.add_argument("--layernorm_epsilon", type=float, default=1e-5)
     g.add_argument("--use_rms_norm", action="store_true")
     g.add_argument("--use_post_ln", action="store_true")
@@ -515,6 +521,9 @@ def transformer_config_from_args(args, model_name: Optional[str] = None
         position_embedding_type=args.position_embedding_type,
         rope_scaling_factor=args.rope_scaling_factor,
         rope_theta=args.rope_theta,
+        rope_llama3_scaling=(tuple(args.rope_llama3_scaling)
+                             if getattr(args, "rope_llama3_scaling", None)
+                             else None),
         tie_embed_logits=args.tie_embed_logits,
         normalization="rmsnorm" if args.use_rms_norm else "layernorm",
         layernorm_epsilon=args.layernorm_epsilon,

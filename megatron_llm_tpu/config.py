@@ -101,6 +101,12 @@ class TransformerConfig:
     # (reference: megatron/model/positional_embeddings.py:7-14, --rope_scaling_factor)
     rope_scaling_factor: float = 1.0
     rope_theta: float = 10000.0
+    # Llama-3.1 NTK-by-parts rope remap (beyond-reference; HF
+    # rope_scaling={'rope_type': 'llama3', ...}).  None = off; otherwise
+    # (factor, low_freq_factor, high_freq_factor,
+    # original_max_position) — a tuple so the config stays hashable
+    # (it rides jit static args).
+    rope_llama3_scaling: Optional[Tuple[float, float, float, int]] = None
     # reference: --no_tie_embed_logits -> untied lm_head
     # (megatron/model/language_model.py:436-457)
     tie_embed_logits: bool = True

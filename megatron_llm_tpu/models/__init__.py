@@ -24,6 +24,7 @@ MODEL_REGISTRY = {
     "gpt": GPTModel,
     "llama": LlamaModel,
     "llama2": LlamaModel,
+    "llama3": LlamaModel,
     "codellama": LlamaModel,
     "falcon": FalconModel,
     "mistral": MistralModel,

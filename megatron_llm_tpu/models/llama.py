@@ -38,6 +38,20 @@ def llama_config(size: str = "7B", **overrides) -> TransformerConfig:
         "70B": dict(num_layers=80, hidden_size=8192, num_attention_heads=64,
                     num_attention_heads_kv=8, ffn_hidden_size=28672,
                     padded_vocab_size=32000),
+        # Llama-3 family (beyond the reference's table): GQA at every
+        # size, 128k vocab, theta 5e5, seq 8192
+        "llama3-8B": dict(num_layers=32, hidden_size=4096,
+                          num_attention_heads=32, num_attention_heads_kv=8,
+                          ffn_hidden_size=14336, padded_vocab_size=128256,
+                          rope_theta=500000.0, seq_length=8192,
+                          max_position_embeddings=8192),
+        "llama3-70B": dict(num_layers=80, hidden_size=8192,
+                           num_attention_heads=64,
+                           num_attention_heads_kv=8,
+                           ffn_hidden_size=28672,
+                           padded_vocab_size=128256,
+                           rope_theta=500000.0, seq_length=8192,
+                           max_position_embeddings=8192),
     }
     base = dict(
         position_embedding_type=PositionEmbeddingType.rotary,

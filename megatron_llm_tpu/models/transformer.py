@@ -770,9 +770,13 @@ def rotary_freqs(cfg: TransformerConfig, seq_len: Optional[int] = None):
         return None
     rot_d = int(cfg.head_dim * cfg.rotary_percent)
     rot_d -= rot_d % 2
+    l3 = cfg.rope_llama3_scaling
     return precompute_freqs_cis(
         rot_d,
         seq_len or cfg.max_position_embeddings,
         theta=cfg.rope_theta,
         scaling_factor=cfg.rope_scaling_factor,
+        llama3_scaling=(dict(zip(
+            ("factor", "low_freq_factor", "high_freq_factor",
+             "original_max_position"), l3)) if l3 else None),
     )

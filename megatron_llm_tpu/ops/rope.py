@@ -23,19 +23,56 @@ import jax
 import jax.numpy as jnp
 
 
+def llama3_scale_freqs(
+    freqs: jax.Array,
+    factor: float = 8.0,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_position: int = 8192,
+) -> jax.Array:
+    """Llama-3.1 NTK-by-parts frequency remap (the published scheme, as
+    in HF ``modeling_rope_utils._compute_llama3_parameters``): leave
+    high-frequency components (wavelength shorter than
+    original_max/high_freq_factor) untouched, divide low-frequency
+    components (wavelength longer than original_max/low_freq_factor) by
+    ``factor``, and smoothly interpolate between the two bands."""
+    two_pi = 2.0 * jnp.pi
+    wavelen = two_pi / freqs
+    low_freq_wavelen = original_max_position / low_freq_factor
+    high_freq_wavelen = original_max_position / high_freq_factor
+    # smooth factor in the interpolation band
+    smooth = (original_max_position / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    interp = (1.0 - smooth) * (freqs / factor) + smooth * freqs
+    out = jnp.where(wavelen > low_freq_wavelen, freqs / factor, freqs)
+    in_band = (wavelen <= low_freq_wavelen) & (wavelen >= high_freq_wavelen)
+    return jnp.where(in_band, interp, out)
+
+
 def precompute_freqs_cis(
     dim: int,
     end: int,
     theta: float = 10000.0,
     scaling_factor: float = 1.0,
+    llama3_scaling: dict | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (cos, sin), each [end, dim // 2], fp32.
 
     reference: positional_embeddings.py:7-14 (including ``t /= scaling_factor``).
+    ``llama3_scaling``: optional kwargs for :func:`llama3_scale_freqs`
+    (Llama-3.1+ checkpoints; mutually exclusive with linear scaling).
     """
     freqs = 1.0 / (
         theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32)[: dim // 2] / dim)
     )
+    if llama3_scaling:
+        if scaling_factor != 1.0:
+            raise ValueError(
+                "rope llama3 scaling and linear scaling_factor "
+                f"({scaling_factor}) are mutually exclusive — no "
+                "checkpoint is trained with both")
+        freqs = llama3_scale_freqs(freqs, **llama3_scaling)
     t = jnp.arange(end, dtype=jnp.float32) / scaling_factor
     freqs = jnp.outer(t, freqs)  # [end, dim/2]
     return jnp.cos(freqs), jnp.sin(freqs)

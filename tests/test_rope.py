@@ -4,6 +4,7 @@ scaling, position_ids."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from megatron_llm_tpu.ops.rope import apply_rotary_emb, precompute_freqs_cis
 
@@ -67,3 +68,34 @@ def test_norm_preserved():
     n_in = np.linalg.norm(x.reshape(2, 16, 4, 4, 2), axis=-1)
     n_out = np.linalg.norm(out.reshape(2, 16, 4, 4, 2), axis=-1)
     np.testing.assert_allclose(n_in, n_out, atol=1e-4)
+
+
+def test_llama3_scale_freqs_matches_hf():
+    """ops.rope.llama3_scale_freqs reproduces HF's llama3 rope init
+    (transformers.modeling_rope_utils._compute_llama3_parameters) over
+    all three bands: untouched high-freq, /factor low-freq, and the
+    smooth interpolation between."""
+    pytest.importorskip("transformers")
+    from transformers import LlamaConfig
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from megatron_llm_tpu.ops.rope import llama3_scale_freqs
+
+    hf_cfg = LlamaConfig(
+        rope_theta=500000.0, hidden_size=256, num_attention_heads=2,
+        max_position_embeddings=65536,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 8192})
+    hf_inv, _ = ROPE_INIT_FUNCTIONS["llama3"](hf_cfg, "cpu")
+    base = 1.0 / (500000.0
+                  ** (np.arange(0, 128, 2, dtype=np.float32) / 128))
+    mine = np.asarray(llama3_scale_freqs(jnp.asarray(base),
+                                         8.0, 1.0, 4.0, 8192))
+    np.testing.assert_allclose(mine, hf_inv.numpy(), rtol=1e-6)
+    # all three bands actually exercised
+    ratio = mine / base
+    assert (np.isclose(ratio, 1.0)).any(), "no untouched high-freq band"
+    assert (np.isclose(ratio, 1 / 8.0)).any(), "no /factor low-freq band"
+    assert ((ratio > 1 / 8.0 + 1e-3) & (ratio < 1.0 - 1e-3)).any(), \
+        "no interpolation band"

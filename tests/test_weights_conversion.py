@@ -83,6 +83,46 @@ def test_hf_llama_logit_parity():
     assert np.abs(hf_logits - my_logits).max() < 1e-5
 
 
+def test_hf_llama3_logit_parity_rope_scaling():
+    """Llama-3.1-style checkpoint: GQA + theta 5e5 + the llama3
+    NTK-by-parts rope remap.  The converted config must carry
+    rope_llama3_scaling and reproduce HF logits (which exercises
+    ops.rope.llama3_scale_freqs against HF's
+    _compute_llama3_parameters)."""
+    from transformers import LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = _tiny_llama_cfg(
+        rope_theta=500000.0, max_position_embeddings=128,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32})
+    hf = LlamaForCausalLM(hf_cfg).eval()
+    params, config = convert_llama_family(hf)
+    assert config["rope_theta"] == 500000.0
+    assert config["rope_llama3_scaling"] == (8.0, 1.0, 4.0, 32)
+    cfg = TransformerConfig(**config, use_flash_attn=False)
+    model = LlamaModel(cfg)
+
+    # positions past original_max (32) are exactly where the remap bites
+    toks = np.random.RandomState(0).randint(0, 128, (2, 96))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(toks)).logits.numpy()
+    my_logits = np.asarray(model(params, jnp.asarray(toks), train=False))
+    assert np.abs(hf_logits - my_logits).max() < 2e-5
+
+    # round trip: the regenerated HF config carries the same rope_scaling
+    hf_cfg2 = hf_config_for("llama3", config)
+    assert hf_cfg2.rope_scaling["rope_type"] == "llama3"
+    assert hf_cfg2.rope_scaling["factor"] == 8.0
+    assert hf_cfg2.rope_theta == 500000.0
+    sd_back = llama_family_state_dict(params, config)
+    sd_orig = hf.state_dict()
+    for k, v in sd_back.items():
+        np.testing.assert_allclose(
+            v.numpy(), sd_orig[k].numpy(), atol=1e-6, err_msg=k)
+
+
 def test_hf_mistral_logit_parity_sliding_window():
     from transformers import MistralConfig, MistralForCausalLM
 

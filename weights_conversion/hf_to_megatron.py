@@ -169,6 +169,26 @@ def convert_llama_family(hf_model, dtype=np.float32, *, layer_mlp=None,
         "hidden_dropout": 0.0,
         "attention_dropout": 0.0,
     }
+    # HF rope_scaling: {'rope_type': 'llama3', ...} (Llama-3.1+) or
+    # {'rope_type'/'type': 'linear', 'factor': f}
+    rs = getattr(hf_cfg, "rope_scaling", None)
+    if rs:
+        kind = rs.get("rope_type") or rs.get("type")
+        if kind == "llama3":
+            config["rope_llama3_scaling"] = (
+                float(rs.get("factor", 8.0)),
+                float(rs.get("low_freq_factor", 1.0)),
+                float(rs.get("high_freq_factor", 4.0)),
+                int(rs.get("original_max_position_embeddings", 8192)),
+            )
+        elif kind == "linear":
+            config["rope_scaling_factor"] = float(rs.get("factor", 1.0))
+        elif kind not in (None, "default"):
+            # yarn/dynamic/longrope/...: converting with plain rope would
+            # silently diverge from HF — fail loud instead
+            raise NotImplementedError(
+                f"unsupported HF rope_scaling type {kind!r} "
+                f"(supported: llama3, linear)")
     return params, config
 
 
@@ -502,6 +522,7 @@ def convert_falcon(hf_model, dtype=np.float32):
 CONVERTERS = {
     "llama": convert_llama_family,
     "llama2": convert_llama_family,
+    "llama3": convert_llama_family,
     "codellama": convert_llama_family,
     "mistral": convert_llama_family,
     "mixtral": convert_mixtral,

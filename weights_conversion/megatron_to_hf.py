@@ -183,8 +183,25 @@ def _index(tree, path, i):
     return tree[i]
 
 
+def _rope_scaling_dict(config: dict):
+    """framework rope fields -> HF ``rope_scaling`` (or None): the
+    llama3 NTK-by-parts tuple wins, else a non-1.0 linear factor."""
+    l3 = config.get("rope_llama3_scaling")
+    if l3:
+        return {
+            "rope_type": "llama3", "factor": l3[0],
+            "low_freq_factor": l3[1], "high_freq_factor": l3[2],
+            "original_max_position_embeddings": int(l3[3]),
+        }
+    if config.get("rope_scaling_factor", 1.0) != 1.0:
+        return {"rope_type": "linear",
+                "factor": config["rope_scaling_factor"]}
+    return None
+
+
 def hf_config_for(model_name: str, config: dict):
-    if model_name in ("llama", "llama2", "codellama"):
+    rope_scaling = _rope_scaling_dict(config)
+    if model_name in ("llama", "llama2", "llama3", "codellama"):
         from transformers import LlamaConfig
 
         return LlamaConfig(
@@ -197,6 +214,7 @@ def hf_config_for(model_name: str, config: dict):
             max_position_embeddings=config["max_position_embeddings"],
             rms_norm_eps=config.get("layernorm_epsilon", 1e-5),
             rope_theta=config.get("rope_theta", 10000.0),
+            rope_scaling=rope_scaling,
             tie_word_embeddings=False,
         )
     if model_name == "mistral":
@@ -211,6 +229,8 @@ def hf_config_for(model_name: str, config: dict):
             num_key_value_heads=config.get("num_attention_heads_kv"),
             max_position_embeddings=config["max_position_embeddings"],
             rms_norm_eps=config.get("layernorm_epsilon", 1e-5),
+            rope_theta=config.get("rope_theta", 10000.0),
+            rope_scaling=rope_scaling,
             sliding_window=config.get("sliding_window_size", 4096),
             tie_word_embeddings=False,
         )
@@ -227,6 +247,7 @@ def hf_config_for(model_name: str, config: dict):
             max_position_embeddings=config["max_position_embeddings"],
             rms_norm_eps=config.get("layernorm_epsilon", 1e-5),
             rope_theta=config.get("rope_theta", 1e6),
+            rope_scaling=rope_scaling,
             sliding_window=config.get("sliding_window_size"),
             num_local_experts=config["num_experts"],
             num_experts_per_tok=config.get("moe_top_k", 2),
@@ -281,6 +302,7 @@ def hf_config_for(model_name: str, config: dict):
             max_position_embeddings=config["max_position_embeddings"],
             rms_norm_eps=config.get("layernorm_epsilon", 1e-6),
             rope_theta=config.get("rope_theta", 1e6),
+            rope_scaling=rope_scaling,
             use_sliding_window=config.get("sliding_window_size") is not None,
             sliding_window=config.get("sliding_window_size"),
             tie_word_embeddings=bool(config.get("tie_embed_logits", False)),
