@@ -4,7 +4,7 @@
 # launch: no torchrun — one process per host; multi-host runs set
 # RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT (jax.distributed bootstrap).
 #
-# Usage: examples/finetune.sh <gpt/llama/llama2/codellama/falcon/mistral/mixtral>
+# Usage: examples/finetune.sh <gpt/llama/llama2/llama3/codellama/falcon/mistral/mixtral>
 #        [--tp=8] [--pp=1] [--micro-batch=1] [--global-batch=12]
 #        [--iters=1000] [--checkpoint=...] [--data=...] [--out=...]
 #        [--seq-len=...] [--instruct] [--wandb]
@@ -43,6 +43,13 @@ case $MODEL in
     EXTRA=(--use_rms_norm --glu_activation swiglu --no_tie_embed_logits
            --position_embedding_type rotary --no_bias_gelu_fusion)
     TOKENIZER=SentencePieceTokenizer;;
+  llama3)
+    SEQ_DEFAULT=8192
+    EXTRA=(--use_rms_norm --glu_activation swiglu --no_tie_embed_logits
+           --position_embedding_type rotary --rope_theta 500000
+           --no_bias_gelu_fusion)
+    # llama-3.1+ context extension: add --rope_llama3_scaling 8 1 4 8192
+    TOKENIZER=HFAutoTokenizer;;
   mistral)
     SEQ_DEFAULT=8192
     EXTRA=(--use_rms_norm --glu_activation swiglu --no_tie_embed_logits

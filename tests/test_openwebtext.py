@@ -167,6 +167,62 @@ class TestCleanup:
         assert word_count("a b  c\nd") == 4
 
 
+class TestCleanupFixDataset:
+    def test_task_flags(self, tmp_path):
+        from cleanup_fix_dataset import process_doc
+
+        long_en = _en_doc(words=200)
+        assert process_doc("short", ["remove_512"])[1] == "remove_512"
+        assert process_doc(long_en, ["remove_512"])[1] is None
+        assert process_doc("tiny javascript snippet",
+                           ["remove_256_javascript"])[1] \
+            == "remove_256_javascript"
+        assert process_doc("ein kurzer deutscher text ohne englisch "
+                           "und noch ein paar mehr worte dazu",
+                           ["remove_512_non_english"])[1] \
+            == "remove_512_non_english"
+        fixed, reason = process_doc("Itâ€™s fine. " + long_en,
+                                    ["ftfy_fix_text"])
+        assert reason is None and "’s" in fixed
+        cleaned, _ = process_doc("a  b   c", ["general_cleaning"])
+        assert cleaned == "a b c"
+        # newline-adjacent space runs and post-punctuation newlines too
+        assert process_doc("a\n  b", ["general_cleaning"])[0] == "a b"
+        assert process_doc("end.\n\nNext",
+                           ["general_cleaning"])[0] == "end. Next"
+
+    def test_tasks_apply_in_cli_order(self):
+        from cleanup_fix_dataset import process_doc
+
+        # ~520 chars of mojibake that shrinks under 512 once fixed:
+        # fix-first drops it, filter-first keeps it
+        moji = ("Itâ€™s x " * 65).strip()      # 519 chars raw
+        assert len(moji) >= 512
+        from cleanup_dataset import fix_text
+        assert len(fix_text(moji)) < 512
+        _, reason = process_doc(moji, ["ftfy_fix_text", "remove_512"])
+        assert reason == "remove_512"
+        _, reason = process_doc(moji, ["remove_512", "ftfy_fix_text"])
+        assert reason is None
+
+    def test_cli_splits_kept_and_filtered(self, tmp_path):
+        src = tmp_path / "in.jsonl"
+        docs = [{"text": _en_doc(words=200)}, {"text": "too short"}]
+        with open(src, "w") as f:
+            for d in docs:
+                f.write(json.dumps(d) + "\n")
+        kept = tmp_path / "kept.jsonl"
+        filt = tmp_path / "filtered.jsonl"
+        r = subprocess.run(
+            [sys.executable, os.path.join(OWT, "cleanup_fix_dataset.py"),
+             str(src), str(kept), str(filt),
+             "--tasks", "remove_512", "ftfy_fix_text"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert len(kept.read_text().splitlines()) == 1
+        assert len(filt.read_text().splitlines()) == 1
+
+
 # ------------------------------------------------------- dedup end-to-end
 
 class TestDedupE2E:

@@ -1,0 +1,110 @@
+"""Task-flag document cleaner (reference:
+``tools/openwebtext/cleanup_fix_dataset.py:1-177``): apply a chosen set
+of cleanup tasks to a jsonl corpus, writing kept/cleaned docs to one
+file and removed docs to another.
+
+Tasks (same names as the reference so recipes port unchanged):
+
+- ``remove_512``              drop docs under 512 characters
+- ``remove_256_javascript``   drop docs under 256 chars mentioning
+                              'javascript' (boilerplate/script scrapes)
+- ``remove_512_non_english``  drop short non-English docs (in-repo
+                              stopword heuristic instead of langdetect)
+- ``ftfy_fix_text``           mojibake repair (in-repo ``fix_text``
+                              instead of ftfy)
+- ``general_cleaning``        collapse repeated spaces / stray newlines
+
+Tasks apply in the order given on the command line (reference
+semantics): a filtering task that triggers short-circuits the rest; a
+fixing task rewrites the text that later tasks then see — so
+``--tasks ftfy_fix_text remove_512`` measures length on the FIXED text.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+
+try:
+    from .cleanup_dataset import fix_text, is_english
+except ImportError:  # run as a script
+    from cleanup_dataset import fix_text, is_english
+
+TASKS = ("remove_512", "remove_256_javascript", "remove_512_non_english",
+         "ftfy_fix_text", "general_cleaning")
+
+
+def _general_cleaning(text: str) -> str:
+    # stray newlines (with any surrounding spaces) -> one space, then
+    # collapse space runs — two passes so space runs created by the
+    # newline replacement are themselves collapsed
+    text = re.sub(r"[ \t]*\n+[ \t]*", " ", text)
+    return re.sub(r"  +", " ", text)
+
+
+def process_doc(text: str, tasks) -> tuple:
+    """Returns (new_text, removal_reason or None); ``tasks`` apply in
+    the order given (see module docstring)."""
+    for task in tasks:
+        if task == "remove_512":
+            if len(text) < 512:
+                return text, task
+        elif task == "remove_256_javascript":
+            if len(text) < 256 and "javascript" in text.lower():
+                return text, task
+        elif task == "remove_512_non_english":
+            if len(text) < 512 and not is_english(text):
+                return text, task
+        elif task == "ftfy_fix_text":
+            text = fix_text(text)
+        elif task == "general_cleaning":
+            text = _general_cleaning(text)
+    return text, None
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="task-flag document cleaner: filter/fix a jsonl "
+                    "corpus into kept + removed outputs")
+    p.add_argument("input", help="jsonl corpus in")
+    p.add_argument("output_cleaned", help="kept/cleaned jsonl out")
+    p.add_argument("output_filtered", help="removed docs jsonl out")
+    p.add_argument("--tasks", nargs="+", choices=TASKS, required=True)
+    p.add_argument("--text_key", default="text")
+    args = p.parse_args(argv)
+
+    counts = dict.fromkeys(TASKS, 0)
+    counts.update(docs=0, kept=0, errors=0)
+    start = time.time()
+    with open(args.output_cleaned, "w", encoding="utf-8") as f_clean, \
+            open(args.output_filtered, "w", encoding="utf-8") as f_filt, \
+            open(args.input, "r", encoding="utf-8",
+                 errors="replace") as fin:
+        for line in fin:
+            counts["docs"] += 1
+            try:
+                rec = json.loads(line)
+                new_text, reason = process_doc(rec[args.text_key],
+                                               args.tasks)
+                if reason is not None:
+                    counts[reason] += 1
+                    f_filt.write(json.dumps(rec, ensure_ascii=False)
+                                 + "\n")
+                    continue
+                rec[args.text_key] = new_text
+                f_clean.write(json.dumps(rec, ensure_ascii=False) + "\n")
+                counts["kept"] += 1
+            except Exception as exc:
+                counts["errors"] += 1
+                print(f"  skipping line: {exc}", flush=True)
+
+    print(f"[FINAL] {time.time() - start:.1f}s | " +
+          " | ".join(f"{k}: {v}" for k, v in counts.items() if v),
+          flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
