@@ -459,6 +459,18 @@ def validate_args(args, world_size: Optional[int] = None):
         args.micro_batch_size * args.data_parallel_size
     ) == 0
 
+    # big-vocab fused CE nudge: at >= 64k vocab the materialized
+    # [tokens, vocab] fp32 logits dominate temp memory (compile-level
+    # evidence: docs/scale_aot.md fused-CE note — 2.1x temp, 1.3x HBM
+    # traffic at 128k); the on-chip flip point is still unmeasured, so
+    # advise rather than auto-flip
+    if (not args.fused_lm_cross_entropy
+            and max(args.padded_vocab_size or 0,
+                    getattr(args, "vocab_size", 0) or 0) >= 65536):
+        print(" > NOTE: padded_vocab_size >= 64k — consider "
+              "--fused_lm_cross_entropy (streams the head matmul + CE "
+              "over vocab chunks; see docs/scale_aot.md)", flush=True)
+
     if args.ffn_hidden_size is None and args.hidden_size is not None:
         args.ffn_hidden_size = 4 * args.hidden_size
     if args.kv_channels is None and args.hidden_size is not None:

@@ -391,9 +391,13 @@ def attention(
                 softmax_scale=1.0 / math.sqrt(cfg.head_dim),
             )
     elif use_flash:
-        from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
+        from megatron_llm_tpu.ops.pallas.flash_attention import (
+            sharded_flash_attention,
+        )
 
-        ctx = flash_attention(
+        # under a mesh the Mosaic kernel must run in an explicit
+        # shard_map (GSPMD cannot auto-partition it); no mesh -> plain
+        ctx = sharded_flash_attention(
             q, k, v,
             causal=True,
             sliding_window=cfg.sliding_window_size,

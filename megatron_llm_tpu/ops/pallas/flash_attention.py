@@ -57,7 +57,9 @@ LANES = 128
 
 
 def _use_pallas() -> bool:
-    return jax.default_backend() == "tpu" or _INTERPRET
+    from megatron_llm_tpu.ops.pallas import pallas_backend_available
+
+    return _INTERPRET or pallas_backend_available()
 
 
 # ---------------------------------------------------------------------------
@@ -492,6 +494,17 @@ FUSED_BACKWARD = True
 # the two-kernel structure instead of risking a compile-time OOM at
 # exactly the long-context lengths the fallback ladder protects.
 FUSED_BWD_MAX_SLAB_BYTES = 4 << 20
+# The fused kernel's own block sizes.  They are SMALLER than the
+# two-kernel 1024 defaults because its scoped-vmem working set carries
+# four bq x bk fp32 score-tile intermediates (s, p, dp, ds) PLUS the
+# full-seq dq slab: at 1024x1024 that is ~15 MB of tiles before the slab
+# and the real compiler rejects it (verified via tools/compile_stats.py
+# — 16.05 MB needed vs the 16 MB scoped-vmem limit at seq 2048, worse at
+# longer seq).  512x512 tiles cost 4 MB total, leaving room for the slab
+# at every supported length.  Whether fused@512 beats two-kernel@1024
+# on-chip is exactly what `tools/mfu_sweep.py fusedbwd` measures.
+FUSED_BLOCK_Q = 512
+FUSED_BLOCK_K = 512
 
 
 def _bwd_call(q, k, v, o, lse, do, *, scale, causal, window,
@@ -507,13 +520,19 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, window,
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
-    if (FUSED_BACKWARD and sq % bq == 0 and sk % bk == 0
+    # caller block sizes act as an upper bound (explicit tuning hints,
+    # e.g. tests at 64); the fused defaults shrink the usual 1024s to a
+    # scoped-vmem-safe size
+    fbq = min(FUSED_BLOCK_Q, bq)
+    fbk = min(FUSED_BLOCK_K, bk)
+    if (FUSED_BACKWARD and sq % fbq == 0 and sk % fbk == 0
             and sq * d * 4 <= FUSED_BWD_MAX_SLAB_BYTES):
         # full blocks only: the fused kernel's in-place row-slice
         # accumulation into the dq slab assumes every q block is complete
         return _bwd_fused_call(
             q, k, v, do, lse, delta, scale=scale, causal=causal,
-            window=window, bq=bq, bk=bk, nq=nq, nk=nk)
+            window=window, bq=fbq, bk=fbk,
+            nq=pl.cdiv(sq, fbq), nk=pl.cdiv(sk, fbk))
 
     kw = dict(scale=scale, block_q=bq, block_k=bk, causal=causal,
               window=window, kv_len=sk, q_len=sq)
@@ -654,3 +673,114 @@ def flash_attention(
                                     softmax_scale)
     return _flash(q, k, v, causal, sliding_window, softmax_scale,
                   block_q, block_k)
+
+
+def sharded_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sliding_window: Optional[int] = None,
+    softmax_scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+) -> jax.Array:
+    """``flash_attention`` under an active device mesh.
+
+    GSPMD cannot auto-partition Mosaic custom calls ("Mosaic kernels
+    cannot be automatically partitioned" — surfaced the moment AOT
+    compiles engaged the real kernels, round 5), so under a mesh the
+    pallas call must run inside an explicit ``shard_map``.  Attention is
+    batch-local and head-local, so the manual region maps batch over dp
+    and heads over tp with no collectives: each device runs the kernel
+    on its local [b/dp, s, nh/tp, d] slab.  GQA kv heads shard over tp
+    when divisible; MQA (ng=1) replicates kv, which preserves the local
+    q-heads-per-group ratio.  Falls back to the plain call when no mesh
+    axis actually shards the inputs.  Nests inside the pipeline engines'
+    pp-manual regions the same way ring attention does
+    (``topology.nesting_mesh`` semantics: abstract mesh + re-declared
+    manual axes).
+    """
+    kw = dict(causal=causal, sliding_window=sliding_window,
+              softmax_scale=softmax_scale, block_q=block_q,
+              block_k=block_k)
+    if not _use_pallas():
+        # XLA fallback attention partitions automatically; no wrapper
+        return flash_attention(q, k, v, **kw)
+
+    from jax.sharding import PartitionSpec as P
+
+    from megatron_llm_tpu import topology
+
+    if not isinstance(q, jax.core.Tracer):
+        # eager call (no jit): subset-manual shard_map needs a tracing
+        # context, and eager arrays are device-local anyway
+        return flash_attention(q, k, v, **kw)
+
+    mesh, manual = topology.current_mesh_and_manual()
+    if mesh is None:
+        return flash_attention(q, k, v, **kw)
+
+    b, _, nh, _ = q.shape
+    ng = k.shape[2]
+
+    def auto_size(name):
+        return (mesh.shape[name]
+                if name in mesh.axis_names and name not in manual else 1)
+
+    def usable(name, dim_size):
+        return auto_size(name) > 1 and dim_size % mesh.shape[name] == 0
+
+    def xla_fallback():
+        # a combo the manual mapping can't express: the raw pallas call
+        # would hit the GSPMD 'Mosaic kernels cannot be automatically
+        # partitioned' lowering error (the arrays may be sharded even
+        # when not evenly divisible), so use partitionable XLA math —
+        # q-chunked past the length where the [s, s] score tensor is a
+        # compile hazard
+        from megatron_llm_tpu.ops.chunked_attention import (
+            CHUNKED_ATTENTION_MIN_SEQ,
+            chunked_causal_attention,
+        )
+
+        if q.shape[1] >= CHUNKED_ATTENTION_MIN_SEQ:
+            # chunked path handles causal=False too — the [s, s] score
+            # hazard doesn't care about masking
+            return chunked_causal_attention(
+                q, k, v, causal=causal, sliding_window=sliding_window,
+                softmax_scale=softmax_scale)
+        return _reference_attention(q, k, v, causal, sliding_window,
+                                    softmax_scale
+                                    or 1.0 / math.sqrt(q.shape[-1]))
+
+    dp = topology.DP_AXIS if usable(topology.DP_AXIS, b) else None
+    tp_q = topology.TP_AXIS if usable(topology.TP_AXIS, nh) else None
+    tp_kv = tp_q if (tp_q and ng % mesh.shape[tp_q] == 0) else None
+    if dp is None and tp_q is None:
+        if auto_size(topology.DP_AXIS) == 1 and \
+                auto_size(topology.TP_AXIS) == 1:
+            # nothing can shard batch/heads: plain pallas is safe
+            return flash_attention(q, k, v, **kw)
+        return xla_fallback()  # axes exist but dims don't divide
+    if tp_q and tp_kv is None and ng > 1:
+        # GQA kv heads not divisible by tp: sharding q but replicating kv
+        # would change the local q-per-group ratio — unsupported combo
+        return xla_fallback()
+
+    qspec = P(dp, None, tp_q, None)
+    kvspec = P(dp, None, tp_kv, None)
+    # ALL mesh axes go manual, not just the ones in the specs: with a
+    # subset, the Mosaic call still sits inside an auto-sharding region
+    # for the remaining axes and the GSPMD partitioner refuses it even
+    # when those axes are size 1 / unused.  Unmentioned manual axes mean
+    # "replicated", which matches the activation layout here (and inside
+    # an enclosing pp/cp-manual region, matches per-group locality).
+    return jax.shard_map(
+        lambda ql, kl, vl: flash_attention(ql, kl, vl, **kw),
+        mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec),
+        out_specs=qspec,
+        axis_names=set(mesh.axis_names),
+        check_vma=False,
+    )(q, k, v)

@@ -37,7 +37,14 @@ _INTERPRET = False
 
 
 def _use_pallas() -> bool:
-    return jax.default_backend() == "tpu" or _INTERPRET
+    from megatron_llm_tpu import topology
+    from megatron_llm_tpu.ops.pallas import pallas_backend_available
+
+    if topology.sharded_auto_mesh_active():
+        # see rmsnorm.py: norm kernels defer to the partitionable XLA
+        # norm under GSPMD auto sharding (manual-only regions keep it)
+        return False
+    return _INTERPRET or pallas_backend_available()
 
 
 def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mu_ref, rstd_ref, *, eps):

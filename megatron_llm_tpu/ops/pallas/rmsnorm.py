@@ -30,7 +30,18 @@ _BLOCK_ROWS = 256
 
 
 def _use_pallas() -> bool:
-    return jax.default_backend() == "tpu" or _INTERPRET
+    from megatron_llm_tpu import topology
+    from megatron_llm_tpu.ops.pallas import pallas_backend_available
+
+    if topology.sharded_auto_mesh_active():
+        # GSPMD cannot auto-partition Mosaic kernels; unlike flash
+        # attention (head/batch-local, shard_map-wrapped), the norm
+        # kernels see a [tokens, hidden] view that mixes batch and
+        # sharded-seq axes, so under auto sharding they defer to the
+        # XLA norm (which fuses well and partitions).  Fully-manual
+        # regions (pp-only pipelines) keep the pallas kernel.
+        return False
+    return _INTERPRET or pallas_backend_available()
 
 
 def _pick_rows(n: int, h: int, itemsize: int) -> int:

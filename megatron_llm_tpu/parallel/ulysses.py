@@ -68,10 +68,14 @@ def ulysses_self_attention(
 
     # local attention over the FULL sequence with nh/P heads: the exact
     # same kernel path as single-device attention (pallas flash on TPU,
-    # reference math elsewhere), so all flash tuning carries over
-    from megatron_llm_tpu.ops.pallas.flash_attention import flash_attention
+    # reference math elsewhere), so all flash tuning carries over.
+    # sharded_ variant: tp/dp are still GSPMD-auto inside this cp-manual
+    # region, and a Mosaic call can't be auto-partitioned over them
+    from megatron_llm_tpu.ops.pallas.flash_attention import (
+        sharded_flash_attention,
+    )
 
-    ctx = flash_attention(
+    ctx = sharded_flash_attention(
         qg, kg, vg, causal=causal, sliding_window=sliding_window,
         softmax_scale=softmax_scale)
 

@@ -196,35 +196,52 @@ def initialize_distributed(
 # Sharding constructors.
 # ---------------------------------------------------------------------------
 
-def nesting_mesh(required_axis: str):
-    """Mesh + already-manual axes for a shard_map that may nest inside
-    another manual region (the pipeline engines).
-
-    Inside an enclosing manual shard_map jax requires the *abstract*
-    context mesh and the re-declaration of every already-Manual axis;
-    outside, the concrete device mesh.  Returns ``(mesh, manual_axes)``,
-    or ``(None, None)`` when ``required_axis`` is absent or size 1 in the
-    selected mesh — the caller should fall back to its unsharded path.
-    Shared by ``vocab_parallel_lookup_manual`` and
-    ``context_parallel_attention``."""
+def current_mesh_and_manual():
+    """(governing mesh, already-Manual axis names) for building a
+    shard_map that may nest inside another manual region — the abstract
+    context mesh when one is active (inside jit/manual regions jax
+    requires it plus re-declaration of every already-Manual axis), else
+    the concrete global mesh.  ``(None, set())`` when no mesh governs."""
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or not mesh.axis_names:
         # not inside any mesh context: the concrete global mesh governs
         mesh = _MESH
-    elif required_axis not in mesh.axis_names:
-        # an abstract mesh IS active but doesn't carry the axis: do NOT
-        # silently switch to the global mesh — a nested shard_map over a
-        # different mesh than the enclosing context fails with an opaque
-        # jax error; (None, None) routes callers to their clean fallback
-        # (round-3 advisor finding)
-        return None, None
-    if (mesh is None or required_axis not in mesh.axis_names
-            or mesh.shape[required_axis] == 1):
-        return None, None
+    if mesh is None:
+        return None, set()
     manual = {
         name for name, t in zip(mesh.axis_names, mesh.axis_types)
         if "Manual" in str(t)
     }
+    return mesh, manual
+
+
+def sharded_auto_mesh_active() -> bool:
+    """True when the governing mesh has a size>1 axis still under GSPMD
+    auto-sharding — i.e. auto partitioning is in play and a bare Mosaic
+    custom call is a lowering error.  Axes already Manual don't count:
+    inside a fully-manual region the arrays are device-local and pallas
+    is legal."""
+    mesh, manual = current_mesh_and_manual()
+    return mesh is not None and any(
+        mesh.shape[a] > 1 for a in mesh.axis_names if a not in manual)
+
+
+def nesting_mesh(required_axis: str):
+    """Mesh + already-manual axes for a shard_map that may nest inside
+    another manual region (the pipeline engines).
+
+    Returns ``(mesh, manual_axes)``, or ``(None, None)`` when
+    ``required_axis`` is absent or size 1 in the governing mesh — the
+    caller should fall back to its unsharded path.  NOTE: when an
+    abstract mesh is active but lacks the axis we must NOT silently
+    switch to the global mesh (a nested shard_map over a different mesh
+    than the enclosing context fails with an opaque jax error —
+    round-3 advisor finding).  Shared by ``vocab_parallel_lookup_manual``
+    and ``context_parallel_attention``."""
+    mesh, manual = current_mesh_and_manual()
+    if (mesh is None or required_axis not in mesh.axis_names
+            or mesh.shape[required_axis] == 1):
+        return None, None
     return mesh, manual
 
 

@@ -185,3 +185,23 @@ def test_ulysses_train_step(utils):
     _, _, metrics = step(params, opt_state, batch, jax.random.PRNGKey(0),
                          1e-3, 0.0)
     assert np.isfinite(float(metrics["lm loss"]))
+
+
+def test_ulysses_nested_pallas_tp(utils):
+    """The round-5 motivating case: inside ulysses' cp-manual region,
+    tp is still auto, and the INNER pallas flash must nest its own
+    shard_map (interpret mode engages the real kernel path on CPU) —
+    parity with full reference attention."""
+    import megatron_llm_tpu.ops.pallas.flash_attention as F
+
+    utils.initialize_model_parallel(tp=2, pp=1, cp=2)
+    q, k, v = _qkv(nh=4, ng=2, d=64)
+    ref = _reference_attention(q, k, v, True, None, 0.125)
+    F._INTERPRET = True
+    try:
+        out = jax.jit(
+            lambda q, k, v: ulysses_context_attention(
+                q, k, v, causal=True, softmax_scale=0.125))(q, k, v)
+    finally:
+        F._INTERPRET = False
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

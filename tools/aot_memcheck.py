@@ -77,6 +77,15 @@ CONFIGS = {
         hbm_gb=95, tp=8, pp=4, vpp=None, seq=4096, micro_batch=1,
         num_micro=8, zero1=True,
     ),
+    # Llama-3-8B (GQA 8kv, 128k vocab, theta 5e5) at seq 8192 on v5e-16:
+    # the 128k-vocab head is exactly where fused CE pays (scale_aot
+    # notes), so this row compiles with fused_lm_cross_entropy on
+    "llama3-8b-v5e16": dict(
+        family="llama3", size="llama3-8B", topology="v5e:4x4",
+        accel="v5litepod-16", hbm_gb=16, tp=8, pp=1, vpp=None, seq=8192,
+        micro_batch=1, num_micro=4, zero1=True, recompute="full",
+        fused_ce=True,
+    ),
     # beyond-reference families at scale: Qwen2-7B and Gemma-7B
     "qwen2-7b-tp8": dict(
         family="qwen2", size="7B", topology="v5p:2x2x2", accel="v5p-16",
@@ -121,6 +130,7 @@ def _model_for(spec):
         recompute_granularity=spec.get("recompute", "selective"),
         use_flash_attn=True,
         use_fused_rmsnorm=False,
+        fused_lm_cross_entropy=spec.get("fused_ce", False),
     )
     if spec["family"] == "gpt":
         from megatron_llm_tpu.models.gpt import GPTModel
@@ -138,7 +148,7 @@ def _model_for(spec):
         from megatron_llm_tpu.models.gemma import GemmaModel, gemma_config
 
         return GemmaModel(gemma_config(spec["size"], **common))
-    if spec["family"] == "llama2":
+    if spec["family"] in ("llama2", "llama3"):
         from megatron_llm_tpu.models.llama import LlamaModel, llama_config
 
         return LlamaModel(llama_config(spec["size"], **common))
@@ -323,6 +333,11 @@ def main(argv):
     env.pop("JAX_PLATFORM_NAME", None)
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    # AOT children lower for a TPU topology with a CPU default backend;
+    # without this the pallas kernels silently compile as XLA fallbacks
+    # (discovered round 5 — rows recorded before then were XLA-attention
+    # compiles)
+    env["MLT_FORCE_PALLAS"] = "1"
     rc = 0
     for name in names:
         e = dict(env)

@@ -43,15 +43,28 @@ TRIALS = [
     ("seq8192-twokernel", 8192, 1, False),
     ("seq8192-fused", 8192, 1, True),
     # fused-CE flip-point insurance (VERDICT r4 #7 is chip-gated; these
-    # record the compiler-visible memory/traffic effect at 128k vocab):
-    # (label, seq, mb, fused_bwd, vocab, fused_ce)
-    ("vocab128k-plainCE", 2048, 4, True, 131072, False),
-    ("vocab128k-fusedCE", 2048, 4, True, 131072, True),
+    # record the compiler-visible memory/traffic effect at 128k vocab).
+    # Smaller body (L4 h1024): at the full bench shape the 128k-vocab
+    # model's fp32 optimizer state alone nears the 16 GB HBM and both
+    # variants OOM at compile, drowning the CE difference.
+    # (label, seq, mb, fused_bwd, vocab, fused_ce, shape)
+    ("vocab128k-plainCE", 2048, 4, True, 131072, False, "small"),
+    ("vocab128k-fusedCE", 2048, 4, True, 131072, True, "small"),
 ]
+
+SHAPES = {
+    # the bench.py llama-650M shape (docs/perf_tpu.md)
+    "bench": dict(num_layers=10, hidden_size=2048, num_attention_heads=16,
+                  ffn_hidden_size=5632),
+    # d=128 kept (MXU alignment), small body for memory-edge trials
+    "small": dict(num_layers=4, hidden_size=1024, num_attention_heads=8,
+                  ffn_hidden_size=2816),
+}
 
 
 def run_trial(label: str, seq: int, mb: int, fused: bool,
-              vocab: int = 32000, fused_ce: bool = False) -> dict:
+              vocab: int = 32000, fused_ce: bool = False,
+              shape: str = "bench") -> dict:
     import jax
     import jax.numpy as jnp
     from jax.experimental import topologies
@@ -64,13 +77,15 @@ def run_trial(label: str, seq: int, mb: int, fused: bool,
 
     fa.FUSED_BACKWARD = fused
 
+    # smallest expressible v5e topology is one 2x2 host; the program is
+    # compiled single-device on its first chip (no collectives), so the
+    # memory/cost analysis is the 1-chip bench-config story
     topo = topologies.get_topology_desc(platform="tpu",
-                                        topology_name="v5e:1x1")
+                                        topology_name="v5e:2x2")
     dev = topo.devices[0]
 
     cfg = llama_config(
-        "tiny", num_layers=10, hidden_size=2048, num_attention_heads=16,
-        ffn_hidden_size=5632, padded_vocab_size=vocab, seq_length=seq,
+        "tiny", **SHAPES[shape], padded_vocab_size=vocab, seq_length=seq,
         max_position_embeddings=seq, params_dtype="bf16",
         compute_dtype="bf16", recompute_granularity="selective",
         use_flash_attn=True, use_fused_rmsnorm=True,
@@ -94,7 +109,10 @@ def run_trial(label: str, seq: int, mb: int, fused: bool,
     }
     print(f"[{label}] lowering ({n_params/1e6:.0f}M params, "
           f"{dev.device_kind})...", file=sys.stderr, flush=True)
-    lowered = jax.jit(step, device=dev).lower(
+    # donate params/opt_state like the real bench jit (build_train_step's
+    # inner donation doesn't survive the outer device-pinning jit), so
+    # memory_analysis aliases them instead of double-counting
+    lowered = jax.jit(step, device=dev, donate_argnums=(0, 1)).lower(
         params_shape, opt_shape, batch,
         jax.ShapeDtypeStruct((2,), jnp.uint32),
         jax.ShapeDtypeStruct((), jnp.float32),
@@ -152,7 +170,10 @@ def main(argv):
     env.pop("JAX_PLATFORM_NAME", None)
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
-    env["TPU_ACCELERATOR_TYPE"] = "v5litepod-1"
+    env["TPU_ACCELERATOR_TYPE"] = "v5litepod-4"
+    # AOT children lower for a TPU topology with a CPU default backend;
+    # without this the kernels silently compile as their XLA fallbacks
+    env["MLT_FORCE_PALLAS"] = "1"
     rc = 0
     rows = []
     for t in wanted:
