@@ -235,6 +235,32 @@ class TestShardedFlashAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-6)
 
+    def test_indivisible_dims_xla_fallback(self, utils, monkeypatch):
+        # nh=6 on tp=4 AND b=3 on dp=2: neither heads nor batch can
+        # shard, but auto axes exist — the wrapper must route to the
+        # partitionable XLA path (NOT the raw pallas call, which GSPMD
+        # can't partition) and stay numerically exact.  A spy pins the
+        # routing: parity alone can't distinguish the paths.
+        q, k, v = _qkv(b=3, s=128, nh=6, ng=2, d=64)
+        want = F._reference_attention(q, k, v, True, None, 0.125)
+        called = {}
+        real_ref = F._reference_attention
+
+        def spy(*a, **kw):
+            called["ref"] = True
+            return real_ref(*a, **kw)
+
+        monkeypatch.setattr(F, "_reference_attention", spy)
+        utils.initialize_model_parallel(tp=4)
+        try:
+            got = jax.jit(lambda q, k, v: F.sharded_flash_attention(
+                q, k, v, causal=True, softmax_scale=0.125))(q, k, v)
+        finally:
+            utils.destroy_model_parallel()
+        assert called.get("ref"), "xla fallback path was not taken"
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
     def test_no_mesh_plain_path(self):
         q, k, v = _qkv()
         want = F.flash_attention(q, k, v, causal=True, softmax_scale=0.125,
