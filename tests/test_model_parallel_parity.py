@@ -93,3 +93,38 @@ def test_grad_parity_tp_sp(utils):
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5,
                                    err_msg=str(pa))
+
+
+def test_tp_parity_with_pallas_flash(utils):
+    """Model-level tp+sp parity with the PALLAS flash kernel engaged
+    (interpret mode): exercises the transformer dispatch ->
+    sharded_flash_attention -> nested shard_map integration that the
+    op-level tests cover in isolation.  seq must be a multiple of the
+    fused block min; head_dim and GQA groups divide tp."""
+    import megatron_llm_tpu.ops.pallas.flash_attention as F
+
+    cfg = llama_config("tiny", num_layers=2, hidden_size=128,
+                       num_attention_heads=4, num_attention_heads_kv=2,
+                       seq_length=64, max_position_embeddings=64,
+                       padded_vocab_size=128, use_flash_attn=True)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 128, (4, 64)))
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    F._INTERPRET = True
+    try:
+        base = model(params, tokens, labels=labels, train=False)
+
+        mesh = utils.initialize_model_parallel(tp=2)
+        ps = sh.shard_params(params, model.param_specs(params))
+        dsh = NamedSharding(mesh, P("dp", None))
+        t, l = jax.device_put(tokens, dsh), jax.device_put(labels, dsh)
+
+        out = jax.jit(lambda p, t, l: model(
+            p, t, labels=l, train=False, sequence_parallel=True))(ps, t, l)
+    finally:
+        F._INTERPRET = False
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                               atol=2e-5)
