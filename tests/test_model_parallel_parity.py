@@ -99,21 +99,30 @@ def test_tp_parity_with_pallas_flash(utils):
     """Model-level tp+sp parity with the PALLAS flash kernel engaged
     (interpret mode): exercises the transformer dispatch ->
     sharded_flash_attention -> nested shard_map integration that the
-    op-level tests cover in isolation.  seq must be a multiple of the
-    fused block min; head_dim and GQA groups divide tp."""
+    op-level tests cover in isolation.  num_attention_heads (and the
+    GQA kv groups) must divide tp or the wrapper demotes to the XLA
+    fallback — a spy asserts the pallas shard_map leg actually ran."""
     import megatron_llm_tpu.ops.pallas.flash_attention as F
 
-    cfg = llama_config("tiny", num_layers=2, hidden_size=128,
-                       num_attention_heads=4, num_attention_heads_kv=2,
+    cfg = llama_config("tiny", num_attention_heads_kv=2,
                        seq_length=64, max_position_embeddings=64,
                        padded_vocab_size=128, use_flash_attn=True)
     model = LlamaModel(cfg)
     params = model.init(jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
-    tokens = jnp.asarray(rng.randint(0, 128, (4, 64)))
+    tokens = jnp.asarray(
+        rng.randint(0, cfg.padded_vocab_size, (4, 64)))
     labels = jnp.roll(tokens, -1, axis=1)
 
+    flash_calls = []
+    real_flash = F.flash_attention
+
+    def spy(*a, **kw):
+        flash_calls.append(a[0].shape)
+        return real_flash(*a, **kw)
+
     F._INTERPRET = True
+    F.flash_attention = spy
     try:
         base = model(params, tokens, labels=labels, train=False)
 
@@ -126,5 +135,10 @@ def test_tp_parity_with_pallas_flash(utils):
             p, t, labels=l, train=False, sequence_parallel=True))(ps, t, l)
     finally:
         F._INTERPRET = False
+        F.flash_attention = real_flash
+    # the sharded run must have reached the pallas kernel with LOCAL
+    # shapes (heads/tp), not the XLA fallback
+    assert any(shape[2] == cfg.num_attention_heads // 2
+               for shape in flash_calls), flash_calls
     np.testing.assert_allclose(np.asarray(out), np.asarray(base),
                                atol=2e-5)
