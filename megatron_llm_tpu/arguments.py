@@ -249,10 +249,13 @@ def _add_distributed_args(parser):
     g.add_argument("--sequence_parallel", action="store_true")
     g.add_argument("--context_parallel_size", type=int, default=1)
     g.add_argument("--context_parallel_algo", default="ring",
-                   choices=["ring", "ulysses"],
-                   help="cp attention algorithm: K/V ring (ppermute) or "
+                   choices=["ring", "ulysses", "zigzag"],
+                   help="cp attention algorithm: K/V ring (ppermute), "
                         "Ulysses all-to-all (heads %% cp == 0; falls back "
-                        "to ring otherwise)")
+                        "to ring otherwise), or zigzag (load-balanced "
+                        "causal ring: half-chunk pair layout + "
+                        "fully-masked-block skipping; needs an even "
+                        "seq/cp, falls back to ring otherwise)")
     g.add_argument("--use_distributed_optimizer", action="store_true")
     g.add_argument("--expert_model_parallel_size", type=int, default=1)
     g.add_argument("--distributed_backend", default="xla",

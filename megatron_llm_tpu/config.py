@@ -233,9 +233,9 @@ class TransformerConfig:
                 "position_embedding_type",
                 PositionEmbeddingType(self.position_embedding_type),
             )
-        if self.context_parallel_algo not in ("ring", "ulysses"):
+        if self.context_parallel_algo not in ("ring", "ulysses", "zigzag"):
             raise ValueError(
-                f"context_parallel_algo must be ring|ulysses, got "
+                f"context_parallel_algo must be ring|ulysses|zigzag, got "
                 f"{self.context_parallel_algo!r}")
         if self.num_experts > 1:
             if self.add_bias_linear:

@@ -369,15 +369,29 @@ def attention(
             ulysses_supported,
         )
 
-        # two context-parallel algorithms (both absent from the
+        # three context-parallel algorithms (all absent from the
         # reference): 'ulysses' all-to-alls heads<->sequence so attention
-        # runs dense and local (needs heads % cp == 0); 'ring' permutes
-        # K/V around the cp ring (any head count).  Ulysses falls back to
-        # ring when the head counts don't divide cp.
+        # runs dense and local (needs heads % cp == 0); 'zigzag' is the
+        # load-balanced causal ring (half-chunk pair layout, fully-masked
+        # sub-blocks skipped); 'ring' permutes K/V around the cp ring
+        # (any head count).  Ulysses falls back to ring when the head
+        # counts don't divide cp; zigzag falls back when the local
+        # sequence is odd.
         algo = getattr(cfg, "context_parallel_algo", "ring")
         if algo == "ulysses" and ulysses_supported(
                 cfg.num_attention_heads, cfg.num_query_groups, cp_size):
             ctx = ulysses_context_attention(
+                q, k, v,
+                causal=True,
+                sliding_window=cfg.sliding_window_size,
+                softmax_scale=1.0 / math.sqrt(cfg.head_dim),
+            )
+        elif algo == "zigzag" and (q.shape[1] // cp_size) % 2 == 0:
+            from megatron_llm_tpu.parallel.zigzag_ring import (
+                zigzag_context_attention,
+            )
+
+            ctx = zigzag_context_attention(
                 q, k, v,
                 causal=True,
                 sliding_window=cfg.sliding_window_size,
