@@ -147,6 +147,8 @@ GROUPS["fusedbwd"] = [
          ffn=5632, L=10, seq=4096, fused_bwd=False),
     dict(label="650M seq4096 fused bwd", mb=2, h=2048, heads=16,
          ffn=5632, L=10, seq=4096, fused_bwd=True),
+    dict(label="650M seq8192 two-kernel bwd", mb=1, h=2048, heads=16,
+         ffn=5632, L=10, seq=8192, fused_bwd=False),
     dict(label="650M seq8192 fused bwd", mb=1, h=2048, heads=16,
          ffn=5632, L=10, seq=8192, fused_bwd=True),
 ]
