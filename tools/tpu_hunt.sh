@@ -60,7 +60,7 @@ step_tmo() {
 # local failure (import error, broken env) that probing harder won't fix —
 # surface it and stop instead of reporting "tunnel down" for 10 hours.
 probe() {
-  timeout 150 python - >/tmp/tpu_probe.log 2>&1 <<'EOF'
+  timeout 150 python - >/tmp/tpu_probe.log 2>&1 9>&- <<'EOF'
 import jax, jax.numpy as jnp
 x = jnp.ones((256, 256))
 assert jax.devices()[0].platform == "tpu"
@@ -87,7 +87,7 @@ run_step() {  # name
     return 0
   fi
   echo "[hunt $(date +%H:%M:%S)] step $name attempt $att"
-  timeout "$(step_tmo "$name")" bash -c "$(step_cmd "$name")" >> "/tmp/hunt_$name.log" 2>&1
+  timeout "$(step_tmo "$name")" bash -c "$(step_cmd "$name")" >> "/tmp/hunt_$name.log" 2>&1 9>&-
   local rc=$?
   if [ "$rc" -eq 0 ]; then
     touch "$MARKS/$name.done"
