@@ -1,0 +1,29 @@
+"""tools/profile_step.py writes a real xplane trace around the train step.
+
+Beyond-reference capability (SURVEY.md §5.1: the reference has no
+profiler integration); on CPU the trace carries the host plane, on TPU
+the device plane as well — the tool and the assertion are
+backend-agnostic.
+"""
+
+import glob
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_profile_step_writes_xplane(tmp_path):
+    logdir = str(tmp_path / "trace")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "profile_step.py"),
+         "--preset", "tiny", "--logdir", logdir, "--steps", "2"],
+        capture_output=True, text=True, timeout=600, cwd=ROOT,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "trace written" in r.stdout
+    planes = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                       recursive=True)
+    assert planes, r.stdout
+    assert os.path.getsize(planes[0]) > 0

@@ -15,16 +15,12 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-import jax, jax.numpy as jnp, numpy as np
+import jax
 
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(ROOT, ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-from megatron_llm_tpu.config import ParallelConfig, TrainConfig
-from megatron_llm_tpu.models.llama import LlamaModel, llama_config
-from megatron_llm_tpu.optimizer import MegatronOptimizer
-from megatron_llm_tpu.training import build_train_step
+from tools.bench_harness import (enable_compile_cache, make_cfg,
+                                 build_concrete, make_batch)
+
+enable_compile_cache()
 
 PEAK = 197e12
 
@@ -42,23 +38,13 @@ def bench_cfg(label, mb=8, remat="selective", flash=True, fused_rms=True,
         # model/optimizer init INSIDE the trial guard: the memory-edge
         # trials (bigvocab) can OOM at init, which must fail that one
         # trial, not abort the sweep
-        cfg = llama_config("tiny", num_layers=L, hidden_size=h, num_attention_heads=heads,
-            ffn_hidden_size=ffn, padded_vocab_size=vocab, seq_length=seq,
-            max_position_embeddings=seq, params_dtype="bf16", compute_dtype="bf16",
-            recompute_granularity=remat, use_flash_attn=flash, use_fused_rmsnorm=fused_rms,
-            num_experts=experts, moe_top_k=top_k, fused_lm_cross_entropy=fused_ce)
-        model = LlamaModel(cfg)
-        params = model.init(jax.random.PRNGKey(0))
+        cfg = make_cfg(L=L, h=h, heads=heads, ffn=ffn, seq=seq,
+                       vocab=vocab, remat=remat, flash=flash,
+                       fused_rms=fused_rms, experts=experts, top_k=top_k,
+                       fused_ce=fused_ce)
+        model, params, opt, opt_state, step = build_concrete(cfg, mb)
         n = model.num_params(params)
-        tc = TrainConfig(micro_batch_size=mb, global_batch_size=mb, train_iters=0, lr=1e-4,
-                         optimizer="adam", bf16=True, clip_grad=1.0)
-        opt = MegatronOptimizer(tc, params_dtype=jnp.bfloat16)
-        opt_state = opt.init(params)
-        step = build_train_step(model, opt, ParallelConfig(), 1)
-        rng = np.random.RandomState(0)
-        toks = jnp.asarray(rng.randint(0, vocab, (1, mb, seq)))
-        batch = {"tokens": toks, "labels": jnp.roll(toks, -1, -1),
-                 "loss_mask": jnp.ones_like(toks, jnp.float32)}
+        batch = make_batch(mb, seq, vocab)
         key = jax.random.PRNGKey(1)
         for _ in range(2):
             params, opt_state, m = step(params, opt_state, batch, key, 1e-4, 0.0)

@@ -1,0 +1,100 @@
+"""Capture an XLA profiler trace of the training step.
+
+Beyond-reference tooling (SURVEY.md §5.1 records that the reference has
+"no nsys/profiler integration, no chrome traces"): on TPU the natural
+equivalent is `jax.profiler.trace`, which records the device timeline
+(MXU occupancy, HBM traffic, per-fusion timing) into an xplane protobuf
+that TensorBoard's profile plugin / Perfetto render directly.  This tool
+wires it around one jitted train step so "profile, iterate" is one
+command:
+
+    python tools/profile_step.py --logdir /tmp/trace           # 650M bench shape
+    python tools/profile_step.py --preset tiny --logdir /tmp/t # CI / CPU
+
+Prints the trace directory and the per-step wall times; the trace
+contains host + device planes (device plane only on real TPU).
+"""
+
+import argparse
+import glob
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from tools.bench_harness import (enable_compile_cache, make_cfg,
+                                 build_concrete, make_batch)
+
+import jax
+
+PRESETS = {
+    # the on-chip bench shape (docs/perf_tpu.md): ~650M llama
+    "bench": dict(L=10, h=2048, heads=16, ffn=5632, seq=2048, mb=4),
+    # small enough for CPU / CI
+    "tiny": dict(L=2, h=128, heads=4, ffn=352, seq=64, mb=2),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--logdir", required=True,
+                    help="directory for the xplane trace (created)")
+    ap.add_argument("--preset", choices=sorted(PRESETS), default="bench")
+    ap.add_argument("--steps", type=int, default=3,
+                    help="traced steps (after 2 untraced warmup steps)")
+    ap.add_argument("--seq", type=int, help="override preset seq length")
+    ap.add_argument("--micro_batch", type=int, help="override preset mb")
+    args = ap.parse_args()
+
+    enable_compile_cache()
+
+    p = dict(PRESETS[args.preset])
+    mb = args.micro_batch or p.pop("mb")
+    p.pop("mb", None)
+    if args.seq:
+        p["seq"] = args.seq
+    seq = p["seq"]
+    vocab = 32000 if args.preset == "bench" else 512
+    on_tpu = jax.default_backend() == "tpu"
+
+    cfg = make_cfg(vocab=vocab, flash=on_tpu, fused_rms=on_tpu, **p)
+    model, params, opt, opt_state, step = build_concrete(cfg, mb)
+    batch = make_batch(mb, seq, vocab)
+    key = jax.random.PRNGKey(1)
+
+    print(f"profile_step: preset={args.preset} seq={seq} mb={mb} "
+          f"backend={jax.default_backend()}", flush=True)
+    for i in range(2):  # compile + warmup, untraced
+        params, opt_state, m = step(params, opt_state, batch, key, 1e-4, 0.0)
+        float(m["lm loss"])
+    print("profile_step: warmup done, tracing", flush=True)
+
+    os.makedirs(args.logdir, exist_ok=True)
+    preexisting = set(glob.glob(
+        os.path.join(args.logdir, "**", "*.xplane.pb"), recursive=True))
+    with jax.profiler.trace(args.logdir):
+        for i in range(args.steps):
+            t0 = time.perf_counter()
+            params, opt_state, m = step(params, opt_state, batch, key,
+                                        1e-4, 0.0)
+            float(m["lm loss"])  # host sync inside the trace window
+            print(f"profile_step: step {i}: "
+                  f"{(time.perf_counter() - t0) * 1000:.1f} ms", flush=True)
+
+    # only accept a trace written by THIS run — a reused logdir keeps
+    # older timestamped session dirs around (set difference, not mtime:
+    # coarse mtime granularity could reject a just-written file)
+    planes = sorted(set(glob.glob(
+        os.path.join(args.logdir, "**", "*.xplane.pb"), recursive=True))
+        - preexisting)
+    if not planes:
+        print("profile_step: ERROR no fresh .xplane.pb written", flush=True)
+        sys.exit(1)
+    print(f"profile_step: trace written: {planes[0]}", flush=True)
+    print("profile_step: view with: tensorboard --logdir "
+          f"{args.logdir}  (profile plugin), or convert to perfetto",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
