@@ -61,8 +61,16 @@ def generate(
     stop_on_double_eol: bool = False,
     prevent_newline_after_colon: bool = False,
     rolling_cache: Optional[bool] = None,
+    cache_len: Optional[int] = None,
 ):
     """Returns (texts, token_lists, log_probs or None).
+
+    ``cache_len``: minimum KV-cache allocation (slots); decode masks
+    the unused tail, outputs are identical
+    (tests/test_generation.py::test_cache_len_padding_is_invisible).
+    Decouples per-step attention cost from max_new_tokens (used by
+    tools/decode_bench.py).  Does not by itself avoid recompiles —
+    the jit keys on prompt shape and tokens_to_generate.
 
     ``batch_times_seqlen_threshold``: micro-batch the prefill forward
     above this batch*seqlen (reference
@@ -138,6 +146,7 @@ def generate(
         top_p_decay=top_p_decay, top_p_bound=top_p_bound,
         extra_stop_ids=tuple(extra_stop), stop_pairs=tuple(stop_pairs),
         ban_pairs=tuple(ban_pairs), rolling_cache=bool(rolling_cache),
+        cache_len=cache_len,
     )
     out_tokens = np.asarray(out_tokens)
     stop_set = set(extra_stop)

@@ -94,7 +94,8 @@ def _prefill_chunks(b: int, n: int, threshold: Optional[int]) -> int:
                      "top_p", "temperature", "greedy", "eod_id",
                      "return_log_probs", "batch_times_seqlen_threshold",
                      "top_p_decay", "top_p_bound", "extra_stop_ids",
-                     "stop_pairs", "ban_pairs", "rolling_cache"),
+                     "stop_pairs", "ban_pairs", "rolling_cache",
+                     "cache_len"),
 )
 def generate_tokens(
     model,
@@ -118,8 +119,22 @@ def generate_tokens(
     stop_pairs: tuple = (),
     ban_pairs: tuple = (),
     rolling_cache: bool = False,
+    cache_len: Optional[int] = None,
 ):
     """Returns (tokens [b, total], gen_lengths [b], log_probs [b, total]).
+
+    ``cache_len``: allocate the KV cache with at least this many slots
+    (>= prompt + max_new_tokens).  Decode masks cache positions beyond
+    the current index, so results are identical; per-step attention
+    cost then depends on the allocation, not on max_new_tokens — which
+    is what lets benchmarks difference two generation lengths at equal
+    per-step cost (tools/decode_bench.py).  NOTE this alone does NOT
+    make compiles reusable across request shapes: the jit still keys
+    on the prompt array shape and the static max_new_tokens — a server
+    wanting few compiles must pad prompts to bucket widths and fix
+    max_new_tokens per bucket (at which point the cache size is
+    already uniform).  Ignored for rolling caches, which are already
+    fixed-size (the sliding window).
 
     ``batch_times_seqlen_threshold``: prefill forwards whose batch*seqlen
     exceeds it run micro-batched (sequential ``lax.map`` chunks), so the
@@ -136,7 +151,9 @@ def generate_tokens(
     cfg = model.cfg
     b, max_prompt = prompt_tokens.shape
     total = max_prompt + max_new_tokens
-    caches = init_kv_caches(cfg, b, total, rolling=rolling_cache)
+    cache_total = total if (cache_len is None or rolling_cache) \
+        else max(cache_len, total)
+    caches = init_kv_caches(cfg, b, cache_total, rolling=rolling_cache)
 
     tokens = jnp.concatenate(
         [prompt_tokens,

@@ -1,0 +1,27 @@
+"""tools/decode_bench.py runs end-to-end and prints decode/e2e rates."""
+
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_decode_bench_tiny():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "decode_bench.py"),
+         "--preset", "tiny"],
+        capture_output=True, text=True, timeout=600, cwd=ROOT,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    # the differenced decode rate may legitimately be INVALID on a fast
+    # host (the tiny preset's extra steps can sit inside run-to-run
+    # jitter — that's the guard working); e2e must always be real
+    m = re.search(
+        r"decode\s+(?:([0-9.]+) tok/s|INVALID \(t2-t1 jitter\)) "
+        r"\| e2e\s+([0-9.]+) tok/s", r.stdout)
+    assert m, r.stdout
+    if m.group(1) is not None:
+        assert float(m.group(1)) > 0
+    assert float(m.group(2)) > 0

@@ -412,3 +412,23 @@ def test_microbatched_prefill_matches_monolithic(model_and_params):
     np.testing.assert_array_equal(np.asarray(len_a), np.asarray(len_b))
     np.testing.assert_allclose(np.asarray(lp_a), np.asarray(lp_b),
                                atol=2e-5)
+
+
+def test_cache_len_padding_is_invisible(model_and_params):
+    """A padded KV cache (cache_len > prompt+max_new) is masked out:
+    tokens, lengths, and log-probs match the exact-size cache bit for
+    bit (the knob behind tools/decode_bench.py's equal-cost
+    differencing)."""
+    from megatron_llm_tpu.text_generation.generation import generate_tokens
+    model, params = model_and_params
+    toks = jnp.array([[3, 5, 7, 9], [2, 4, 0, 0]], jnp.int32)
+    lens = jnp.array([4, 2], jnp.int32)
+    key = jax.random.PRNGKey(1)
+    kw = dict(max_new_tokens=6, min_prompt_len=2, greedy=True,
+              return_log_probs=True)
+    t0, l0, p0 = generate_tokens(model, params, toks, lens, key, **kw)
+    t1, l1, p1 = generate_tokens(model, params, toks, lens, key,
+                                 cache_len=4 + 6 + 17, **kw)
+    np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+    np.testing.assert_allclose(np.asarray(p0), np.asarray(p1), atol=1e-5)

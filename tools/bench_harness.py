@@ -26,6 +26,13 @@ def enable_compile_cache():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
+# the on-chip bench shape (docs/perf_tpu.md): ~650M llama, MXU-aligned
+# head_dim 128 — ONE definition shared by bench-shape presets in
+# profile_step / decode_bench (mfu_sweep's GROUPS spell shapes out per
+# trial because shapes ARE its sweep axes)
+BENCH_SHAPE = dict(L=10, h=2048, heads=16, ffn=5632)
+
+
 def make_cfg(*, L=16, h=1280, heads=16, ffn=3584, seq=2048, vocab=32000,
              remat="selective", flash=True, fused_rms=True, experts=0,
              top_k=2, fused_ce=False):

@@ -22,14 +22,14 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from tools.bench_harness import (enable_compile_cache, make_cfg,
-                                 build_concrete, make_batch)
+from tools.bench_harness import (BENCH_SHAPE, enable_compile_cache,
+                                 make_cfg, build_concrete, make_batch)
 
 import jax
 
 PRESETS = {
     # the on-chip bench shape (docs/perf_tpu.md): ~650M llama
-    "bench": dict(L=10, h=2048, heads=16, ffn=5632, seq=2048, mb=4),
+    "bench": dict(**BENCH_SHAPE, seq=2048, mb=4),
     # small enough for CPU / CI
     "tiny": dict(L=2, h=128, heads=4, ffn=352, seq=64, mb=2),
 }

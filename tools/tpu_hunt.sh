@@ -28,9 +28,10 @@ DEADLINE=$(( $(date +%s) + 36000 ))   # give up after 10h
 # One list of steps, used by the run loop, all_settled, and the final
 # status report alike.  Timeouts are generous per-group compile budgets.
 # First wave = the VERDICT playbook must-haves; second wave = gravy
-# measurements (MoE dispatch overhead, long-seq + xla comparison) that
+# measurements (MoE dispatch overhead, long-seq + xla comparison,
+# decode throughput) that
 # only run once every first-wave step has settled.
-STEPS=(fusedbwd seq4096 bigvocab bench_final moe long)
+STEPS=(fusedbwd seq4096 bigvocab bench_final moe long decode)
 step_cmd() {
   case $1 in
     fusedbwd)    echo "python tools/mfu_sweep.py fusedbwd" ;;
@@ -39,13 +40,14 @@ step_cmd() {
     bench_final) echo "python bench.py" ;;
     moe)         echo "python tools/mfu_sweep.py moe" ;;
     long)        echo "python tools/mfu_sweep.py long" ;;
+    decode)      echo "python tools/decode_bench.py" ;;
   esac
 }
 step_tmo() {
   case $1 in
     fusedbwd) echo 1500 ;; seq4096) echo 1800 ;;
     bigvocab) echo 2100 ;; bench_final) echo 900 ;;
-    moe) echo 1200 ;; long) echo 1500 ;;
+    moe) echo 1200 ;; long) echo 1500 ;; decode) echo 1200 ;;
   esac
 }
 
