@@ -147,6 +147,11 @@ def _add_regularization_args(parser):
     g.add_argument("--adam_beta2", type=float, default=0.999)
     g.add_argument("--adam_eps", type=float, default=1e-8)
     g.add_argument("--sgd_momentum", type=float, default=0.9)
+    g.add_argument("--optimizer_state_dtype", default="fp32",
+                   choices=["fp32", "bf16"],
+                   help="storage dtype of Adam moments / SGD momentum "
+                        "(bf16 halves optimizer-state memory+traffic; "
+                        "step math stays fp32)")
 
 
 def _add_training_args(parser):
@@ -596,6 +601,7 @@ def train_config_from_args(args) -> TrainConfig:
         adam_beta2=args.adam_beta2,
         adam_eps=args.adam_eps,
         sgd_momentum=args.sgd_momentum,
+        optimizer_state_dtype=args.optimizer_state_dtype,
         clip_grad=args.clip_grad,
         fp16=args.fp16,
         bf16=args.bf16,

@@ -296,6 +296,13 @@ class TrainConfig:
     adam_beta2: float = 0.999
     adam_eps: float = 1e-8
     sgd_momentum: float = 0.9
+    # 'fp32' (default) | 'bf16': storage dtype of the Adam moments /
+    # SGD momentum buffer.  bf16 halves optimizer-state HBM (and its
+    # read+write traffic in the step, and checkpoint size); the step
+    # math still runs in fp32 (state is upcast, computed, downcast).
+    # Master params are unaffected — they stay fp32.  Beyond-reference
+    # (the reference's apex Adam is fp32-state only).
+    optimizer_state_dtype: str = "fp32"
     clip_grad: float = 1.0
     # mixed precision
     fp16: bool = False
@@ -308,6 +315,12 @@ class TrainConfig:
     # misc
     seed: int = 1234
     data_parallel_random_init: bool = False
+
+    def __post_init__(self):
+        if self.optimizer_state_dtype not in ("fp32", "bf16"):
+            raise ValueError(
+                f"optimizer_state_dtype must be fp32|bf16, got "
+                f"{self.optimizer_state_dtype!r}")
 
     @property
     def grad_accum_steps_fn(self):

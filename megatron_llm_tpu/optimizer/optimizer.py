@@ -68,6 +68,13 @@ class MegatronOptimizer:
         self.cfg = train_cfg
         self.params_dtype = params_dtype
         self.is_low_precision = params_dtype != jnp.float32
+        # moments storage dtype (config.optimizer_state_dtype): bf16
+        # halves state HBM + step traffic; the update math below always
+        # upcasts to fp32, so only STORAGE precision changes
+        self.state_dtype = (
+            jnp.bfloat16 if train_cfg.optimizer_state_dtype == "bf16"
+            else jnp.float32
+        )
         # loss scaling: only for fp16 (bf16 trains unscaled) —
         # reference: optimizer/__init__.py:88-107
         if train_cfg.fp16:
@@ -85,15 +92,16 @@ class MegatronOptimizer:
 
     # ------------------------------------------------------------------
     def init(self, params) -> OptimizerState:
-        f32 = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        sd = self.state_dtype
+        zeros = lambda p: jnp.zeros_like(p, dtype=sd)
         master = (
             jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
             if self.is_low_precision
             else None
         )
-        exp_avg = jax.tree_util.tree_map(f32, params)
+        exp_avg = jax.tree_util.tree_map(zeros, params)
         exp_avg_sq = (
-            jax.tree_util.tree_map(f32, params)
+            jax.tree_util.tree_map(zeros, params)
             if self.cfg.optimizer == "adam"
             else None
         )
@@ -157,12 +165,13 @@ class MegatronOptimizer:
             bc2 = 1.0 - b2 ** t
 
             def upd(m_old, v_old, g, p32, w):
-                m = b1 * m_old + (1.0 - b1) * g
-                v = b2 * v_old + (1.0 - b2) * jnp.square(g)
+                m = b1 * m_old.astype(jnp.float32) + (1.0 - b1) * g
+                v = (b2 * v_old.astype(jnp.float32)
+                     + (1.0 - b2) * jnp.square(g))
                 update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
                 # AdamW decoupled weight decay (apex adam_w_mode default)
                 new_p = p32 - lr * (update + w * p32)
-                return m, v, new_p
+                return m.astype(m_old.dtype), v.astype(v_old.dtype), new_p
 
             out = jax.tree_util.tree_map(
                 upd, state.exp_avg, state.exp_avg_sq, grads, masters, wd_mask
@@ -178,9 +187,9 @@ class MegatronOptimizer:
 
             def upd(buf_old, g, p32, w):
                 g = g + w * p32
-                buf = mom * buf_old + g
+                buf = mom * buf_old.astype(jnp.float32) + g
                 new_p = p32 - lr * buf
-                return buf, new_p
+                return buf.astype(buf_old.dtype), new_p
 
             out = jax.tree_util.tree_map(upd, state.exp_avg, grads, masters, wd_mask)
             new_m = jax.tree_util.tree_map(lambda o: o[0], out,

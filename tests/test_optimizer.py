@@ -173,3 +173,70 @@ def test_zero1_realized_shardings(utils):
     # the pre-sharding state (replicated) must fail loudly
     with pytest.raises(RuntimeError, match="not dp-sharded"):
         opt.verify_zero1_sharding(opt_state, min_bytes=32 << 10)
+
+
+def test_bf16_optimizer_state_dtype():
+    """optimizer_state_dtype='bf16' stores moments in bf16 (half the
+    state bytes) while the update math stays fp32: a short training
+    trajectory stays close to the fp32-state run, and the first step
+    (zero-initialized moments, exactly representable) matches it."""
+    def run(state_dtype, steps=20):
+        tc = TrainConfig(optimizer="adam", lr=0.0, clip_grad=0.0,
+                         weight_decay=0.0,
+                         optimizer_state_dtype=state_dtype)
+        opt = MegatronOptimizer(tc)
+        params = _params()
+        state = opt.init(params)
+        key = jax.random.PRNGKey(7)
+        traj = []
+        for i in range(steps):
+            key, k = jax.random.split(key)
+            grads = jax.tree_util.tree_map(
+                lambda p, k=k: jax.random.normal(k, p.shape, jnp.float32),
+                params)
+            params, state, _ = opt.step(params, grads, state, 0.05, 0.0)
+            traj.append(np.asarray(params["layer"]["kernel"]).copy())
+        return state, traj
+
+    s32, t32 = run("fp32")
+    s16, t16 = run("bf16")
+    # storage dtype + leaf-wise byte halving
+    m32 = s32.exp_avg["layer"]["kernel"]
+    m16 = s16.exp_avg["layer"]["kernel"]
+    assert m32.dtype == jnp.float32 and m16.dtype == jnp.bfloat16
+    assert s16.exp_avg_sq["layer"]["kernel"].dtype == jnp.bfloat16
+    assert m16.nbytes * 2 == m32.nbytes
+    # master params stay fp32 regardless (here params are fp32 -> None)
+    # step 1 exact (moments start at zero: no accumulated rounding yet,
+    # and the step-1 Adam update is sign(g)-scaled so storage precision
+    # cancels), later steps track within bf16 accumulation error
+    np.testing.assert_allclose(t16[0], t32[0], atol=1e-6)
+    np.testing.assert_allclose(t16[-1], t32[-1], rtol=0.0, atol=5e-2)
+    # the trajectories must not be identical arrays by accident of an
+    # unwired knob: assert the bf16 state really is coarser somewhere
+    assert any(not np.array_equal(a, b) for a, b in zip(t16[1:], t32[1:]))
+
+
+def test_bf16_state_with_low_precision_params():
+    """bf16 moments compose with bf16 params + fp32 masters (the bench
+    configuration): masters remain fp32 and training still converges
+    on the quadratic toy problem."""
+    tc = TrainConfig(optimizer="adam", lr=0.0, clip_grad=0.0,
+                     weight_decay=0.0, bf16=True,
+                     optimizer_state_dtype="bf16")
+    opt = MegatronOptimizer(tc, params_dtype=jnp.bfloat16)
+    params = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16), _params())
+    state = opt.init(params)
+    assert state.master_params["layer"]["kernel"].dtype == jnp.float32
+    assert state.exp_avg["layer"]["kernel"].dtype == jnp.bfloat16
+    target = jax.tree_util.tree_map(jnp.zeros_like, params)
+    loss0 = None
+    for i in range(30):
+        grads = jax.tree_util.tree_map(
+            lambda p, t: (p - t).astype(jnp.float32), params, target)
+        loss = float(sum(jnp.sum(jnp.square(g))
+                         for g in jax.tree_util.tree_leaves(grads)))
+        loss0 = loss0 if loss0 is not None else loss
+        params, state, _ = opt.step(params, grads, state, 0.05, 0.0)
+    assert loss < 0.5 * loss0

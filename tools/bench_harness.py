@@ -48,7 +48,7 @@ def make_cfg(*, L=16, h=1280, heads=16, ffn=3584, seq=2048, vocab=32000,
         fused_lm_cross_entropy=fused_ce)
 
 
-def build_concrete(cfg, mb, num_micro=1):
+def build_concrete(cfg, mb, num_micro=1, opt_state_dtype="fp32"):
     """Initialized (model, params, opt, opt_state, step) for one config."""
     import jax
     import jax.numpy as jnp
@@ -60,7 +60,8 @@ def build_concrete(cfg, mb, num_micro=1):
     params = model.init(jax.random.PRNGKey(0))
     tc = TrainConfig(micro_batch_size=mb,
                      global_batch_size=mb * num_micro, train_iters=0,
-                     lr=1e-4, optimizer="adam", bf16=True, clip_grad=1.0)
+                     lr=1e-4, optimizer="adam", bf16=True, clip_grad=1.0,
+                     optimizer_state_dtype=opt_state_dtype)
     opt = MegatronOptimizer(tc, params_dtype=jnp.bfloat16)
     opt_state = opt.init(params)
     step = build_train_step(model, opt, ParallelConfig(), num_micro)

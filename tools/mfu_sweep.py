@@ -27,7 +27,7 @@ PEAK = 197e12
 def bench_cfg(label, mb=8, remat="selective", flash=True, fused_rms=True,
               L=16, h=1280, ffn=3584, heads=16, seq=2048, iters=5, bq=None,
               bk=None, experts=0, top_k=2, fused_bwd=None, vocab=32000,
-              fused_ce=False):
+              fused_ce=False, opt_state_dtype="fp32"):
     import megatron_llm_tpu.ops.pallas.flash_attention as fa
     orig_bq, orig_bk = fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K
     orig_fused = fa.FUSED_BACKWARD
@@ -42,7 +42,8 @@ def bench_cfg(label, mb=8, remat="selective", flash=True, fused_rms=True,
                        vocab=vocab, remat=remat, flash=flash,
                        fused_rms=fused_rms, experts=experts, top_k=top_k,
                        fused_ce=fused_ce)
-        model, params, opt, opt_state, step = build_concrete(cfg, mb)
+        model, params, opt, opt_state, step = build_concrete(
+            cfg, mb, opt_state_dtype=opt_state_dtype)
         n = model.num_params(params)
         batch = make_batch(mb, seq, vocab)
         key = jax.random.PRNGKey(1)
@@ -170,6 +171,17 @@ GROUPS["bigvocab"] = [
          vocab=262144),
     dict(label="v256k fused-CE", mb=2, h=2048, heads=16, ffn=5632, L=6,
          vocab=262144, fused_ce=True),
+]
+# bf16 optimizer-state A/B (optimizer_state_dtype): the Adam moments are
+# pure HBM traffic in the step — storing them bf16 halves those
+# bytes.  Same shape as the bench config.
+GROUPS["optstate"] = [
+    dict(label="650M fp32 moments (bench)", mb=4, h=2048, heads=16,
+         ffn=5632, L=10),
+    dict(label="650M bf16 moments", mb=4, h=2048, heads=16, ffn=5632,
+         L=10, opt_state_dtype="bf16"),
+    dict(label="650M seq4096 bf16 moments", mb=2, h=2048, heads=16,
+         ffn=5632, L=10, seq=4096, opt_state_dtype="bf16"),
 ]
 GROUPS["all"] = GROUPS["baseline"] + GROUPS["blocks"]
 

@@ -31,7 +31,7 @@ DEADLINE=$(( $(date +%s) + 36000 ))   # give up after 10h
 # measurements (MoE dispatch overhead, long-seq + xla comparison,
 # decode throughput) that
 # only run once every first-wave step has settled.
-STEPS=(fusedbwd seq4096 bigvocab bench_final moe long decode)
+STEPS=(fusedbwd seq4096 bigvocab bench_final moe long decode optstate)
 step_cmd() {
   case $1 in
     fusedbwd)    echo "python tools/mfu_sweep.py fusedbwd" ;;
@@ -41,6 +41,7 @@ step_cmd() {
     moe)         echo "python tools/mfu_sweep.py moe" ;;
     long)        echo "python tools/mfu_sweep.py long" ;;
     decode)      echo "python tools/decode_bench.py" ;;
+    optstate)    echo "python tools/mfu_sweep.py optstate" ;;
   esac
 }
 step_tmo() {
@@ -48,6 +49,7 @@ step_tmo() {
     fusedbwd) echo 1500 ;; seq4096) echo 1800 ;;
     bigvocab) echo 2100 ;; bench_final) echo 900 ;;
     moe) echo 1200 ;; long) echo 1500 ;; decode) echo 1200 ;;
+    optstate) echo 1200 ;;
   esac
 }
 
