@@ -1,0 +1,70 @@
+"""Drive bench.py's TPU branch on CPU via BENCH_SIMULATE_TPU.
+
+The real TPU branch gets one shot per tunnel window; these tests execute
+the same code path (primary seq-4096-analog, flash-fallback guard,
+secondary block, record schema, cache-persist guard) at a tiny shape so
+a bug there is caught in CI, not on-chip.  Crucially: a simulated
+record must NEVER be persisted as an on-chip measurement — round 5
+caught exactly that overwrite in manual testing.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_sim(tmp_path, extra_env):
+    env = dict(os.environ, BENCH_SIMULATE_TPU="1", JAX_PLATFORMS="cpu",
+               **extra_env)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                       capture_output=True, text=True, timeout=900,
+                       cwd=ROOT, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    return json.loads(line)
+
+
+def test_sim_flash_ok_runs_primary_and_secondary(tmp_path):
+    cache = os.path.join(ROOT, ".bench_cache", "latest_tpu.json")
+    before = open(cache).read() if os.path.exists(cache) else None
+    # snapshot so a guard REGRESSION can't destroy the real on-chip
+    # record (irreplaceable during a tunnel outage) — the assertion
+    # below still catches the bug, the artifact survives it
+    if before is not None:
+        (tmp_path / "cache_snapshot.json").write_text(before)
+    try:
+        rec = _run_sim(tmp_path, {"BENCH_SIM_FLASH_OK": "1"})
+    finally:
+        if before is not None and (not os.path.exists(cache)
+                                   or open(cache).read() != before):
+            polluted = open(cache).read() if os.path.exists(cache) else None
+            with open(cache, "w") as f:
+                f.write(before)
+        else:
+            polluted = None
+    assert rec["simulated"] is True
+    assert rec["model"] == "llama-sim"
+    # primary at the sim's "4096-analog", secondary block at half
+    assert rec["seq_length"] == 256
+    assert rec["seq2048"] is not None
+    assert rec["seq2048"]["seq_length"] == 128
+    # a real training loss, not an out-of-range-embedding NaN
+    assert rec["loss"] == rec["loss"] and rec["loss"] < 7.0
+    # the cache-persist guard: simulated records never reach the cache
+    # (the finally above already restored the artifact if not)
+    assert polluted is None, \
+        f"simulated record polluted the TPU cache: {polluted[:200]}"
+
+
+def test_sim_flash_fail_falls_back(tmp_path):
+    rec = _run_sim(tmp_path, {})
+    # no flash -> primary drops to the secondary seq and mb; no secondary
+    assert rec["seq_length"] == 128
+    assert rec["micro_batch"] == 4
+    assert rec["seq2048"] is None
+    assert rec["attention"] == "xla"
+    assert rec["simulated"] is True
