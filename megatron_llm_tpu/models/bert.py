@@ -41,6 +41,7 @@ from megatron_llm_tpu.parallel.layers import (
     init_method_normal,
     parallel_lm_logits,
 )
+from megatron_llm_tpu.quantization import dequantize_kernel
 
 
 # Architecture flags BERT forces (reference asserts spread through
@@ -97,7 +98,8 @@ def init_bert_lm_head_params(key, cfg: TransformerConfig, dtype):
 
 
 def bert_lm_head(hidden: jax.Array, params, word_embedding, cfg) -> jax.Array:
-    h = jnp.einsum("...h,hk->...k", hidden, params["dense"]["kernel"].astype(hidden.dtype))
+    h = jnp.einsum("...h,hk->...k", hidden,
+                   dequantize_kernel(params["dense"], hidden.dtype))
     h = h + params["dense"]["bias"].astype(hidden.dtype)
     h = jax.nn.gelu(h, approximate=False)
     h = apply_norm(h, params["layernorm"], "layernorm", eps=cfg.layernorm_epsilon,
@@ -118,7 +120,8 @@ def init_pooler_params(key, cfg: TransformerConfig, dtype):
 
 def pooler(hidden: jax.Array, params) -> jax.Array:
     first = hidden[:, 0, :]
-    out = first @ params["kernel"].astype(first.dtype) + params["bias"].astype(first.dtype)
+    out = (first @ dequantize_kernel(params, first.dtype)
+           + params["bias"].astype(first.dtype))
     return jnp.tanh(out)
 
 
@@ -213,7 +216,7 @@ class BertModel:
             pooled = pooler(hidden, params["pooler"])
             bh = params["binary_head"]
             binary_logits = (
-                pooled @ bh["kernel"].astype(pooled.dtype)
+                pooled @ dequantize_kernel(bh, pooled.dtype)
                 + bh["bias"].astype(pooled.dtype)
             )
 

@@ -35,6 +35,7 @@ from megatron_llm_tpu.parallel.layers import (
     init_linear_params,
     init_method_normal,
 )
+from megatron_llm_tpu.quantization import dequantize_kernel
 
 
 class BiEncoderModel:
@@ -110,7 +111,7 @@ class BiEncoderModel:
         pooled = hidden[:, 0, :]  # [CLS] representation (reference :309)
         if "projection" in tower:
             p = tower["projection"]
-            pooled = (pooled @ p["kernel"].astype(pooled.dtype)
+            pooled = (pooled @ dequantize_kernel(p, pooled.dtype)
                       + p["bias"].astype(pooled.dtype))
         return pooled
 

@@ -31,6 +31,7 @@ from megatron_llm_tpu.parallel.layers import (
     init_linear_params,
     init_method_normal,
 )
+from megatron_llm_tpu.quantization import dequantize_kernel
 
 
 class ClassificationModel:
@@ -108,7 +109,7 @@ class ClassificationModel:
         pooled = _dropout(pooled, self.cfg.hidden_dropout, k_drop, train)
         head = params["classification_head"]
         logits = (
-            pooled @ head["kernel"].astype(pooled.dtype)
+            pooled @ dequantize_kernel(head, pooled.dtype)
             + head["bias"].astype(pooled.dtype)
         )
         if labels is None:

@@ -42,6 +42,7 @@ from megatron_llm_tpu.parallel.layers import (
     scaled_init_method_normal,
 )
 from megatron_llm_tpu.parallel.sharding import constrain
+from megatron_llm_tpu.quantization import dequantize_weight
 
 
 def moe_capacity(cfg: TransformerConfig, seq_len: int) -> int:
@@ -181,8 +182,8 @@ def moe_mlp(
     expert_in = constrain(expert_in, ex, None, None, None)
 
     # --- per-expert FFN, tp-sharded like the dense MLP ---
-    w_in = params["experts"]["w_in"].astype(cdtype)
-    w_out = params["experts"]["w_out"].astype(cdtype)
+    w_in = dequantize_weight(params["experts"], "w_in", cdtype)
+    w_out = dequantize_weight(params["experts"], "w_out", cdtype)
     mid = jnp.einsum("ebch,ehf->ebcf", expert_in, w_in)
     mid = constrain(mid, ex, None, None, "ffn")
     mid = apply_mlp_activation(mid, cfg)

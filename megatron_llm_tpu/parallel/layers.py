@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from megatron_llm_tpu.parallel.sharding import constrain
+from megatron_llm_tpu.quantization import dequantize_kernel
 
 
 # ---------------------------------------------------------------------------
@@ -136,10 +137,9 @@ def column_parallel_linear(
     all-gathers it (the reference's explicit fwd all-gather,
     layers.py:225-243).
     """
-    kernel = params["kernel"]
+    kernel = dequantize_kernel(params, compute_dtype)
     bias = params.get("bias")
     if compute_dtype is not None:
-        kernel = kernel.astype(compute_dtype)
         bias = bias.astype(compute_dtype) if bias is not None else None
     if sequence_parallel:
         x = constrain(x, "batch", "seq_tp", None)
@@ -171,10 +171,9 @@ def row_parallel_linear(
     Bias is added *after* the reduction, on the full output (reference adds
     bias post-reduction so it is applied once, not tp times).
     """
-    kernel = params["kernel"]
+    kernel = dequantize_kernel(params, compute_dtype)
     bias = params.get("bias")
     if compute_dtype is not None:
-        kernel = kernel.astype(compute_dtype)
         bias = bias.astype(compute_dtype) if bias is not None else None
     x = constrain(x, "batch", "seq", in_logical)
     y = jnp.einsum("...f,fh->...h", x, kernel)

@@ -1,0 +1,153 @@
+"""Weight-only int8 quantization for inference (beyond-reference).
+
+Decode is weight-bandwidth-bound: each generated token re-reads every
+dense weight from HBM.  Storing the linear kernels as int8 with
+per-output-channel fp32 scales halves those bytes; the dequantize
+(``int8 -> compute dtype, * scale``) sits directly on the matmul
+operand, where XLA fuses it into the dot's operand load — int8 lives in
+HBM, full precision exists only tile-wise on the way into the MXU.
+
+Scope: the 2-D linear kernels (QKV/out-proj/MLP — the overwhelming
+majority of weight bytes).  Embedding tables and the LM head stay in
+the compute dtype (gather/logits paths, small share of bytes).
+Inference-only: the training step expects float ``kernel`` leaves.
+
+Usage::
+
+    from megatron_llm_tpu.quantization import quantize_linear_weights_int8
+    qparams = quantize_linear_weights_int8(params)
+    generate_tokens(model, qparams, ...)   # same call sites
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def _quantize_kernel(kernel: jax.Array):
+    """[..., in, out] float (plain, scanned [L, ...], or MoE expert
+    bank [L, E, ...]) -> (int8 kernel_q, fp32 [..., out] kernel_scale).
+
+    Symmetric per-output-channel absmax scaling, reducing the input
+    axis (-2); out = last axis in both the column `hf` and row `fh`
+    kernel conventions.  The scanned layer stack stores kernels with a
+    leading layer dim — per-(layer, channel) scales, and the scan's
+    per-layer slicing hands the linear fns matching [in,out]/[out]
+    views."""
+    k32 = kernel.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(k32), axis=-2)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(k32 / scale[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+#: weight names the quantizer understands, all stored [..., in, out]:
+#: 'kernel' (dense linears), 'w_in'/'w_out' (MoE expert banks, moe.py)
+QUANTIZABLE_WEIGHTS = ("kernel", "w_in", "w_out")
+
+
+def dequantize_weight(params: dict, name: str,
+                      compute_dtype=None) -> jax.Array:
+    """The matmul operand for a (possibly quantized) named weight.
+
+    Keeping the dequant exactly here (multiply on the operand) is what
+    lets XLA fuse it into the dot instead of materializing a
+    full-precision copy in HBM."""
+    if f"{name}_q" in params:
+        dt = compute_dtype if compute_dtype is not None else jnp.bfloat16
+        scale = params[f"{name}_scale"].astype(dt)
+        return params[f"{name}_q"].astype(dt) * scale[..., None, :]
+    w = params[name]
+    if compute_dtype is not None:
+        w = w.astype(compute_dtype)
+    return w
+
+
+def dequantize_kernel(params: dict, compute_dtype=None) -> jax.Array:
+    """column/row_parallel_linear's operand (see dequantize_weight)."""
+    return dequantize_weight(params, "kernel", compute_dtype)
+
+
+def quantize_linear_weights_int8(params: Any, min_params: int = 4096):
+    """Tree transform: every linear param dict ({'kernel': 2-D float})
+    with at least ``min_params`` elements becomes
+    {'kernel_q': int8, 'kernel_scale': fp32[out], ...bias unchanged}.
+
+    Norm scales (1-D), embeddings (no 'kernel' key), and tiny kernels
+    are left untouched."""
+    def walk(node):
+        if isinstance(node, dict):
+            # never quantize MoE routers: routing logits are decision
+            # variables (per-expert scale perturbs top-k choices) and the
+            # [hidden, experts] tensor is negligible HBM
+            if "router" in node:
+                rest = {key: walk(v) for key, v in node.items()
+                        if key != "router"}
+                rest["router"] = node["router"]
+                return rest
+            # quantizable members are always linear-layout [..., in,
+            # out]: 2-D plain, 3-D scanned layer stacks / expert banks,
+            # 4-D stacked expert banks [L, E, in, out]
+            hits = [key for key in QUANTIZABLE_WEIGHTS
+                    if (hasattr(node.get(key), "ndim")
+                        and 2 <= node[key].ndim <= 4
+                        and jnp.issubdtype(node[key].dtype, jnp.floating)
+                        and node[key].size >= min_params)]
+            out = {key: walk(v) for key, v in node.items()
+                   if key not in hits}
+            for key in hits:
+                q, scale = _quantize_kernel(node[key])
+                out[f"{key}_q"] = q
+                out[f"{key}_scale"] = scale
+            return out
+        if isinstance(node, (list, tuple)):
+            t = type(node)
+            return t(walk(v) for v in node)
+        return node
+
+    return walk(params)
+
+
+def quantize_param_specs(specs: Any, qparams: Any):
+    """Spec-tree transform mirroring ``quantize_linear_weights_int8``:
+    wherever the quantized tree carries kernel_q/kernel_scale, the spec
+    dict's 'kernel' entry becomes kernel_q (same spec — int8 shards
+    exactly like the float kernel did) + kernel_scale (the kernel spec
+    minus its input axis, i.e. drop entry -2), so
+    ``shard_params(qparams, quantize_param_specs(model.param_specs(p),
+    qparams))`` works for tp-sharded int8 serving."""
+    def walk(sp, qp):
+        if isinstance(sp, dict):
+            out = {}
+            for key, v in sp.items():
+                if (key in QUANTIZABLE_WEIGHTS and isinstance(qp, dict)
+                        and f"{key}_q" in qp):
+                    kspec = tuple(v)
+                    out[f"{key}_q"] = kspec
+                    out[f"{key}_scale"] = kspec[:-2] + kspec[-1:]
+                else:
+                    out[key] = walk(v, qp.get(key) if isinstance(qp, dict)
+                                    else None)
+            return out
+        if isinstance(sp, (list, tuple)) and not all(
+                isinstance(x, (str, type(None))) for x in sp):
+            t = type(sp)
+            return t(walk(v, qp[i] if isinstance(qp, (list, tuple))
+                          else None) for i, v in enumerate(sp))
+        return sp
+
+    return walk(specs, qparams)
+
+
+def quantized_weight_bytes(params: Any):
+    """(quantized_bytes, float_bytes) over all leaves — the HBM story."""
+    qb = fb = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        if hasattr(leaf, "dtype"):
+            if leaf.dtype == jnp.int8:
+                qb += leaf.nbytes
+            else:
+                fb += leaf.nbytes
+    return qb, fb

@@ -98,6 +98,13 @@ def main():
           f"{jax.default_backend()}", flush=True)
     for b in p["batches"]:
         run_trial(model, params, b, p["prompt"], p["gen"], p["vocab"])
+    # weight-only int8 A/B: decode re-reads every dense weight per
+    # token, so halving those bytes targets the decode bandwidth bound
+    from megatron_llm_tpu.quantization import quantize_linear_weights_int8
+    qparams = quantize_linear_weights_int8(params)
+    print("decode_bench: int8 weight-only quantized kernels", flush=True)
+    for b in p["batches"]:
+        run_trial(model, qparams, b, p["prompt"], p["gen"], p["vocab"])
 
 
 if __name__ == "__main__":
