@@ -1,0 +1,151 @@
+"""Speculative greedy decoding with prompt-lookup (n-gram) drafting.
+
+Beyond-reference serving acceleration.  Decode on TPU is weight-
+bandwidth-bound: a forward over K+1 tokens costs barely more than over
+1 (same weight bytes cross HBM), so verifying K guessed tokens in one
+chunked cache-forward is nearly free — every accepted guess is a decode
+step that never pays the per-token weight read.  Drafts come from
+prompt-lookup decoding (n-gram continuation): the most recent earlier
+occurrence of the current bigram proposes the next K tokens.  Great on
+repetitive workloads (summarization, code edit, RAG quoting); on
+adversarial text acceptance drops to 0 and the cost approaches vanilla.
+
+EXACTNESS: output is token-for-token identical to vanilla greedy
+decoding (tests/test_speculative.py asserts it).  The accept rule
+commits argmax(L_i) for i = 0..a where a is the longest prefix with
+draft_i == argmax(L_{i-1}); position p'-1's KV may be stale for a
+rejected draft, but the next iteration re-forwards from p'-1 and
+overwrites it — the cache invariant (KV valid through p'-2) holds.
+
+Scope: batch 1, greedy, linear cache (the interactive-serving case).
+"""
+
+from typing import Optional
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from megatron_llm_tpu.text_generation.generation import (
+    _forward_with_cache,
+    init_kv_caches,
+)
+
+
+def _lookup_draft(tokens: jax.Array, pos: jax.Array, k: int) -> jax.Array:
+    """Most-recent bigram-match continuation: K guesses for positions
+    pos..pos+K-1 given committed tokens[0:pos].  tokens is the [total]
+    working buffer (committed prefix + zeros)."""
+    total = tokens.shape[0]
+    idx = jnp.arange(total)
+    b0, b1 = tokens[pos - 2], tokens[pos - 1]
+    # match j: committed bigram at (j, j+1) equals the current one, with
+    # the continuation window starting before pos (j+2 <= pos-? any
+    # earlier occurrence strictly before the current bigram)
+    nxt = jnp.roll(tokens, -1)
+    match = (tokens == b0) & (nxt == b1) & (idx + 2 < pos) & (idx + 1 < total)
+    m = jnp.max(jnp.where(match, idx, -1))  # most recent, or -1
+    start = jnp.where(m >= 0, m + 2, 0)
+    # dynamic_slice clamps start so the window fits — harmless for
+    # guesses (bad guesses just get rejected)
+    return jax.lax.dynamic_slice(tokens, (start,), (k,))
+
+
+def speculative_greedy_generate(
+    model,
+    params,
+    prompt_tokens: jax.Array,   # [1, prompt_len] — NOT right-padded
+    prompt_lengths: jax.Array,  # [1] (must equal prompt_len)
+    *,
+    max_new_tokens: int,
+    draft_k: int = 8,
+    eod_id: Optional[int] = None,
+):
+    """Returns (tokens [1, total], gen_lengths [1]) — identical to the
+    greedy path of ``generate_tokens`` on the same inputs.
+
+    Validation lives in this unjitted wrapper (prompt_lengths is a
+    concrete array here): right-padded prompts are a generate_tokens
+    feature this scope does not implement — padding would be treated as
+    committed context and silently change the output, so refuse
+    (batch-1 serving has no reason to pad)."""
+    assert prompt_tokens.shape[0] == 1, "speculative decode is batch-1"
+    assert int(jnp.asarray(prompt_lengths).reshape(-1)[0]) \
+        == prompt_tokens.shape[1], \
+        "speculative decode takes an unpadded batch-1 prompt"
+    return _spec_impl(model, params, prompt_tokens,
+                      max_new_tokens=max_new_tokens, draft_k=draft_k,
+                      eod_id=eod_id)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "draft_k", "eod_id"),
+)
+def _spec_impl(
+    model,
+    params,
+    prompt_tokens: jax.Array,
+    *,
+    max_new_tokens: int,
+    draft_k: int = 8,
+    eod_id: Optional[int] = None,
+):
+    cfg = model.cfg
+    b, max_prompt = prompt_tokens.shape
+    total = max_prompt + max_new_tokens
+    K = draft_k
+    # working buffer padded by K+1 so the verify window never clamps
+    buf = jnp.zeros((total + K + 1,), prompt_tokens.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, prompt_tokens[0], (0,))
+
+    caches = init_kv_caches(cfg, b, total + K + 1)
+
+    # ---- prefill all but the last prompt token ----------------------------
+    prefill = max_prompt - 1
+    logits, caches = _forward_with_cache(
+        model, params, prompt_tokens[:, :prefill], caches, 0)
+
+    # carry: (pos = #committed tokens, buf, caches, done)
+    state = (jnp.int32(max_prompt), buf, caches, jnp.bool_(False))
+
+    def cond(state):
+        pos, _, _, done = state
+        return (pos < total) & ~done
+
+    def body(state):
+        pos, buf, caches, done = state
+        draft = _lookup_draft(buf, pos, K)
+        # chunk = [last committed token, draft_1..draft_K] at positions
+        # pos-1 .. pos+K-1
+        chunk = jnp.concatenate(
+            [jax.lax.dynamic_slice(buf, (pos - 1,), (1,)), draft])[None, :]
+        logits, new_caches = _forward_with_cache(
+            model, params, chunk, caches, pos - 1)
+        greedy = jnp.argmax(logits[0], axis=-1).astype(buf.dtype)  # [K+1]
+        # accept: longest prefix with draft_i == greedy_{i-1}
+        agree = draft == greedy[:-1]
+        acc = jnp.cumprod(agree.astype(jnp.int32))
+        a = jnp.sum(acc)                        # accepted drafts, 0..K
+        n_commit = a + 1                        # + the bonus token
+        commit = greedy                          # positions pos..pos+K
+        if eod_id is not None:
+            # stop at the first committed EOD (inclusive)
+            is_eod = commit == eod_id
+            hits = jnp.where(is_eod, jnp.arange(K + 1), K + 1)
+            first_eod = jnp.min(hits)
+            done = done | (first_eod < n_commit)
+            n_commit = jnp.minimum(n_commit, first_eod + 1)
+        # never commit past the generation budget
+        n_commit = jnp.minimum(n_commit, total - pos)
+        done = done | (pos + n_commit >= total)
+        # masked write of the K+1 window: keep old beyond n_commit
+        old = jax.lax.dynamic_slice(buf, (pos,), (K + 1,))
+        keep = jnp.arange(K + 1) < n_commit
+        window = jnp.where(keep, commit, old)
+        buf = jax.lax.dynamic_update_slice(buf, window, (pos,))
+        return (pos + n_commit, buf, new_caches, done)
+
+    pos, buf, caches, done = jax.lax.while_loop(cond, body, state)
+    return buf[None, :total], (pos - max_prompt)[None]

@@ -12,7 +12,7 @@ def test_decode_bench_tiny():
     r = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "decode_bench.py"),
          "--preset", "tiny"],
-        capture_output=True, text=True, timeout=600, cwd=ROOT,
+        capture_output=True, text=True, timeout=1200, cwd=ROOT,
     )
     assert r.returncode == 0, r.stdout + r.stderr
     # the differenced decode rate may legitimately be INVALID on a fast

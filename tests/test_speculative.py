@@ -1,0 +1,80 @@
+"""Speculative prompt-lookup decoding == vanilla greedy, token for token."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+from megatron_llm_tpu.text_generation.generation import generate_tokens
+from megatron_llm_tpu.text_generation.speculative import (
+    speculative_greedy_generate,
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = llama_config("tiny", num_layers=2, seq_length=128,
+                       max_position_embeddings=128, padded_vocab_size=64,
+                       use_flash_attn=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _vanilla(model, params, toks, n_new, eod=None):
+    lens = jnp.asarray([toks.shape[1]], jnp.int32)
+    out, n, _ = generate_tokens(
+        model, params, toks, lens, jax.random.PRNGKey(0),
+        max_new_tokens=n_new, min_prompt_len=toks.shape[1], greedy=True,
+        eod_id=eod)
+    return np.asarray(out[0]), int(jnp.asarray(n).reshape(-1)[0])
+
+
+@pytest.mark.parametrize("k", [1, 4, 8])
+@pytest.mark.parametrize("prompt", [
+    # repetitive prompt: lookup drafting should accept often
+    [5, 9, 5, 9, 5, 9, 5, 9],
+    # arbitrary prompt: acceptance may be zero — result must STILL match
+    [3, 17, 42, 8, 11, 2, 29],
+])
+def test_matches_vanilla_greedy(model_and_params, k, prompt):
+    model, params = model_and_params
+    toks = jnp.asarray([prompt], jnp.int32)
+    n_new = 24
+    want, _ = _vanilla(model, params, toks, n_new)
+    got, n = speculative_greedy_generate(
+        model, params, toks, jnp.asarray([len(prompt)], jnp.int32),
+        max_new_tokens=n_new, draft_k=k)
+    np.testing.assert_array_equal(np.asarray(got[0]), want)
+    assert int(jnp.asarray(n).reshape(-1)[0]) == n_new
+
+
+def test_padded_prompt_refused(model_and_params):
+    model, params = model_and_params
+    toks = jnp.asarray([[5, 9, 5, 9, 0, 0]], jnp.int32)
+    with pytest.raises(Exception):
+        speculative_greedy_generate(
+            model, params, toks, jnp.asarray([4], jnp.int32),
+            max_new_tokens=4)
+
+
+def test_eod_stops_early(model_and_params):
+    """With eod_id set to a token the model actually produces, both
+    decoders stop at the same place; tokens agree through the stop."""
+    model, params = model_and_params
+    toks = jnp.asarray([[5, 9, 5, 9, 5, 9]], jnp.int32)
+    n_new = 24
+    # find a token the vanilla run produces, use it as the "eod"
+    full, _ = _vanilla(model, params, toks, n_new)
+    eod = int(full[toks.shape[1] + 4])  # the 5th generated token
+    want, want_n = _vanilla(model, params, toks, n_new, eod=eod)
+    got, got_n = speculative_greedy_generate(
+        model, params, toks, jnp.asarray([6], jnp.int32),
+        max_new_tokens=n_new, draft_k=4, eod_id=eod)
+    got_n = int(jnp.asarray(got_n).reshape(-1)[0])
+    # vanilla's gen length counts through the eod token
+    assert got_n <= n_new
+    stop = toks.shape[1] + got_n
+    np.testing.assert_array_equal(np.asarray(got[0][:stop]), want[:stop])
+    assert int(np.asarray(got[0][stop - 1])) == eod
