@@ -22,20 +22,41 @@ def extra_args(parser):
     g.add_argument("--model_name", required=True)
     g.add_argument("--port", type=int, default=5000)
     g.add_argument("--host", default="0.0.0.0")
+    g.add_argument("--int8_weights", action="store_true",
+                   help="weight-only int8 quantization of the linear "
+                        "kernels at load (halves decode weight traffic; "
+                        "docs/guide/inference.md)")
     return parser
 
 
 def main():
     args = initialize_megatron(extra_args_provider=extra_args)
-    model = MODEL_REGISTRY[args.model_name](
-        transformer_config_from_args(args)
-    )
+    # same per-model presets and derivations as finetune.py: the CLI is
+    # self-sufficient (--model_name=llama2 implies rotary/swiglu/
+    # rmsnorm/no-bias; gemma gets its sqrt(hidden) embedding scale)
+    from finetune import MODEL_DEFAULTS, _apply_model_defaults, model_provider
+    if args.model_name in MODEL_DEFAULTS:
+        _apply_model_defaults(args, sys.argv[1:])
+        model = model_provider(args)
+    else:
+        model = MODEL_REGISTRY[args.model_name](
+            transformer_config_from_args(args)
+        )
     if args.load:
         params, _, _ = checkpointing.load_checkpoint(args.load, finetune=True)
     else:
         print(" no --load given: serving a randomly initialized model")
         params = model.init(jax.random.PRNGKey(args.seed))
-    params = sh.shard_params(params, model.param_specs(params))
+    specs = model.param_specs(params)
+    if args.int8_weights:
+        from megatron_llm_tpu.quantization import (
+            quantize_linear_weights_int8, quantize_param_specs,
+            quantized_weight_bytes)
+        params = quantize_linear_weights_int8(params)
+        specs = quantize_param_specs(specs, params)
+        qb, fb = quantized_weight_bytes(params)
+        print(f" int8 weights: {qb/1e6:.1f} MB int8 + {fb/1e6:.1f} MB float")
+    params = sh.shard_params(params, specs)
     tokenizer = global_vars.get_tokenizer()
     MegatronServer(model, params, tokenizer).run(args.host, args.port)
 
