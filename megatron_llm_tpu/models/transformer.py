@@ -325,6 +325,35 @@ def attention(
                                           (x.shape[0],) + mask.shape[1:])
         new_cache = {"k": ck, "v": cv, "index": idx + q.shape[1],
                      "rolling": None}
+    elif kv_cache is not None and "k_q" in kv_cache:
+        # int8-quantized linear cache (beyond-reference): K/V stored as
+        # int8 with per-(batch, position, group) fp32 absmax scales —
+        # at long context the KV bytes dominate decode HBM traffic, and
+        # this halves them vs bf16 (quarters vs fp32).  Quantize on
+        # write (chunk-local scales), dequantize on read; the int8
+        # arrays are what cross HBM each step.
+        idx = kv_cache["index"]
+        from megatron_llm_tpu.quantization import absmax_quantize_int8
+        # [b, n, g, d] -> int8 + [b, n, g] per-position scales
+        kq, ks = absmax_quantize_int8(k, axis=-1)
+        vq, vs = absmax_quantize_int8(v, axis=-1)
+        upd = jax.lax.dynamic_update_slice_in_dim
+        ckq = upd(kv_cache["k_q"], kq, idx, axis=1)
+        cks = upd(kv_cache["k_scale"], ks, idx, axis=1)
+        cvq = upd(kv_cache["v_q"], vq, idx, axis=1)
+        cvs = upd(kv_cache["v_scale"], vs, idx, axis=1)
+        sk = ckq.shape[1]
+        pos = idx + jnp.arange(k.shape[1])
+        valid = jnp.arange(sk)[None, :] <= pos[:, None]  # [sq, sk]
+        if cfg.sliding_window_size is not None:
+            valid &= jnp.arange(sk)[None, :] > pos[:, None] - cfg.sliding_window_size
+        mask = ~valid[None, None]  # [1,1,sq,sk]
+        cdt = k.dtype
+        k = ckq.astype(cdt) * cks[..., None].astype(cdt)
+        v = cvq.astype(cdt) * cvs[..., None].astype(cdt)
+        attention_mask = jnp.broadcast_to(mask, (x.shape[0],) + mask.shape[1:])
+        new_cache = {"k_q": ckq, "k_scale": cks, "v_q": cvq,
+                     "v_scale": cvs, "index": idx + q.shape[1]}
     elif kv_cache is not None:
         # incremental decode: write current k/v at cache index, attend over
         # the full cache (reference: transformer.py:433-505)

@@ -25,6 +25,19 @@ import jax
 import jax.numpy as jnp
 
 
+def absmax_quantize_int8(t: jax.Array, axis: int):
+    """Symmetric absmax int8: reduce |t| over ``axis``, scale = max/127
+    (1.0 where all-zero), q = clip(round(t/scale)).  Shared numerics for
+    the weight quantizer (axis=-2) and the int8 KV cache (axis=-1,
+    models/transformer.py) — one place to change the sentinel/clip."""
+    t32 = t.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(t32), axis=axis)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(t32 / jnp.expand_dims(scale, axis)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _quantize_kernel(kernel: jax.Array):
     """[..., in, out] float (plain, scanned [L, ...], or MoE expert
     bank [L, E, ...]) -> (int8 kernel_q, fp32 [..., out] kernel_scale).
@@ -35,12 +48,7 @@ def _quantize_kernel(kernel: jax.Array):
     leading layer dim — per-(layer, channel) scales, and the scan's
     per-layer slicing hands the linear fns matching [in,out]/[out]
     views."""
-    k32 = kernel.astype(jnp.float32)
-    absmax = jnp.max(jnp.abs(k32), axis=-2)
-    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(k32 / scale[..., None, :]),
-                 -127, 127).astype(jnp.int8)
-    return q, scale
+    return absmax_quantize_int8(kernel, axis=-2)
 
 
 #: weight names the quantizer understands, all stored [..., in, out]:

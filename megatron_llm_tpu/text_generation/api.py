@@ -62,6 +62,7 @@ def generate(
     prevent_newline_after_colon: bool = False,
     rolling_cache: Optional[bool] = None,
     cache_len: Optional[int] = None,
+    int8_kv_cache: bool = False,
 ):
     """Returns (texts, token_lists, log_probs or None).
 
@@ -87,6 +88,13 @@ def generate(
         window = model.cfg.sliding_window_size
         rolling_cache = (window is not None
                          and toks.shape[1] + tokens_to_generate > window)
+    if int8_kv_cache and rolling_cache:
+        # checked AFTER the auto-enable above: the ring cache is already
+        # O(window) and has no int8 variant — say so instead of silently
+        # serving bf16 KV
+        print(" > NOTE: int8_kv_cache is ignored for this request — the "
+              "rolling (sliding-window) cache engaged and has no int8 "
+              "variant; KV stays bf16", flush=True)
 
     def one_tok(text, quiet=False):
         # Resolve ``text`` to the single token id it produces
@@ -147,6 +155,7 @@ def generate(
         extra_stop_ids=tuple(extra_stop), stop_pairs=tuple(stop_pairs),
         ban_pairs=tuple(ban_pairs), rolling_cache=bool(rolling_cache),
         cache_len=cache_len,
+        int8_kv_cache=int8_kv_cache and not rolling_cache,
     )
     out_tokens = np.asarray(out_tokens)
     stop_set = set(extra_stop)
@@ -189,6 +198,7 @@ def generate_and_post_process(
     stop_on_eol: bool = False,
     stop_on_double_eol: bool = False,
     prevent_newline_after_colon: bool = False,
+    int8_kv_cache: bool = False,
     **_unused,
 ):
     """Reference signature compatibility (api.py:19-69)."""
@@ -201,6 +211,7 @@ def generate_and_post_process(
         add_bos=add_BOS, top_p_decay=top_p_decay, top_p_bound=top_p_bound,
         stop_on_eol=stop_on_eol, stop_on_double_eol=stop_on_double_eol,
         prevent_newline_after_colon=prevent_newline_after_colon,
+        int8_kv_cache=int8_kv_cache,
     )
     segments = [[tokenizer.detokenize([t]) for t in row] for row in tokens]
     return texts, segments, log_probs, tokens

@@ -30,7 +30,8 @@ NEG_INF_LOGIT = -1e10
 
 
 def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int,
-                   dtype=None, rolling: bool = False):
+                   dtype=None, rolling: bool = False,
+                   quantized: bool = False):
     """Per-layer decode caches.  ``rolling=True`` (sliding-window models
     only) allocates a ring buffer of exactly ``sliding_window_size``
     slots instead of ``max_len`` — decode memory O(window) rather than
@@ -46,6 +47,22 @@ def init_kv_caches(cfg: TransformerConfig, batch: int, max_len: int,
         size = min(max_len, cfg.sliding_window_size)
     else:
         size = max_len
+    if quantized:
+        # int8 K/V + per-(batch, position, group) fp32 absmax scales
+        # (models/transformer.py int8 branch) — halves decode KV HBM
+        # traffic vs bf16.  Linear cache only (the rolling ring is
+        # already O(window)).
+        assert not rolling, "int8 KV cache: linear cache only"
+        return [
+            {
+                "k_q": jnp.zeros((batch, size, ng, d), jnp.int8),
+                "k_scale": jnp.ones((batch, size, ng), jnp.float32),
+                "v_q": jnp.zeros((batch, size, ng, d), jnp.int8),
+                "v_scale": jnp.ones((batch, size, ng), jnp.float32),
+                "index": jnp.int32(0),
+            }
+            for _ in range(cfg.num_layers)
+        ]
     return [
         {
             "k": jnp.zeros((batch, size, ng, d), dtype),
@@ -95,7 +112,7 @@ def _prefill_chunks(b: int, n: int, threshold: Optional[int]) -> int:
                      "return_log_probs", "batch_times_seqlen_threshold",
                      "top_p_decay", "top_p_bound", "extra_stop_ids",
                      "stop_pairs", "ban_pairs", "rolling_cache",
-                     "cache_len"),
+                     "cache_len", "int8_kv_cache"),
 )
 def generate_tokens(
     model,
@@ -120,6 +137,7 @@ def generate_tokens(
     ban_pairs: tuple = (),
     rolling_cache: bool = False,
     cache_len: Optional[int] = None,
+    int8_kv_cache: bool = False,
 ):
     """Returns (tokens [b, total], gen_lengths [b], log_probs [b, total]).
 
@@ -135,6 +153,12 @@ def generate_tokens(
     max_new_tokens per bucket (at which point the cache size is
     already uniform).  Ignored for rolling caches, which are already
     fixed-size (the sliding window).
+
+    ``int8_kv_cache``: store K/V as int8 with per-(batch, position,
+    group) absmax scales — half the decode KV HBM traffic vs bf16,
+    the dominant bytes at long context.  Logits shift by the ~0.4%
+    per-entry quantization error (tests bound the drift); linear cache
+    only.
 
     ``batch_times_seqlen_threshold``: prefill forwards whose batch*seqlen
     exceeds it run micro-batched (sequential ``lax.map`` chunks), so the
@@ -153,7 +177,8 @@ def generate_tokens(
     total = max_prompt + max_new_tokens
     cache_total = total if (cache_len is None or rolling_cache) \
         else max(cache_len, total)
-    caches = init_kv_caches(cfg, b, cache_total, rolling=rolling_cache)
+    caches = init_kv_caches(cfg, b, cache_total, rolling=rolling_cache,
+                            quantized=int8_kv_cache)
 
     tokens = jnp.concatenate(
         [prompt_tokens,
@@ -184,13 +209,15 @@ def generate_tokens(
         # tensor never exists
         bc = b // C
         toks_c = tokens[:, :prefill].reshape(C, bc, prefill)
+        # generic over cache layouts (plain k/v, int8 k_q/.../scales,
+        # rolling marker): batch-leading tensors reshape, index
+        # broadcasts, structural markers pass through — a new cache
+        # key can't silently miss this path
         caches_c = [
-            {"k": c["k"].reshape(C, bc, *c["k"].shape[1:]),
-             "v": c["v"].reshape(C, bc, *c["v"].shape[1:]),
-             "index": jnp.broadcast_to(c["index"], (C,)),
-             # preserve the structural rolling marker, or the chunked
-             # prefill would silently fall back to linear-cache semantics
-             **({"rolling": None} if "rolling" in c else {})}
+            {key: (jnp.broadcast_to(val, (C,)) if key == "index"
+                   else val if val is None
+                   else val.reshape(C, bc, *val.shape[1:]))
+             for key, val in c.items()}
             for c in caches
         ]
 
@@ -213,9 +240,9 @@ def generate_tokens(
             log_probs = jax.lax.dynamic_update_slice(
                 log_probs, picked_c.reshape(b, prefill - 1), (0, 1))
         caches = [
-            {"k": c["k"].reshape(b, *c["k"].shape[2:]),
-             "v": c["v"].reshape(b, *c["v"].shape[2:]),
-             "index": c["index"][0]}
+            {key: (val[0] if key == "index" else val if val is None
+                   else val.reshape(b, *val.shape[2:]))
+             for key, val in c.items()}
             for c in caches_out
         ]
 

@@ -29,10 +29,11 @@ MAX_TOKENS = 1024
 class MegatronGenerate:
     """Request validation + dispatch (reference: text_generation_server.py:31)."""
 
-    def __init__(self, model, params, tokenizer):
+    def __init__(self, model, params, tokenizer, int8_kv_cache=False):
         self.model = model
         self.params = params
         self.tokenizer = tokenizer
+        self.int8_kv_cache = int8_kv_cache
         self.lock = threading.Lock()
 
     def handle(self, payload: dict):
@@ -127,6 +128,7 @@ class MegatronGenerate:
                 stop_on_eol=stop_on_eol,
                 stop_on_double_eol=stop_on_double_eol,
                 prevent_newline_after_colon=prevent_newline_after_colon,
+                int8_kv_cache=self.int8_kv_cache,
             )
             out = {"text": texts, "segments": segments, "tokens": tokens}
             if logprobs:
@@ -137,8 +139,9 @@ class MegatronGenerate:
 class MegatronServer:
     """reference: text_generation_server.py:234-241."""
 
-    def __init__(self, model, params, tokenizer):
-        self.generator = MegatronGenerate(model, params, tokenizer)
+    def __init__(self, model, params, tokenizer, int8_kv_cache=False):
+        self.generator = MegatronGenerate(model, params, tokenizer,
+                                          int8_kv_cache=int8_kv_cache)
 
     def run(self, host: str = "0.0.0.0", port: int = 5000):
         generator = self.generator

@@ -1,4 +1,4 @@
-"""tools/run_text_generation_server.py --int8_weights end to end:
+"""tools/run_text_generation_server.py --int8_weights --int8_kv_cache e2e:
 model presets applied from --model_name, weights quantized at load,
 REST API serves generation."""
 
@@ -42,7 +42,7 @@ def test_server_int8_cli(tmp_path):
          "--max_position_embeddings=64", "--micro_batch_size=1",
          "--global_batch_size=1",
          "--tokenizer_type=BertWordPieceLowerCase",
-         f"--vocab_file={vocab}", "--int8_weights",
+         f"--vocab_file={vocab}", "--int8_weights", "--int8_kv_cache",
          f"--port={port}", "--host=127.0.0.1"],
         cwd=ROOT, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True)

@@ -26,6 +26,10 @@ def extra_args(parser):
                    help="weight-only int8 quantization of the linear "
                         "kernels at load (halves decode weight traffic; "
                         "docs/guide/inference.md)")
+    g.add_argument("--int8_kv_cache", action="store_true",
+                   help="store decode K/V as int8 with per-position "
+                        "scales (halves KV HBM traffic — the dominant "
+                        "bytes at long context)")
     return parser
 
 
@@ -58,7 +62,8 @@ def main():
         print(f" int8 weights: {qb/1e6:.1f} MB int8 + {fb/1e6:.1f} MB float")
     params = sh.shard_params(params, specs)
     tokenizer = global_vars.get_tokenizer()
-    MegatronServer(model, params, tokenizer).run(args.host, args.port)
+    MegatronServer(model, params, tokenizer,
+                   int8_kv_cache=args.int8_kv_cache).run(args.host, args.port)
 
 
 if __name__ == "__main__":
