@@ -36,7 +36,7 @@ PRESETS = {
 }
 
 
-def run_trial(model, params, b, prompt, gen, vocab):
+def run_trial(model, params, b, prompt, gen, vocab, kv_int8=False):
     from megatron_llm_tpu.text_generation.generation import generate_tokens
     rng = np.random.RandomState(0)
     toks = jnp.asarray(rng.randint(1, vocab, (b, prompt)))
@@ -52,27 +52,30 @@ def run_trial(model, params, b, prompt, gen, vocab):
         # compile (first call per n_new) then measure
         out = generate_tokens(model, params, toks, lens, key,
                               max_new_tokens=n_new, min_prompt_len=prompt,
-                              greedy=True, cache_len=cache)
+                              greedy=True, cache_len=cache,
+                              int8_kv_cache=kv_int8)
         float(out[1].sum())  # host sync (axon: block_until_ready can lie)
         t0 = time.perf_counter()
         out = generate_tokens(model, params, toks, lens, key,
                               max_new_tokens=n_new, min_prompt_len=prompt,
-                              greedy=True, cache_len=cache)
+                              greedy=True, cache_len=cache,
+                              int8_kv_cache=kv_int8)
         float(out[1].sum())
         return time.perf_counter() - t0
 
     t1 = timed(gen)
     t2 = timed(2 * gen)
     e2e_tps = b * 2 * gen / t2
+    tag = " kv-int8" if kv_int8 else ""
     if t2 - t1 < 0.05 * t2:
         # the N extra decode steps are inside run-to-run jitter: a
         # differenced rate would be noise presented as signal
-        print(f"b={b:3d} prompt={prompt} gen={2*gen}: decode   INVALID "
+        print(f"b={b:3d} prompt={prompt} gen={2*gen}{tag}: decode   INVALID "
               f"(t2-t1 jitter) | e2e {e2e_tps:9.1f} tok/s "
               f"(t={t2*1000:.0f} ms)", flush=True)
         return
     decode_tps = b * gen / (t2 - t1)
-    print(f"b={b:3d} prompt={prompt} gen={2*gen}: "
+    print(f"b={b:3d} prompt={prompt} gen={2*gen}{tag}: "
           f"decode {decode_tps:9.1f} tok/s | e2e {e2e_tps:9.1f} tok/s "
           f"(t={t2*1000:.0f} ms)", flush=True)
 
@@ -105,6 +108,11 @@ def main():
     print("decode_bench: int8 weight-only quantized kernels", flush=True)
     for b in p["batches"]:
         run_trial(model, qparams, b, p["prompt"], p["gen"], p["vocab"])
+    # int8 KV cache on top of int8 weights: fully int8 decode bytes
+    print("decode_bench: + int8 KV cache", flush=True)
+    for b in p["batches"]:
+        run_trial(model, qparams, b, p["prompt"], p["gen"], p["vocab"],
+                  kv_int8=True)
     # speculative prompt-lookup A/B on a repetitive prompt (the
     # favorable case: summarization/code-edit-like repetition) —
     # exactness is covered by tests/test_speculative.py, this measures
