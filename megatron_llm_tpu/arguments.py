@@ -180,9 +180,16 @@ def _add_training_args(parser):
     g.add_argument("--use_flash_attn", action="store_true", default=True)
     g.add_argument("--no_flash_attn", action="store_false",
                    dest="use_flash_attn")
-    # opt-in chunked head+CE for very large vocabularies (docs/perf_tpu.md
-    # records why it is off by default at 32k)
-    g.add_argument("--fused_lm_cross_entropy", action="store_true")
+    # chunked head+CE: off by default at 32k vocab (docs/perf_tpu.md
+    # records the measured tie), auto-ON at >= 128k vocab where the
+    # compile-level evidence is decisive (2.1x temp memory, 1.3x HBM
+    # traffic — docs/scale_aot.md); default=None distinguishes
+    # "unspecified" from an explicit choice so validate_args can
+    # auto-enable without overriding the user
+    g.add_argument("--fused_lm_cross_entropy", action="store_const",
+                   const=True, default=None)
+    g.add_argument("--no_fused_lm_cross_entropy", action="store_const",
+                   const=False, dest="fused_lm_cross_entropy")
     g.add_argument("--fused_ce_chunk_size", type=int, default=8192)
 
 
@@ -406,6 +413,51 @@ def _add_compat_noop_args(parser):
 # validation / derivation
 # ---------------------------------------------------------------------------
 
+def apply_fused_ce_policy(args, vocab=None):
+    """Decide ``fused_lm_cross_entropy`` from the best-known vocab size.
+
+    Policy (VERDICT r4 #7): off below 64k (the measured on-chip tie at
+    32k, docs/perf_tpu.md), advisory note at 64k-128k, AUTO-ON at
+    >= 128k where the compile-level evidence is decisive (temp memory
+    3.20->1.51 GB, HBM traffic 25.5->20.1 GB, docs/scale_aot.md) — but
+    only with an unsharded vocab: under tp>1 the fused path is inert
+    (models/gpt.py gates on _vocab_unsharded) and we say so instead of
+    advertising a saving that never engages.
+
+    Idempotent and re-entrant: the user's explicit choice (tri-state
+    flag, resolved on the FIRST call) always wins; non-explicit users
+    get the policy recomputed as larger vocab estimates become known
+    (tokenizer padding runs after validate_args; --use_checkpoint_args
+    triggers a second validate_args pass)."""
+    if vocab is None:
+        vocab = max(getattr(args, "padded_vocab_size", 0) or 0,
+                    getattr(args, "vocab_size", 0) or 0)
+    if getattr(args, "fused_ce_user_explicit", None) is None:
+        args.fused_ce_user_explicit = \
+            getattr(args, "fused_lm_cross_entropy", None) is not None
+    if args.fused_ce_user_explicit:
+        return
+    rank0 = getattr(args, "rank", 0) == 0
+    tp = getattr(args, "tensor_model_parallel_size", 1) or 1
+    if vocab >= 131072 and tp == 1:
+        if not getattr(args, "fused_lm_cross_entropy", False) and rank0:
+            print(" > vocab >= 128k: auto-enabling fused_lm_cross_entropy "
+                  "(streams the head matmul + CE over vocab chunks; "
+                  "opt out with --no_fused_lm_cross_entropy)", flush=True)
+        args.fused_lm_cross_entropy = True
+    else:
+        args.fused_lm_cross_entropy = False
+        if rank0 and vocab >= 131072:
+            print(" > NOTE: vocab >= 128k but tensor-parallel vocab "
+                  "sharding is active — fused_lm_cross_entropy is inert "
+                  "under a sharded vocab (the vocab-parallel CE already "
+                  "avoids the full logits); leaving it off", flush=True)
+        elif rank0 and vocab >= 65536:
+            print(" > NOTE: padded_vocab_size >= 64k — consider "
+                  "--fused_lm_cross_entropy (see docs/scale_aot.md)",
+                  flush=True)
+
+
 def validate_args(args, world_size: Optional[int] = None):
     """Cross-derivations (reference: arguments.py:53-345)."""
     import jax
@@ -467,17 +519,11 @@ def validate_args(args, world_size: Optional[int] = None):
         args.micro_batch_size * args.data_parallel_size
     ) == 0
 
-    # big-vocab fused CE nudge: at >= 64k vocab the materialized
-    # [tokens, vocab] fp32 logits dominate temp memory (compile-level
-    # evidence: docs/scale_aot.md fused-CE note — 2.1x temp, 1.3x HBM
-    # traffic at 128k); the on-chip flip point is still unmeasured, so
-    # advise rather than auto-flip
-    if (not args.fused_lm_cross_entropy
-            and max(args.padded_vocab_size or 0,
-                    getattr(args, "vocab_size", 0) or 0) >= 65536):
-        print(" > NOTE: padded_vocab_size >= 64k — consider "
-              "--fused_lm_cross_entropy (streams the head matmul + CE "
-              "over vocab chunks; see docs/scale_aot.md)", flush=True)
+    # big-vocab fused CE policy (VERDICT r4 #7) — one idempotent
+    # helper, re-fired whenever the known vocab grows (tokenizer
+    # padding, initialize_megatron's no-tokenizer padding, and a second
+    # validate_args pass after --use_checkpoint_args)
+    apply_fused_ce_policy(args)
 
     if args.ffn_hidden_size is None and args.hidden_size is not None:
         args.ffn_hidden_size = 4 * args.hidden_size

@@ -48,6 +48,10 @@ def initialize_megatron(
         mult = args.make_vocab_size_divisible_by * args.tensor_model_parallel_size
         v = args.vocab_size
         args.padded_vocab_size = ((v + mult - 1) // mult) * mult
+        # padding can cross the fused-CE auto-on threshold (a vocab one
+        # padding multiple below 128k) — re-fire the policy
+        from megatron_llm_tpu.arguments import apply_fused_ce_policy
+        apply_fused_ce_policy(args)
 
     timers = Timers(log_level=args.timing_log_level)
     global_vars.set_global_variables(args, tokenizer=tokenizer, timers=timers)

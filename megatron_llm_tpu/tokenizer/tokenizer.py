@@ -79,16 +79,12 @@ def _vocab_size_with_padding(orig_vocab_size: int, args) -> int:
         print(f" > padded vocab (size: {orig_vocab_size}) with "
               f"{after - orig_vocab_size} dummy tokens "
               f"(new size: {after})", flush=True)
-    # big-vocab fused CE nudge at the point where the tokenizer-derived
-    # vocab is actually known (validate_args runs before the tokenizer
-    # is built, so its copy of this check only sees explicit
-    # --vocab_size/--padded_vocab_size); getattr default True keeps the
-    # preprocess CLIs (no such flag) quiet
-    if (getattr(args, "rank", 0) == 0 and after >= 65536
-            and not getattr(args, "fused_lm_cross_entropy", True)):
-        print(" > NOTE: padded vocab >= 64k — consider "
-              "--fused_lm_cross_entropy (streams the head matmul + CE "
-              "over vocab chunks; see docs/scale_aot.md)", flush=True)
+    # re-fire the fused-CE policy now that the tokenizer-derived vocab
+    # is known (validate_args ran before the tokenizer was built); the
+    # guard keeps the preprocess CLIs (no such flags) out of it
+    if getattr(args, "fused_ce_user_explicit", None) is not None:
+        from megatron_llm_tpu.arguments import apply_fused_ce_policy
+        apply_fused_ce_policy(args, vocab=after)
     return after
 
 

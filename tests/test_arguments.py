@@ -126,3 +126,69 @@ def test_use_checkpoint_args_overrides_cli(tmp_path):
     assert a.num_attention_heads == 4
     assert a.use_rms_norm is True
     assert a.use_bias is False
+
+
+def test_fused_ce_auto_policy():
+    """VERDICT r4 #7: fused_lm_cross_entropy auto-enables at >= 128k
+    vocab (compile-evidence flip), stays off at 32k, and an explicit
+    --no_fused_lm_cross_entropy always wins."""
+    base = ["--num_layers=2", "--hidden_size=64",
+            "--num_attention_heads=4", "--seq_length=32",
+            "--micro_batch_size=1"]
+    small = _args(*base, "--vocab_size=32000")
+    assert small.fused_lm_cross_entropy is False
+    assert small.fused_ce_user_explicit is False
+    big = _args(*base, "--vocab_size=131072")
+    assert big.fused_lm_cross_entropy is True
+    veto = _args(*base, "--vocab_size=131072",
+                 "--no_fused_lm_cross_entropy")
+    assert veto.fused_lm_cross_entropy is False
+    assert veto.fused_ce_user_explicit is True
+    forced = _args(*base, "--vocab_size=32000",
+                   "--fused_lm_cross_entropy")
+    assert forced.fused_lm_cross_entropy is True
+
+
+def test_fused_ce_auto_policy_via_tokenizer_padding():
+    """The tokenizer-derived vocab only exists after validate_args; the
+    policy re-fires at padding time for non-explicit users."""
+    from megatron_llm_tpu.tokenizer.tokenizer import (
+        _vocab_size_with_padding)
+    a = _args("--num_layers=2", "--hidden_size=64",
+              "--num_attention_heads=4", "--seq_length=32",
+              "--micro_batch_size=1", "--vocab_size=32000")
+    assert a.fused_lm_cross_entropy is False
+    _vocab_size_with_padding(140000, a)
+    assert a.fused_lm_cross_entropy is True
+    # explicit opt-out survives the tokenizer hook too
+    b = _args("--num_layers=2", "--hidden_size=64",
+              "--num_attention_heads=4", "--seq_length=32",
+              "--micro_batch_size=1", "--vocab_size=32000",
+              "--no_fused_lm_cross_entropy")
+    _vocab_size_with_padding(140000, b)
+    assert b.fused_lm_cross_entropy is False
+
+
+def test_fused_ce_policy_tp_sharded_vocab_is_inert():
+    """tp>1 shards the vocab; the fused path never engages there
+    (models/gpt.py gates on an unsharded vocab), so the policy must not
+    advertise it."""
+    a = _args("--num_layers=2", "--hidden_size=64",
+              "--num_attention_heads=4", "--seq_length=32",
+              "--micro_batch_size=1", "--vocab_size=131072",
+              "--tensor_model_parallel_size=8")
+    assert a.fused_lm_cross_entropy is False
+
+
+def test_fused_ce_policy_survives_second_validate():
+    """--use_checkpoint_args re-runs validate_args after the checkpoint
+    restores a big vocab: the policy must re-fire, not be fossilized by
+    the first pass's small-vocab resolution."""
+    a = _args("--num_layers=2", "--hidden_size=64",
+              "--num_attention_heads=4", "--seq_length=32",
+              "--micro_batch_size=1", "--vocab_size=32000")
+    assert a.fused_lm_cross_entropy is False
+    a.padded_vocab_size = 131072  # as _apply_checkpoint_args would
+    a = validate_args(a, world_size=8)
+    assert a.fused_lm_cross_entropy is True
+    assert a.fused_ce_user_explicit is False
