@@ -1,0 +1,25 @@
+"""tools/aot_decode_memcheck.py CI smoke: the tiny rows compile through
+the real libtpu AOT path and report bytes + a fits verdict, with the
+int8 row's argument bytes strictly below bf16's."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tiny_rows():
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "aot_decode_memcheck.py"), "tiny"],
+        capture_output=True, text=True, timeout=1200, cwd=ROOT,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rows = [json.loads(l) for l in r.stdout.splitlines()
+            if l.startswith("{")]
+    by_name = {x["row"]: x for x in rows}
+    assert by_name["tiny-bf16"]["fits"] and by_name["tiny-int8"]["fits"]
+    assert (by_name["tiny-int8"]["arg_gb"]
+            < by_name["tiny-bf16"]["arg_gb"])
