@@ -22,7 +22,7 @@ def model_and_params():
     return model, params
 
 
-def _vanilla(model, params, toks, n_new, eod=None):
+def _vanilla(model, params, toks, n_new, eod=None):  # params may be quantized
     lens = jnp.asarray([toks.shape[1]], jnp.int32)
     out, n, _ = generate_tokens(
         model, params, toks, lens, jax.random.PRNGKey(0),
@@ -78,3 +78,18 @@ def test_eod_stops_early(model_and_params):
     stop = toks.shape[1] + got_n
     np.testing.assert_array_equal(np.asarray(got[0][:stop]), want[:stop])
     assert int(np.asarray(got[0][stop - 1])) == eod
+
+
+def test_composes_with_int8_weights(model_and_params):
+    """Speculative decode over int8-quantized params matches vanilla
+    greedy over the SAME quantized params (exactness is vs the same
+    weights, whatever their precision)."""
+    from megatron_llm_tpu.quantization import quantize_linear_weights_int8
+    model, params = model_and_params
+    qparams = quantize_linear_weights_int8(params)
+    toks = jnp.asarray([[5, 9, 5, 9, 5, 9, 5, 9]], jnp.int32)
+    want, _ = _vanilla(model, qparams, toks, 16)
+    got, n = speculative_greedy_generate(
+        model, qparams, toks, jnp.asarray([8], jnp.int32),
+        max_new_tokens=16, draft_k=6)
+    np.testing.assert_array_equal(np.asarray(got[0]), want)
