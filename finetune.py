@@ -96,6 +96,17 @@ def extra_args(parser):
     g.add_argument("--model_name", required=True,
                    choices=sorted(MODEL_DEFAULTS))
     g.add_argument("--model_type", default=None)  # compat
+    # LoRA (megatron_llm_tpu/lora.py): train low-rank adapters over a
+    # frozen base — Adam state and grads shrink to the adapter size.
+    # Checkpoints are exported MERGED (standard format); continuing a
+    # LoRA run means re-finetuning from the merged weights.
+    g.add_argument("--lora_rank", type=int, default=0,
+                   help="enable LoRA with this rank (0 = off)")
+    g.add_argument("--lora_alpha", type=float, default=None,
+                   help="LoRA scaling numerator (default 2*rank)")
+    g.add_argument("--lora_targets",
+                   default="query_key_value,dense",
+                   help="comma-separated linear names to adapt")
     return parser
 
 
@@ -343,6 +354,13 @@ def main():
     params = sh.shard_params(params, model.param_specs(params))
 
     def save_natural(save_dir, it_, params_, opt_state_, scheduler_=None):
+        if lora_base is not None:
+            # export MERGED weights in the standard checkpoint format
+            # (loadable anywhere a base checkpoint is); the lora-shaped
+            # optimizer state is fresh-start-only, so drop it
+            from megatron_llm_tpu.lora import merge_lora
+            params_ = merge_lora(lora_base, params_)
+            opt_state_ = None
         checkpointing.save_checkpoint(
             save_dir, it_,
             convert_params_layout(
@@ -361,6 +379,31 @@ def main():
     if args.fp16 or args.bf16:
         dt = jnp.float16 if args.fp16 else jnp.bfloat16
         params = jax.tree_util.tree_map(lambda p: p.astype(dt), params)
+
+    # LoRA: swap the trainable tree for low-rank adapters over the
+    # frozen (already sharded + cast) base
+    lora_base = None
+    if args.lora_rank:
+        if pc.pipeline_model_parallel_size > 1:
+            raise SystemExit("--lora_rank supports pp=1 on the CLI path "
+                             "(the lora.py library composes manually)")
+        if args.load and not args.finetune:
+            raise SystemExit(
+                "--lora_rank with --load requires --finetune: LoRA runs "
+                "start fresh from base weights (checkpoints are exported "
+                "merged; there is no LoRA-shaped optimizer state to "
+                "resume)")
+        from megatron_llm_tpu.lora import LoraAdapter
+        lora_base = params
+        model = LoraAdapter(model, lora_base)
+        lora = model.init_lora(
+            args.lora_rank, jax.random.PRNGKey(args.seed + 1),
+            alpha=args.lora_alpha,
+            targets=tuple(t for t in args.lora_targets.split(",") if t))
+        params = sh.shard_params(lora, model.param_specs(lora))
+        n_ad = model.num_params(params)
+        print(f" > LoRA rank {args.lora_rank}: {n_ad/1e6:.2f}M adapter "
+              f"params trainable, base frozen", flush=True)
 
     train_iter, eval_iter = build_data_iterator(args, mesh, num_micro)
 

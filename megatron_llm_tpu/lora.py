@@ -1,0 +1,179 @@
+"""LoRA finetuning (beyond-reference).
+
+Low-Rank Adaptation: every targeted linear ``W [.., in, out]`` gains a
+pair ``A [.., in, r]`` (gaussian / sqrt(r)) and ``B [.., r, out]``
+(zeros), and the layer computes ``y = x W + (x A) B * (alpha / r)`` —
+at init B=0 makes the adapted model exactly the base model.  Only the
+adapters train: the optimizer sees a tree that is ~0.1-1% of the model,
+so Adam state and checkpoints shrink accordingly, and the frozen base
+params are closed over by the train step (no grads, no master copies).
+
+TPU notes: the low-rank path stays as two thin matmuls (x@A then @B) —
+never materialize W + BA [in, out] in the forward, it would double the
+weight HBM traffic the freeze avoids.  Shardings: A inherits the
+kernel's input-axis sharding with a replicated rank axis, B mirrors the
+kernel's output axis, so tp/sp layouts work unchanged
+(tests/test_lora.py proves tp=2 parity).
+
+Usage (library)::
+
+    lora = init_lora(model, params, rank=8, key=key)     # adapter tree
+    adapter = LoraAdapter(model, params)                  # train-step model
+    step = build_train_step(adapter, opt, pc, M)          # opt over lora only
+    merged = merge_lora(params, lora)                     # export to base fmt
+
+CLI: ``finetune.py --lora_rank=8 [--lora_alpha=16]
+[--lora_targets=query_key_value,dense,...]``.
+"""
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# default targets: the attention projections (the standard LoRA recipe);
+# names are the param-dict keys used across the model families
+DEFAULT_TARGETS = ("query_key_value", "dense")
+
+
+def _is_linear(node) -> bool:
+    k = node.get("kernel") if isinstance(node, dict) else None
+    return k is not None and hasattr(k, "ndim") and k.ndim >= 2
+
+
+def init_lora(model, params: Any, rank: int, key,
+              alpha: Optional[float] = None,
+              targets: Sequence[str] = DEFAULT_TARGETS):
+    """Adapter tree mirroring ``params``: targeted linear dicts map to
+    {'lora_A', 'lora_B', 'lora_scale'}; everything else maps to None
+    (structural placeholder, ignored by merge/apply)."""
+    alpha = float(alpha if alpha is not None else 2 * rank)
+    scaling = alpha / rank
+    keys = iter(jax.random.split(key, 4096))
+
+    def walk(node, name=""):
+        if isinstance(node, dict):
+            if name in targets and _is_linear(node):
+                kern = node["kernel"]
+                *lead, fan_in, fan_out = kern.shape
+                a = jax.random.normal(
+                    next(keys), (*lead, fan_in, rank), jnp.float32
+                ) / jnp.sqrt(float(rank))
+                return {
+                    "lora_A": a.astype(kern.dtype),
+                    "lora_B": jnp.zeros((*lead, rank, fan_out),
+                                        kern.dtype),
+                    # lead dims mirror the kernel's (the scanned layer
+                    # stack slices EVERY leaf's leading axis)
+                    "lora_scale": jnp.full(tuple(lead), scaling,
+                                           jnp.float32),
+                }
+            return {k: walk(v, k) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v, name) for v in node)
+        return None
+
+    return walk(params)
+
+
+def attach_lora(params: Any, lora: Any):
+    """Forward-time view: targeted linear dicts gain the lora leaves
+    (parallel/layers.py applies the low-rank path when they are
+    present).  Base leaves are shared, not copied."""
+    def walk(p, l):
+        if isinstance(p, dict):
+            if isinstance(l, dict) and "lora_A" in l:
+                return {**p, **l}
+            return {k: walk(v, l.get(k) if isinstance(l, dict) else None)
+                    for k, v in p.items()}
+        if isinstance(p, (list, tuple)):
+            return type(p)(walk(v, l[i] if isinstance(l, (list, tuple))
+                                else None) for i, v in enumerate(p))
+        return p
+
+    return walk(params, lora)
+
+
+def merge_lora(params: Any, lora: Any):
+    """Export: fold the adapters into the base kernels
+    (kernel += scale * A @ B) so the result loads anywhere a base
+    checkpoint does.  The [.., in, out] update is materialized ONCE
+    here — never in the forward."""
+    def walk(p, l):
+        if isinstance(p, dict):
+            if isinstance(l, dict) and "lora_A" in l:
+                kern = p["kernel"]
+                scale = l["lora_scale"]
+                upd = jnp.einsum(
+                    "...ir,...ro->...io",
+                    l["lora_A"].astype(jnp.float32),
+                    l["lora_B"].astype(jnp.float32)) \
+                    * scale.reshape(scale.shape + (1, 1))
+                return {**p, "kernel": (kern.astype(jnp.float32)
+                                        + upd).astype(kern.dtype)}
+            return {k: walk(v, l.get(k) if isinstance(l, dict) else None)
+                    for k, v in p.items()}
+        if isinstance(p, (list, tuple)):
+            return type(p)(walk(v, l[i] if isinstance(l, (list, tuple))
+                                else None) for i, v in enumerate(p))
+        return p
+
+    return walk(params, lora)
+
+
+def lora_param_specs(model, params_or_shape, lora: Any):
+    """Sharding specs for the adapter tree: A inherits the kernel's
+    input-axis sharding (rank axis replicated), B mirrors the output
+    axis (rank axis replicated)."""
+    base_specs = model.param_specs(params_or_shape)
+
+    def walk(sp, l):
+        if isinstance(l, dict) and "lora_A" in l:
+            kspec = tuple(sp["kernel"])
+            return {
+                "lora_A": kspec[:-1] + (None,),
+                "lora_B": kspec[:-2] + (None,) + kspec[-1:],
+                "lora_scale": kspec[:-2],
+            }
+        if isinstance(l, dict):
+            return {k: walk(sp[k] if isinstance(sp, dict) else None, v)
+                    for k, v in l.items()}
+        if isinstance(l, (list, tuple)):
+            return type(l)(walk(sp[i] if isinstance(sp, (list, tuple))
+                                else None, v) for i, v in enumerate(l))
+        return None
+
+    return walk(base_specs, lora)
+
+
+class LoraAdapter:
+    """Model wrapper whose trainable pytree is the LoRA tree.
+
+    Quacks like the wrapped model for ``build_train_step`` /
+    ``MegatronOptimizer``: ``__call__(lora, tokens, ...)`` runs the base
+    model with adapters attached; the frozen base params are a closure
+    constant (no grads, no optimizer state, no fp32 masters)."""
+
+    def __init__(self, model, base_params):
+        self.model = model
+        self.base_params = base_params
+        self.cfg = model.cfg
+
+    def __call__(self, lora, *args, **kwargs):
+        return self.model(attach_lora(self.base_params, lora),
+                          *args, **kwargs)
+
+    def init_lora(self, rank: int, key, alpha=None,
+                  targets: Sequence[str] = DEFAULT_TARGETS):
+        return init_lora(self.model, self.base_params, rank, key,
+                         alpha=alpha, targets=targets)
+
+    def param_specs(self, lora):
+        return lora_param_specs(self.model, self.base_params, lora)
+
+    def num_params(self, lora):
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(lora)
+                   if hasattr(x, "size"))
+
+    def flops_per_token(self):
+        return self.model.flops_per_token()

@@ -42,7 +42,7 @@ def _no_weight_decay(path, leaf) -> bool:
     excluded (reference: _get_params_for_weight_decay_optimization in
     megatron/optimizer/__init__.py: no WD for biases / 1-D params)."""
     names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
-    if "bias" in names or "scale" in names:
+    if "bias" in names or "scale" in names or "lora_scale" in names:
         return True
     if any("norm" in str(n) for n in names):
         return True

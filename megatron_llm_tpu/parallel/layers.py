@@ -121,6 +121,18 @@ def vocab_parallel_embedding(
     return constrain(out, "batch", "seq", None)
 
 
+def _lora_delta(x, params):
+    """LoRA low-rank path (lora.py): two thin matmuls, never the
+    materialized [in, out] update.  lora_scale is a CONSTANT (alpha/r):
+    stop_gradient keeps it out of training even though it rides in the
+    trainable tree for structure (the optimizer also WD-excludes it)."""
+    a = params["lora_A"].astype(x.dtype)
+    b = params["lora_B"].astype(x.dtype)
+    scale = jax.lax.stop_gradient(params["lora_scale"]).astype(x.dtype)
+    return jnp.einsum("...r,ro->...o",
+                      jnp.einsum("...i,ir->...r", x, a), b) * scale
+
+
 def column_parallel_linear(
     x: jax.Array,
     params,
@@ -144,6 +156,8 @@ def column_parallel_linear(
     if sequence_parallel:
         x = constrain(x, "batch", "seq_tp", None)
     y = jnp.einsum("...h,hf->...f", x, kernel)
+    if "lora_A" in params:
+        y = y + _lora_delta(x, params)
     y = constrain(y, "batch", "seq", out_logical)
     if bias is not None and not skip_bias_add:
         y = y + bias
@@ -177,6 +191,8 @@ def row_parallel_linear(
         bias = bias.astype(compute_dtype) if bias is not None else None
     x = constrain(x, "batch", "seq", in_logical)
     y = jnp.einsum("...f,fh->...h", x, kernel)
+    if "lora_A" in params:
+        y = y + _lora_delta(x, params)
     if sequence_parallel:
         y = constrain(y, "batch", "seq_tp", None)
     else:

@@ -91,16 +91,29 @@ def with_logical_constraint(tree, specs, rules=None):
 
 
 def make_shardings(specs, rules=None, mesh: Optional[Mesh] = None):
-    """Pytree of logical-axis tuples -> pytree of NamedShardings."""
+    """Pytree of logical-axis tuples -> pytree of NamedShardings.
+
+    ``None`` spec entries pass through as ``None`` — partial trees
+    (e.g. a LoRA adapter tree whose non-target positions are structural
+    placeholders) shard only where a spec exists."""
     mesh = mesh or topology.get_mesh()
     return jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, logical_to_mesh(s, rules)),
+        lambda s: (None if s is None
+                   else NamedSharding(mesh, logical_to_mesh(s, rules))),
         specs,
         is_leaf=lambda v: isinstance(v, tuple) or v is None,
     )
 
 
 def shard_params(params, specs, rules=None, mesh: Optional[Mesh] = None):
-    """device_put a host-side param pytree onto the mesh per its specs."""
+    """device_put a host-side param pytree onto the mesh per its specs.
+
+    ``None`` placeholders (both sides) pass through untouched, so
+    partial trees (LoRA adapters) shard without a fully-populated spec
+    tree."""
     shardings = make_shardings(specs, rules, mesh)
-    return jax.device_put(params, shardings)
+    return jax.tree_util.tree_map(
+        lambda x, s: x if s is None else jax.device_put(x, s),
+        params, shardings,
+        is_leaf=lambda v: v is None,
+    )

@@ -1,0 +1,127 @@
+"""LoRA finetuning (megatron_llm_tpu/lora.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_llm_tpu.config import ParallelConfig, TrainConfig
+from megatron_llm_tpu.lora import (
+    LoraAdapter,
+    attach_lora,
+    init_lora,
+    merge_lora,
+)
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+from megatron_llm_tpu.optimizer import MegatronOptimizer
+from megatron_llm_tpu.training import build_train_step
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = llama_config("tiny", num_layers=2, seq_length=32,
+                       max_position_embeddings=32, padded_vocab_size=64,
+                       use_flash_attn=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _tok(b=2, s=16):
+    return jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (b, s)), jnp.int32)
+
+
+def test_zero_init_is_identity(model_and_params):
+    """B starts at zero: the adapted model IS the base model."""
+    model, params = model_and_params
+    lora = init_lora(model, params, rank=4, key=jax.random.PRNGKey(1))
+    toks = _tok()
+    base = model(params, toks, train=False)
+    adapted = model(attach_lora(params, lora), toks, train=False)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(adapted),
+                               atol=0, rtol=0)
+
+
+def test_low_rank_path_matches_merged(model_and_params):
+    """y = xW + (xA)B*s  ==  x(W + sAB): the forward's two-thin-matmul
+    path agrees with the merged-kernel export."""
+    model, params = model_and_params
+    lora = init_lora(model, params, rank=4, key=jax.random.PRNGKey(1))
+    # make B nonzero so the test means something
+    lora = jax.tree_util.tree_map(
+        lambda x: (jax.random.normal(jax.random.PRNGKey(2), x.shape,
+                                     x.dtype) * 0.02
+                   if x.ndim >= 2 else x), lora)
+    toks = _tok()
+    via_path = model(attach_lora(params, lora), toks, train=False)
+    via_merge = model(merge_lora(params, lora), toks, train=False)
+    np.testing.assert_allclose(np.asarray(via_path, np.float32),
+                               np.asarray(via_merge, np.float32),
+                               atol=5e-2)
+
+
+def test_train_step_updates_only_adapters(model_and_params):
+    """build_train_step over a LoraAdapter: loss falls, adapters move,
+    the frozen base never changes, and the Adam state is adapter-sized."""
+    model, params = model_and_params
+    adapter = LoraAdapter(model, params)
+    lora = adapter.init_lora(
+        8, jax.random.PRNGKey(1),
+        targets=("query_key_value", "dense",
+                 "dense_h_to_4h", "dense_4h_to_h"))
+    n_lora = adapter.num_params(lora)
+    n_base = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    assert n_lora < 0.35 * n_base  # tiny model; real ratios are ~1%
+
+    tc = TrainConfig(micro_batch_size=2, global_batch_size=2,
+                     train_iters=0, lr=0.0, optimizer="adam",
+                     clip_grad=1.0)
+    opt = MegatronOptimizer(tc)
+    opt_state = opt.init(lora)
+    assert sum(int(x.size) for x in
+               jax.tree_util.tree_leaves(opt_state.exp_avg)) == n_lora
+    step = build_train_step(adapter, opt, ParallelConfig(), 1)
+
+    toks = _tok()[None]  # [num_micro, b, s]
+    batch = {"tokens": toks, "labels": jnp.roll(toks, -1, -1),
+             "loss_mask": jnp.ones_like(toks, jnp.float32)}
+    base_before = jax.tree_util.tree_map(lambda x: np.asarray(x).copy(),
+                                         params)
+    key = jax.random.PRNGKey(2)
+    losses = []
+    for _ in range(40):
+        lora, opt_state, m = step(lora, opt_state, batch, key, 5e-2, 0.0)
+        losses.append(float(m["lm loss"]))
+    # learning through a FROZEN RANDOM base is capacity-bound (the LM
+    # head never trains), so expect a solid drop, not memorization:
+    # measured 4.26 -> 3.22 with qkv+dense+mlp rank-8 adapters
+    assert losses[-1] < 0.8 * losses[0], losses
+    # base params are untouched (closure constants)
+    for a, b in zip(jax.tree_util.tree_leaves(base_before),
+                    jax.tree_util.tree_leaves(adapter.base_params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_tp2_parity(model_and_params, utils):
+    """LoRA forward under tp=2 (sharded base + sharded adapters via
+    lora_param_specs) matches unsharded."""
+    from megatron_llm_tpu.parallel import sharding as sh
+    model, params = model_and_params
+    adapter = LoraAdapter(model, params)
+    lora = adapter.init_lora(4, jax.random.PRNGKey(1))
+    lora = jax.tree_util.tree_map(
+        lambda x: (jax.random.normal(jax.random.PRNGKey(3), x.shape,
+                                     x.dtype) * 0.02
+                   if x.ndim >= 2 else x), lora)
+    toks = _tok(b=4)  # divisible by dp=4 on the tp=2 8-device mesh
+    want = model(attach_lora(params, lora), toks, train=False)
+    utils.initialize_model_parallel(tp=2)
+    try:
+        p_sh = sh.shard_params(params, model.param_specs(params))
+        l_sh = sh.shard_params(lora, adapter.param_specs(lora))
+        got = model(attach_lora(p_sh, l_sh), toks, train=False)
+    finally:
+        utils.destroy_model_parallel()
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=3e-2)
