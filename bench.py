@@ -332,6 +332,14 @@ def child_main():
         "seq2048": None,
         **({"simulated": True} if simulate else {}),
     }
+    # fault-tolerance counters (resilience.py): zeros on a clean bench,
+    # nonzero when a run rewound, retried a save, or tripped the watchdog
+    try:
+        from megatron_llm_tpu.resilience import recovery_counters
+
+        rec["recovery"] = recovery_counters()
+    except Exception:
+        rec["recovery"] = None
     # emit the PRIMARY result immediately — if the optional secondary
     # below hangs into the parent deadline, this artifact is already on
     # stdout (the parent takes the last JSON line it finds)

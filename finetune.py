@@ -33,6 +33,7 @@ from megatron_llm_tpu.arguments import (
     transformer_config_from_args,
 )
 from megatron_llm_tpu.dist_signal_handler import DistributedSignalHandler
+from megatron_llm_tpu.global_vars import get_counters
 from megatron_llm_tpu.initialize import initialize_megatron
 from megatron_llm_tpu.models import MODEL_REGISTRY
 from megatron_llm_tpu.optimizer import (
@@ -300,6 +301,15 @@ def main():
     if args.padded_vocab_size is None:
         raise SystemExit("need --vocab_size/--padded_vocab_size or a tokenizer")
 
+    # hardened checkpoint IO knobs + fault-tolerance runtime
+    # (docs/guide/fault_tolerance.md)
+    checkpointing.configure_save(
+        total_limit=getattr(args, "save_total_limit", 0),
+        retries=getattr(args, "save_retries", 2),
+        retry_backoff=getattr(args, "save_retry_backoff", 0.25))
+    from megatron_llm_tpu.resilience import build_resilience
+    resilience = build_resilience(args)
+
     mesh = topology.get_mesh()
     model = model_provider(args)
     tc = train_config_from_args(args)
@@ -373,6 +383,7 @@ def main():
             # main builds it
             scheduler_ if scheduler_ is not None else scheduler,
             args=checkpointing.config_to_args(getattr(model, "cfg", None)),
+            consumed_samples=get_counters().get("samples", 0),
             async_save=getattr(args, "async_save", False),
         )
 
@@ -525,37 +536,45 @@ def main():
               f"{sum(losses) / len(losses):.6E}")
         return
 
-    params, opt_state, it = pretrain(
-        model, params, tc, pc, train_iter,
-        optimizer=optimizer,
-        scheduler=scheduler,
-        train_step=custom_step,
-        save_fn=save_natural,
-        timers=Timers(log_level=args.timing_log_level,
-                      log_option=args.timing_log_option),
-        log_params_norm=args.log_params_norm,
-        log_num_zeros_in_grad=args.log_num_zeros_in_grad,
-        writer=writer,
-        tensorboard_log_interval=args.tensorboard_log_interval,
-        log_memory=args.log_memory_to_tensorboard,
-        log_batch_size=args.log_batch_size_to_tensorboard,
-        log_world_size=args.log_world_size_to_tensorboard,
-        log_validation_ppl=args.log_validation_ppl_to_tensorboard,
-        log_interval=args.log_interval,
-        save_interval=args.save_interval,
-        async_save=getattr(args, "async_save", False),
-        save_dir=args.save,
-        eval_iterator=None if pipelined else eval_iter,
-        eval_interval=(args.eval_interval
-                       if eval_iter and not pipelined else None),
-        eval_iters=args.eval_iters,
-        exit_signal_handler=handler,
-        start_iteration=start_iteration,
-        opt_state=opt_state,
-        skip_iters=getattr(args, "skip_iters", ()) or (),
-        exit_interval=getattr(args, "exit_interval", None),
-        exit_duration_in_mins=getattr(args, "exit_duration_in_mins", None),
-    )
+    try:
+        params, opt_state, it = pretrain(
+            model, params, tc, pc, train_iter,
+            optimizer=optimizer,
+            scheduler=scheduler,
+            train_step=custom_step,
+            save_fn=save_natural,
+            resilience=resilience,
+            timers=Timers(log_level=args.timing_log_level,
+                          log_option=args.timing_log_option),
+            log_params_norm=args.log_params_norm,
+            log_num_zeros_in_grad=args.log_num_zeros_in_grad,
+            writer=writer,
+            tensorboard_log_interval=args.tensorboard_log_interval,
+            log_memory=args.log_memory_to_tensorboard,
+            log_batch_size=args.log_batch_size_to_tensorboard,
+            log_world_size=args.log_world_size_to_tensorboard,
+            log_validation_ppl=args.log_validation_ppl_to_tensorboard,
+            log_interval=args.log_interval,
+            save_interval=args.save_interval,
+            async_save=getattr(args, "async_save", False),
+            save_dir=args.save,
+            eval_iterator=None if pipelined else eval_iter,
+            eval_interval=(args.eval_interval
+                           if eval_iter and not pipelined else None),
+            eval_iters=args.eval_iters,
+            exit_signal_handler=handler,
+            start_iteration=start_iteration,
+            opt_state=opt_state,
+            skip_iters=getattr(args, "skip_iters", ()) or (),
+            exit_interval=getattr(args, "exit_interval", None),
+            exit_duration_in_mins=getattr(args, "exit_duration_in_mins",
+                                          None),
+        )
+    finally:
+        # stop the watchdog thread + uninstall the fault hook on every
+        # exit path (signal-save exits via SystemExit mid-pretrain)
+        if resilience is not None:
+            resilience.close()
 
     if args.save:
         save_natural(args.save, it, params, opt_state)

@@ -36,6 +36,7 @@ def build_base_parser() -> argparse.ArgumentParser:
     _add_data_args(parser)
     _add_logging_args(parser)
     _add_inference_args(parser)
+    _add_resilience_args(parser)
     _add_compat_noop_args(parser)
     return parser
 
@@ -348,6 +349,58 @@ def _add_inference_args(parser):
     g.add_argument("--inference_batch_times_seqlen_threshold", type=int,
                    default=512)
     g.add_argument("--max_tokens_to_oom", type=int, default=12000)
+
+
+def _add_resilience_args(parser):
+    """Fault-tolerance runtime (resilience.py; beyond-reference — the
+    reference's only in-band recovery is the fp16 loss-scale skip).
+    See docs/guide/fault_tolerance.md."""
+    g = parser.add_argument_group("resilience")
+    g.add_argument("--rewind_on_spike", action="store_true",
+                   help="rewind to the last good host snapshot when the "
+                        "loss goes non-finite or spikes past "
+                        "spike_factor x its EMA")
+    g.add_argument("--spike_factor", type=float, default=3.0,
+                   help="loss > factor * EMA counts as a spike (0 "
+                        "disables the spike test; non-finite always "
+                        "counts)")
+    g.add_argument("--spike_ema_beta", type=float, default=0.98,
+                   help="EMA smoothing for the spike baseline")
+    g.add_argument("--rewind_patience", type=int, default=1,
+                   help="consecutive bad checks before rewinding")
+    g.add_argument("--snapshot_interval", type=int, default=50,
+                   help="iterations between in-host-memory state "
+                        "snapshots (the rewind targets)")
+    g.add_argument("--resilience_check_interval", type=int, default=0,
+                   help="inspect loss/grad_norm every N iterations "
+                        "(device sync each check); 0 = only at log "
+                        "boundaries, which are synced anyway")
+    g.add_argument("--rewind_lr_factor", type=float, default=1.0,
+                   help="multiply the LR by this on every rewind "
+                        "(e.g. 0.5 to back off after a blow-up)")
+    g.add_argument("--max_rewinds", type=int, default=8,
+                   help="abort after this many rewinds (a run that keeps "
+                        "blowing up needs a human)")
+    g.add_argument("--watchdog_timeout_secs", type=float, default=None,
+                   help="arm the hang watchdog: if no iteration completes "
+                        "within this budget, dump stacks + device memory, "
+                        "rescue-save the latest snapshot, and exit 17")
+    g.add_argument("--watchdog_no_hard_exit", action="store_true",
+                   help="watchdog only diagnoses + rescue-saves; the "
+                        "process is left running")
+    g.add_argument("--save_total_limit", type=int, default=0,
+                   help="keep only the newest N iter_* checkpoints "
+                        "(0 = keep all)")
+    g.add_argument("--save_retries", type=int, default=2,
+                   help="retry a failed checkpoint save this many times "
+                        "(exponential backoff)")
+    g.add_argument("--save_retry_backoff", type=float, default=0.25,
+                   help="initial save-retry backoff in seconds (doubles "
+                        "per attempt)")
+    g.add_argument("--fault_inject", type=str, default=None,
+                   help="deterministic chaos spec for testing recovery, "
+                        "e.g. 'nan@3,save_io*2,hang@5:2.0,sigterm@7' "
+                        "(also via MEGATRON_FAULT_INJECT)")
 
 
 def _add_compat_noop_args(parser):

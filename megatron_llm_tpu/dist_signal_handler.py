@@ -1,4 +1,4 @@
-"""SIGTERM-coordinated checkpoint-and-exit.
+"""Signal-coordinated checkpoint-and-exit (SIGTERM + SIGINT).
 
 Reference: ``megatron/dist_signal_handler.py:50-81`` — installs a handler
 and all-gathers the flag so every rank agrees before saving.
@@ -6,6 +6,15 @@ and all-gathers the flag so every rank agrees before saving.
 TPU: under a single controller the decision is process-local; multi-host
 agreement uses a tiny max-reduce over hosts (the analogue of the
 reference's all_gather consensus) via ``jax.experimental.multihost_utils``.
+
+IMPORTANT: ``process_allgather`` is a *collective* — every host must call
+it together or the fabric deadlocks.  The reference calls its all_gather
+every iteration (dist_signal_handler.py:73-81), which both costs a DCN
+round trip per step and couples the hot loop to the slowest host.  Here
+``signals_received()`` polls the local flag only (free); the collective
+consensus runs only when the caller passes ``consensus=True``, which the
+train loop does at its deterministic log/save boundaries — the same
+iterations on every host, so the collective always matches up.
 """
 
 from __future__ import annotations
@@ -17,19 +26,26 @@ import numpy as np
 
 
 class DistributedSignalHandler:
-    def __init__(self, sig=signal.SIGTERM):
-        self.sig = sig
+    """Installs handlers for preemption-style signals.  SIGTERM is what
+    cluster schedulers send ahead of eviction; SIGINT makes ctrl-C on an
+    interactive run take the same graceful save-and-exit path."""
+
+    def __init__(self, sig=(signal.SIGTERM, signal.SIGINT)):
+        self.sigs = tuple(sig) if isinstance(sig, (tuple, list)) else (sig,)
         self._received = False
-        self._prev = None
+        self._prev = {}
 
     def __enter__(self):
-        self._prev = signal.getsignal(self.sig)
-        signal.signal(self.sig, self._handler)
+        for s in self.sigs:
+            self._prev[s] = signal.getsignal(s)
+            signal.signal(s, self._handler)
         return self
 
     def __exit__(self, *exc):
-        if self._prev is not None:
-            signal.signal(self.sig, self._prev)
+        for s, prev in self._prev.items():
+            if prev is not None:
+                signal.signal(s, prev)
+        self._prev = {}
         return False
 
     def install(self):
@@ -38,10 +54,20 @@ class DistributedSignalHandler:
     def _handler(self, signum, frame):
         self._received = True
 
-    def signals_received(self) -> bool:
-        """All hosts agree (max over hosts of the local flag)."""
+    def signals_received(self, consensus: bool = False) -> bool:
+        """Whether to stop for a signal.
+
+        ``consensus=False`` (default): local poll only — safe to call every
+        iteration at zero cost.  On a single host that IS the answer; on
+        multi-host it deliberately stays False so no host acts alone.
+
+        ``consensus=True``: max-reduce the flag over hosts.  Collective —
+        call it only at boundaries every host reaches in lockstep
+        (log/save intervals in the train loop)."""
         local = self._received
         if jax.process_count() > 1:
+            if not consensus:
+                return False
             from jax.experimental import multihost_utils
 
             flag = multihost_utils.process_allgather(

@@ -776,7 +776,7 @@ def sharded_flash_attention(
     # when those axes are size 1 / unused.  Unmentioned manual axes mean
     # "replicated", which matches the activation layout here (and inside
     # an enclosing pp/cp-manual region, matches per-group locality).
-    return jax.shard_map(
+    return topology.shard_map(
         lambda ql, kl, vl: flash_attention(ql, kl, vl, **kw),
         mesh=mesh,
         in_specs=(qspec, kvspec, kvspec),

@@ -105,13 +105,30 @@ class MegatronOptimizer:
             if self.cfg.optimizer == "adam"
             else None
         )
-        return OptimizerState(
+        state = OptimizerState(
             step=jnp.int32(0),
             master_params=master,
             exp_avg=exp_avg,
             exp_avg_sq=exp_avg_sq,
             grad_scaler=self.grad_scaler.init(),
         )
+        # place the scalar leaves (step, grad-scaler state) replicated on
+        # the active mesh: the jitted train step emits them that way, so a
+        # fresh init that matches avoids a second trace/compile of the
+        # whole fused step at iteration 2
+        from megatron_llm_tpu.parallel import sharding as _sh
+
+        mesh = _sh._mesh()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(mesh, PartitionSpec())
+            state = state._replace(
+                step=jax.device_put(state.step, rep),
+                grad_scaler=jax.tree_util.tree_map(
+                    lambda s: jax.device_put(s, rep), state.grad_scaler),
+            )
+        return state
 
     # ------------------------------------------------------------------
     def step(

@@ -64,13 +64,33 @@ def _mesh() -> Optional[Mesh]:
     return topology._MESH
 
 
+def _strip_manual_axes(spec: P) -> P:
+    """Drop mesh axes an enclosing manual region already bound (pre-0.6
+    jax, where ``topology.shard_map`` full-manualizes): constraining a
+    manual axis is a ValueError, and the array is device-local along it
+    anyway, so the constraint is meaningless there."""
+    bound = topology._bound_manual_axis_sizes()
+    if not bound:
+        return spec
+
+    def keep(a):
+        if isinstance(a, (tuple, list)):
+            kept = tuple(x for x in a if x not in bound)
+            return kept if kept else None
+        return None if a in bound else a
+
+    return P(*(keep(a) for a in spec))
+
+
 def constrain(x: jax.Array, *logical_axes: Optional[str], rules=None) -> jax.Array:
     """``with_sharding_constraint`` by logical axis names; no-op when no mesh
     is initialized (pure single-device runs and numpy-golden tests)."""
     mesh = _mesh()
     if mesh is None or all(a is None for a in logical_axes):
         return x
-    spec = logical_to_mesh(logical_axes, rules)
+    spec = _strip_manual_axes(logical_to_mesh(logical_axes, rules))
+    if all(a is None for a in spec):
+        return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
@@ -80,14 +100,14 @@ def with_logical_constraint(tree, specs, rules=None):
     mesh = _mesh()
     if mesh is None:
         return tree
+    def one(x, s):
+        spec = _strip_manual_axes(logical_to_mesh(s, rules))
+        if all(a is None for a in spec):
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
     return jax.tree_util.tree_map(
-        lambda x, s: jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, logical_to_mesh(s, rules))
-        ),
-        tree,
-        specs,
-        is_leaf=lambda v: v is None,
-    )
+        one, tree, specs, is_leaf=lambda v: v is None)
 
 
 def make_shardings(specs, rules=None, mesh: Optional[Mesh] = None):

@@ -193,7 +193,10 @@ def training_log(
     # standard fields, like the reference's per-key loss dict logging
     known = {"lm loss", "loss_scale", "grad_norm", "skipped_iter"}
     for k in sorted(set(metrics) - known):
-        line += f" {k}: {float(metrics[k]):.6E} |"
+        v = metrics[k]
+        # recovery counters and other integral extras read better as ints
+        line += (f" {k}: {v} |" if isinstance(v, int)
+                 else f" {k}: {float(v):.6E} |")
     printer(line)
     if writer is not None:
         for k, v in metrics.items():
@@ -238,6 +241,7 @@ def pretrain(
     log_batch_size: bool = False,
     log_world_size: bool = False,
     log_validation_ppl: bool = False,
+    resilience=None,
 ):
     """Minimal-dependency pretrain loop (the full CLI driver lives in
     ``finetune.py`` / ``pretrain_gpt.py`` at the repo root).
@@ -267,6 +271,12 @@ def pretrain(
     and ``eval_iterator`` is rejected.  ``save_fn(save_dir, it, params,
     opt_state, scheduler)`` overrides checkpoint writing (e.g. to convert
     a VPP stage-major layout back to natural order first).
+
+    ``resilience`` (a ``resilience.ResilienceManager``) arms the
+    fault-tolerance runtime: fault injection before/into each batch,
+    rolling host snapshots, NaN/spike detection at check boundaries with
+    rewind, and the hang watchdog around dispatch/sync.  All of it is
+    host-side — the jitted step is untouched.
     """
     from megatron_llm_tpu import checkpointing
     from megatron_llm_tpu.timers import Timers
@@ -328,7 +338,28 @@ def pretrain(
     train_start = time.perf_counter()
     skip_step = None  # forward-only step, compiled lazily on first skip
 
+    injector = resilience.injector if resilience is not None else None
+    watchdog = resilience.watchdog if resilience is not None else None
+    if resilience is not None:
+        resilience.bind_rescue(
+            save_dir,
+            checkpointing.config_to_args(getattr(model, "cfg", None)))
+    if watchdog is not None:
+        # armed only after the first step completes: iteration 1 includes
+        # XLA compilation, which can dwarf any sane hang timeout
+        watchdog.start()
+        watchdog.pause()
+
+    def _signals(consensus: bool) -> bool:
+        # older handlers (tests, user code) may lack the consensus kwarg
+        try:
+            return exit_signal_handler.signals_received(consensus=consensus)
+        except TypeError:
+            return exit_signal_handler.signals_received()
+
     def _save(it):
+        if watchdog is not None:
+            watchdog.pause()        # storage latency is not a hang
         timers("save-checkpoint", log_level=0).start()
         if save_fn is not None:
             save_fn(save_dir, it, params, opt_state, scheduler)
@@ -341,13 +372,26 @@ def pretrain(
                 async_save=async_save,
             )
         timers("save-checkpoint").stop()
+        if watchdog is not None:
+            watchdog.resume()
 
     try:
         while iteration < train_cfg.train_iters:
+            if resilience is not None and resilience.snapshot_due(iteration):
+                # host-copy the last known-good state BEFORE this step runs
+                # (donation invalidates the old buffers once dispatched)
+                resilience.take_snapshot(iteration, params, opt_state,
+                                         scheduler)
+            if injector is not None:
+                injector.before_iteration(iteration + 1)
             timers("batch-generator", log_level=1).start()
             batch = next(batch_iterator)
             timers("batch-generator").stop()
+            if injector is not None:
+                batch = injector.poison_batch(iteration + 1, batch)
             lr, wd = scheduler.step(1)
+            if resilience is not None:
+                lr = lr * resilience.lr_scale
             step_key = jax.random.fold_in(base_key, iteration)
             if (iteration + 1) in skip_iters:
                 # reference training.py:397-399: forward-only, no update
@@ -375,11 +419,38 @@ def pretrain(
                     params, opt_state, batch, step_key, lr, wd
                 )
                 timers("train-step").stop()
+            if watchdog is not None:
+                watchdog.resume()   # (re)arms; first arm is post-compile
             iteration += 1
             tokens = batch["tokens"].size
             counters["tokens"] += tokens
+            # one sample == one sequence: every leading axis but seq
+            # (reference tracks consumed_train_samples, training.py:700;
+            # this feeds the checkpoint's consumed_samples field)
+            counters["samples"] += tokens // batch["tokens"].shape[-1]
 
-            if log_interval and iteration % log_interval == 0:
+            at_log_boundary = bool(log_interval
+                                   and iteration % log_interval == 0)
+            if (resilience is not None
+                    and resilience.check_due(iteration, at_log_boundary)):
+                loss_val = float(metrics["lm loss"])    # device sync
+                if watchdog is not None:
+                    watchdog.progress()
+                gn = metrics.get("grad_norm")
+                bad = resilience.record_metrics(
+                    iteration, loss_val,
+                    None if gn is None else float(gn))
+                if bad and resilience.should_rewind():
+                    if watchdog is not None:
+                        watchdog.pause()
+                    params, opt_state, iteration = resilience.rewind(
+                        params, opt_state, scheduler, batch_iterator)
+                    if watchdog is not None:
+                        watchdog.resume()
+                    last_time = time.perf_counter()
+                    continue
+
+            if at_log_boundary:
                 if log_params_norm:     # reference --log_params_norm
                     metrics = dict(metrics)
                     metrics["params norm"] = global_grad_norm(params)
@@ -410,9 +481,13 @@ def pretrain(
                         use_writer.add_scalar(
                             "mem-bytes-in-use",
                             stats.get("bytes_in_use", 0), iteration)
+                log_metrics = {k: float(v) for k, v in metrics.items()}
+                if resilience is not None:
+                    from megatron_llm_tpu.resilience import recovery_counters
+                    log_metrics.update(recovery_counters())
                 training_log(
                     iteration, train_cfg.train_iters,
-                    {k: float(v) for k, v in metrics.items()},
+                    log_metrics,
                     elapsed, tokens, lr,
                     writer=use_writer,
                 )
@@ -427,12 +502,16 @@ def pretrain(
                     on_metrics(iteration, metrics)
 
             if eval_step is not None and eval_interval and iteration % eval_interval == 0:
+                if watchdog is not None:
+                    watchdog.pause()    # eval has its own duration budget
                 timers("eval-time", log_level=0).start()
                 losses = []
                 for _ in range(eval_iters):
                     eval_batch = next(eval_iterator)
                     losses.append(float(eval_step(params, eval_batch, None)))
                 timers("eval-time").stop()
+                if watchdog is not None:
+                    watchdog.resume()
                 val = sum(losses) / len(losses)
                 print(f" validation loss at iteration {iteration}: {val:.6E}")
                 if writer is not None:
@@ -449,10 +528,19 @@ def pretrain(
                 _save(iteration)
                 saved = True
 
-            if exit_signal_handler is not None and exit_signal_handler.signals_received():
+            # deterministic consensus boundaries only: every host reaches
+            # the same (log / save / final) iterations, so the multi-host
+            # allgather inside signals_received always pairs up.  Off these
+            # boundaries the poll is local-only and free (the reference
+            # all-gathers every iteration, dist_signal_handler.py:73-81).
+            at_boundary = (saved or at_log_boundary
+                           or iteration >= train_cfg.train_iters)
+            if exit_signal_handler is not None and _signals(at_boundary):
                 print("exiting on termination signal: saving checkpoint")
-                if save_dir and not saved:
-                    _save(iteration)
+                if save_dir:
+                    if not saved:
+                        _save(iteration)
+                    counters["signal_saves"] += 1
                 sys.exit(0)
 
             # exit based on duration (reference training.py:746-758)
@@ -476,5 +564,7 @@ def pretrain(
         # every exit path — normal completion, sys.exit (raises
         # SystemExit), or an exception — flushes in-flight async
         # saves so a durable checkpoint always gets its tracker
+        if watchdog is not None:
+            watchdog.stop()
         checkpointing.finalize_async_saves()
     return params, opt_state, iteration

@@ -66,8 +66,23 @@ class WandbTBShim:
         self._pending.clear()
 
     def finish(self):
+        # run-level recovery summary (rewinds / save_retries /
+        # watchdog_fires / signal_saves) so a run's fault history is
+        # visible without scanning the per-step stream
+        try:
+            from megatron_llm_tpu.resilience import recovery_counters
+
+            summary = recovery_counters()
+        except Exception:
+            summary = None
         self.flush()
         if self._wandb is not None:
+            if summary:
+                for k, v in summary.items():
+                    self._run.summary[f"recovery/{k}"] = v
             self._run.finish()
         elif self._file is not None:
+            if summary is not None:
+                self._file.write(json.dumps(
+                    {"event": "recovery_summary", **summary}) + "\n")
             self._file.close()

@@ -14,18 +14,35 @@ import os
 # the 8 virtual device threads can legitimately take longer to converge
 # (compilation runs on the same cores), and the default *aborts the
 # process*.  Raise them — slow is fine, SIGABRT mid-suite is not.
-_WANTED_FLAGS = (
-    "--xla_force_host_platform_device_count=8 "
-    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=300 "
-    "--xla_cpu_collective_call_terminate_timeout_seconds=7200"
-)
+#
+# XLA aborts the whole process on an UNKNOWN flag in XLA_FLAGS
+# (parse_flags_from_env.cc), and the collective-call timeout flags do not
+# exist in every jaxlib — probe the extension binary for each flag's name
+# and only pass the ones this build knows about.
+
+
+def _xla_flag_supported(name: str) -> bool:
+    try:
+        import jaxlib
+
+        so = os.path.join(os.path.dirname(jaxlib.__file__),
+                          "xla_extension.so")
+        with open(so, "rb") as f:
+            return name.encode() in f.read()
+    except Exception:
+        return True     # can't probe: keep the flag (pre-probe behavior)
+
+
+_WANTED_FLAGS = [
+    "--xla_force_host_platform_device_count=8",
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=300",
+    "--xla_cpu_collective_call_terminate_timeout_seconds=7200",
+]
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    _flags = (_flags + " " + _WANTED_FLAGS).strip()
-elif "collective_call_terminate_timeout" not in _flags:
-    _flags = (_flags + " "
-              + "--xla_cpu_collective_call_warn_stuck_timeout_seconds=300 "
-              + "--xla_cpu_collective_call_terminate_timeout_seconds=7200")
+for _f in _WANTED_FLAGS:
+    _name = _f.lstrip("-").split("=")[0]
+    if _name not in _flags and _xla_flag_supported(_name):
+        _flags = (_flags + " " + _f).strip()
 os.environ["XLA_FLAGS"] = _flags
 os.environ["JAX_PLATFORMS"] = "cpu"
 

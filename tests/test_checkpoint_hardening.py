@@ -1,0 +1,200 @@
+"""Hardened checkpoint IO: atomic publish, retry-with-backoff, manifest
+validation, corrupt-tracker / corrupt-checkpoint fallback, keep-last-N GC."""
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from megatron_llm_tpu import checkpointing, global_vars
+from megatron_llm_tpu.resilience import set_save_fault_hook
+
+
+@pytest.fixture(autouse=True)
+def _clean_save_state():
+    global_vars.reset_counters()
+    checkpointing.configure_save(total_limit=0, retries=2,
+                                 retry_backoff=0.01)
+    yield
+    set_save_fault_hook(None)
+    global_vars.reset_counters()
+    checkpointing.configure_save(total_limit=0, retries=2,
+                                 retry_backoff=0.25)
+
+
+def _params(seed=0, scale=1.0):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": jnp.asarray(rng.randn(4, 4).astype(np.float32) * scale),
+        "b": jnp.asarray(rng.randn(4).astype(np.float32) * scale),
+    }
+
+
+def _tracker(d):
+    return checkpointing.get_checkpoint_tracker_filename(str(d))
+
+
+# ---------------------------------------------------------------------------
+# Atomic publish + manifest
+# ---------------------------------------------------------------------------
+
+def test_save_is_atomic_and_validates(tmp_path):
+    checkpointing.save_checkpoint(str(tmp_path), 7, _params())
+    assert (tmp_path / "iter_0000007").is_dir()
+    assert not list(tmp_path.glob("*.tmp"))
+    ok, reason = checkpointing.validate_checkpoint_dir(
+        tmp_path / "iter_0000007")
+    assert ok, reason
+    pl, _, meta = checkpointing.load_checkpoint(str(tmp_path))
+    assert meta["iteration"] == 7
+    np.testing.assert_array_equal(np.asarray(pl["w"]),
+                                  np.asarray(_params()["w"]))
+
+
+def test_manifest_checksum_detects_tampering(tmp_path):
+    checkpointing.save_checkpoint(str(tmp_path), 1, _params())
+    meta_path = tmp_path / "iter_0000001" / "meta.json"
+    meta = json.loads(meta_path.read_text())
+    meta["manifest"]["model"]["['w']"]["shape"] = [9, 9]
+    meta_path.write_text(json.dumps(meta))
+    ok, reason = checkpointing.validate_checkpoint_dir(
+        tmp_path / "iter_0000001")
+    assert not ok and "checksum" in reason
+
+
+def test_load_rejects_shape_mismatch(tmp_path):
+    """A manifest that passes its checksum but disagrees with the restored
+    tensors (bit rot, wrong-file copy) fails loudly instead of training on
+    garbage."""
+    checkpointing.save_checkpoint(str(tmp_path), 1, _params())
+    meta_path = tmp_path / "iter_0000001" / "meta.json"
+    meta = json.loads(meta_path.read_text())
+    meta["manifest"]["model"]["['w']"]["shape"] = [9, 9]
+    meta["manifest_sha256"] = checkpointing._manifest_sha256(
+        meta["manifest"])
+    meta_path.write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="mismatches its manifest"):
+        checkpointing.load_checkpoint(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Retry
+# ---------------------------------------------------------------------------
+
+def test_save_retries_transient_ioerror(tmp_path):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise IOError("transient")
+
+    set_save_fault_hook(flaky)
+    checkpointing.configure_save(retries=3, retry_backoff=0.01)
+    checkpointing.save_checkpoint(str(tmp_path), 4, _params())
+    assert global_vars.get_counters()["save_retries"] == 2
+    assert (tmp_path / "iter_0000004").is_dir()
+    ok, reason = checkpointing.validate_checkpoint_dir(
+        tmp_path / "iter_0000004")
+    assert ok, reason
+
+
+def test_save_raises_after_retry_exhaustion(tmp_path):
+    def always_fail():
+        raise IOError("storage is gone")
+
+    set_save_fault_hook(always_fail)
+    checkpointing.configure_save(retries=1, retry_backoff=0.01)
+    with pytest.raises(IOError):
+        checkpointing.save_checkpoint(str(tmp_path), 4, _params())
+    assert global_vars.get_counters()["save_retries"] == 1
+    # nothing published: no final dir, no tracker
+    assert not (tmp_path / "iter_0000004").exists()
+    assert not os.path.exists(_tracker(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Corruption fallback
+# ---------------------------------------------------------------------------
+
+def test_corrupt_tracker_returns_absent():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        it, release = checkpointing.read_tracker(d)       # no tracker
+        assert it is None and not release
+        with open(_tracker(d), "w") as f:
+            f.write("")                                   # killed mid-write
+        assert checkpointing.read_tracker(d) == (None, False)
+        with open(_tracker(d), "w") as f:
+            f.write("garbage\n")
+        assert checkpointing.read_tracker(d) == (None, False)
+        with open(_tracker(d), "w") as f:
+            f.write(" 12 \n")
+        assert checkpointing.read_tracker(d) == (12, False)
+        with open(_tracker(d), "w") as f:
+            f.write("release")
+        assert checkpointing.read_tracker(d) == (None, True)
+
+
+def test_corrupt_tracker_falls_back_to_newest_valid(tmp_path):
+    checkpointing.save_checkpoint(str(tmp_path), 1, _params(1))
+    checkpointing.save_checkpoint(str(tmp_path), 2, _params(2))
+    with open(_tracker(tmp_path), "w") as f:
+        f.write("not-a-number")
+    pl, _, meta = checkpointing.load_checkpoint(str(tmp_path))
+    assert meta["iteration"] == 2
+    np.testing.assert_array_equal(np.asarray(pl["w"]),
+                                  np.asarray(_params(2)["w"]))
+
+
+def test_corrupt_latest_falls_back_to_previous(tmp_path):
+    checkpointing.save_checkpoint(str(tmp_path), 1, _params(1))
+    checkpointing.save_checkpoint(str(tmp_path), 2, _params(2))
+    # iter 2's payload rots away; the tracker still points at it
+    (tmp_path / "iter_0000002" / "meta.json").write_text("{ truncated")
+    pl, _, meta = checkpointing.load_checkpoint(str(tmp_path))
+    assert meta["iteration"] == 1
+    np.testing.assert_array_equal(np.asarray(pl["w"]),
+                                  np.asarray(_params(1)["w"]))
+
+
+def test_no_valid_checkpoint_returns_none(tmp_path):
+    with open(_tracker(tmp_path), "w") as f:
+        f.write("5")                    # dangling tracker, no payload
+    assert checkpointing.load_checkpoint(str(tmp_path)) == (None, None, None)
+
+
+def test_explicit_iteration_never_substituted(tmp_path):
+    checkpointing.save_checkpoint(str(tmp_path), 1, _params(1))
+    checkpointing.save_checkpoint(str(tmp_path), 2, _params(2))
+    (tmp_path / "iter_0000002" / "meta.json").unlink()
+    # implicit load falls back; an explicit request must not
+    _, _, meta = checkpointing.load_checkpoint(str(tmp_path))
+    assert meta["iteration"] == 1
+    with pytest.raises(FileNotFoundError):
+        checkpointing.load_checkpoint(str(tmp_path), iteration=2)
+
+
+# ---------------------------------------------------------------------------
+# GC
+# ---------------------------------------------------------------------------
+
+def test_save_total_limit_keeps_last_n(tmp_path):
+    checkpointing.configure_save(total_limit=2)
+    for i in range(1, 5):
+        checkpointing.save_checkpoint(str(tmp_path), i, _params(i))
+    kept = sorted(p.name for p in tmp_path.glob("iter_*"))
+    assert kept == ["iter_0000003", "iter_0000004"]
+    _, _, meta = checkpointing.load_checkpoint(str(tmp_path))
+    assert meta["iteration"] == 4
+
+
+def test_total_limit_zero_keeps_everything(tmp_path):
+    checkpointing.configure_save(total_limit=0)
+    for i in range(1, 4):
+        checkpointing.save_checkpoint(str(tmp_path), i, _params(i))
+    assert len(list(tmp_path.glob("iter_*"))) == 3
