@@ -41,16 +41,10 @@ def log(msg):
 # Child: the actual measurement (runs with jax, under parent's deadline)
 # --------------------------------------------------------------------------
 
-PEAK_FLOPS = {
-    # bf16 peak per chip, keyed by device_kind substrings; spellings vary
-    # across libtpu versions (v5e reports "TPU v5 lite" or "TPU v5e")
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+# the per-chip bf16 peak table and the >0.95 MFU fabrication guard live in
+# megatron_llm_tpu/telemetry.py (one source of truth with the runtime
+# throughput stream); imported inside child_main only — the parent must
+# stay jax-free
 A100_REFERENCE_MFU = 0.47  # BASELINE.md derivation
 
 
@@ -81,8 +75,9 @@ def child_main():
               or "TPU" in dev.device_kind)
     # peak FLOPs only meaningful on real TPU hardware; None elsewhere so the
     # CPU fallback never fabricates an MFU / vs_baseline measurement
-    peak = next((v for k, v in PEAK_FLOPS.items() if k in dev.device_kind),
-                197e12 if on_tpu else None)
+    from megatron_llm_tpu.telemetry import (MFU_SANITY_LIMIT,
+                                            peak_flops_for_kind)
+    peak = peak_flops_for_kind(dev.device_kind, assume_tpu=on_tpu)
     log(f"child: BENCH_INIT_OK backend={jax.default_backend()} "
         f"device={dev.device_kind}")
 
@@ -304,12 +299,12 @@ def child_main():
     tps = tokens_per_iter / dt
     flops_tok = model.flops_per_token()
     mfu = tps * flops_tok / peak if peak else None
-    if mfu is not None and mfu > 0.95:
+    if mfu is not None and mfu > MFU_SANITY_LIMIT:
         # physically impossible: the timing loop failed to sync with the
         # device.  Refuse to emit a garbage number; a nonzero exit makes
         # the parent fall through its attempt ladder.
-        log(f"child: MEASUREMENT_INVALID mfu={mfu:.2f} > 0.95 "
-            f"(dt={dt*1000:.2f} ms/iter cannot be real)")
+        log(f"child: MEASUREMENT_INVALID mfu={mfu:.2f} > "
+            f"{MFU_SANITY_LIMIT} (dt={dt*1000:.2f} ms/iter cannot be real)")
         sys.exit(3)
 
     rec = {
@@ -374,9 +369,10 @@ def child_main():
                                     label="seq2048")
             tps2 = mb2 * sec_seq / dt2
             mfu2 = tps2 * model2.flops_per_token() / peak if peak else None
-            if mfu2 is not None and mfu2 > 0.95:
+            if mfu2 is not None and mfu2 > MFU_SANITY_LIMIT:
                 log(f"child: seq2048 MEASUREMENT_INVALID mfu={mfu2:.2f} "
-                    f"> 0.95 — dropping the secondary (primary stands)")
+                    f"> {MFU_SANITY_LIMIT} — dropping the secondary "
+                    f"(primary stands)")
             elif mfu2 is not None:
                 rec["seq2048"] = {
                     "value": round(tps2, 1), "mfu": round(mfu2, 4),

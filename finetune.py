@@ -536,6 +536,10 @@ def main():
               f"{sum(losses) / len(losses):.6E}")
         return
 
+    from megatron_llm_tpu.telemetry import build_telemetry
+
+    telemetry = build_telemetry(args, model)
+
     try:
         params, opt_state, it = pretrain(
             model, params, tc, pc, train_iter,
@@ -544,6 +548,7 @@ def main():
             train_step=custom_step,
             save_fn=save_natural,
             resilience=resilience,
+            telemetry=telemetry,
             timers=Timers(log_level=args.timing_log_level,
                           log_option=args.timing_log_option),
             log_params_norm=args.log_params_norm,
@@ -575,6 +580,9 @@ def main():
         # exit path (signal-save exits via SystemExit mid-pretrain)
         if resilience is not None:
             resilience.close()
+        # close after resilience: a crash path above may still want to
+        # dump the flight recorder through the installed stream
+        telemetry.close()
 
     if args.save:
         save_natural(args.save, it, params, opt_state)

@@ -35,6 +35,7 @@ def build_base_parser() -> argparse.ArgumentParser:
     _add_validation_args(parser)
     _add_data_args(parser)
     _add_logging_args(parser)
+    _add_telemetry_args(parser)
     _add_inference_args(parser)
     _add_resilience_args(parser)
     _add_compat_noop_args(parser)
@@ -344,6 +345,39 @@ def _add_logging_args(parser):
     g.add_argument("--wandb_api_key", type=str, default=None)
 
 
+def _add_telemetry_args(parser):
+    """Unified runtime telemetry (telemetry.py; MegaScale arxiv
+    2402.15627 §5 — per-step telemetry, in-situ profiler capture, flight
+    recorder).  See docs/guide/observability.md."""
+    g = parser.add_argument_group("telemetry")
+    g.add_argument("--structured_log_dir", type=str, default=None,
+                   help="write one JSONL record per log boundary "
+                        "(telemetry.jsonl) with loss/lr/step time/"
+                        "throughput/MFU/memory/recovery counters, and "
+                        "keep a flight recorder of the last K step "
+                        "records dumped here on watchdog fire/crash")
+    g.add_argument("--flight_recorder_size", type=int, default=64,
+                   help="how many step records the in-memory flight "
+                        "recorder retains")
+    g.add_argument("--profile", action="store_true",
+                   help="capture a jax.profiler trace of iterations "
+                        "[profile_step_start, profile_step_end] during "
+                        "training (in-loop analogue of "
+                        "tools/profile_step.py)")
+    g.add_argument("--profile_step_start", type=int, default=10,
+                   help="first iteration inside the profiler trace "
+                        "(leave warmup/compile outside the window)")
+    g.add_argument("--profile_step_end", type=int, default=12,
+                   help="last iteration inside the profiler trace")
+    g.add_argument("--profile_dir", type=str, default=None,
+                   help="trace output dir (default: "
+                        "<structured_log_dir>/profile, else "
+                        "./profile_trace)")
+    g.add_argument("--profiler_port", type=int, default=None,
+                   help="start jax.profiler.start_server on this port "
+                        "for live TensorBoard capture")
+
+
 def _add_inference_args(parser):
     g = parser.add_argument_group("inference")
     g.add_argument("--inference_batch_times_seqlen_threshold", type=int,
@@ -529,6 +563,11 @@ def validate_args(args, world_size: Optional[int] = None):
     )
     args.world_size = world_size
     args.data_parallel_size = world_size // mp   # reference: arguments.py:76
+
+    if getattr(args, "profile", False):
+        assert args.profile_step_end >= args.profile_step_start, (
+            f"--profile_step_end ({args.profile_step_end}) must be >= "
+            f"--profile_step_start ({args.profile_step_start})")
 
     # virtual pipeline (reference: arguments.py:121-132)
     if args.num_layers_per_virtual_pipeline_stage is not None:

@@ -68,6 +68,17 @@ def recovery_counters() -> Dict[str, int]:
     return rc()
 
 
+def telemetry_summary() -> Optional[Dict[str, float]]:
+    """The active run's aggregate telemetry (mean MFU, mean
+    tokens/sec/device, mean step time) from the --structured_log_dir
+    stream; None when no stream is installed.  Re-exported here (like
+    ``recovery_counters``) so metrics consumers need not import
+    telemetry."""
+    from megatron_llm_tpu.telemetry import run_summary
+
+    return run_summary()
+
+
 def get_metric(name: str):
     if name not in METRICS:
         raise KeyError(

@@ -336,10 +336,13 @@ def language_model_forward(
         k_embed, k_stack = jax.random.split(rng_key)
     else:
         k_embed = k_stack = None
-    h = embedding_forward(
-        tokens, position_ids, params["embedding"], cfg,
-        tokentype_ids=tokentype_ids, rng_key=k_embed, train=train,
-    )
+    # named_scope: trace-time only (zero runtime cost) — groups the xplane
+    # ops for the in-loop profiler (telemetry.py / --profile)
+    with jax.named_scope("embedding"):
+        h = embedding_forward(
+            tokens, position_ids, params["embedding"], cfg,
+            tokentype_ids=tokentype_ids, rng_key=k_embed, train=train,
+        )
     if sequence_parallel:
         h = constrain(h, "batch", "seq_tp", None)
     if freqs is None:
@@ -370,11 +373,12 @@ def language_model_forward(
         return (h, moe_aux) if cfg.num_experts > 1 else h
 
     head = lm_head_weight(params)
-    logits = parallel_lm_logits(
-        h, head,
-        sequence_parallel=sequence_parallel,
-        compute_dtype=cfg.compute_jnp_dtype,
-    )
+    with jax.named_scope("lm_head"):
+        logits = parallel_lm_logits(
+            h, head,
+            sequence_parallel=sequence_parallel,
+            compute_dtype=cfg.compute_jnp_dtype,
+        )
     if kv_caches is not None:
         return logits, new_caches
     return (logits, moe_aux) if cfg.num_experts > 1 else logits

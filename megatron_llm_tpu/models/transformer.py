@@ -643,21 +643,26 @@ def transformer_layer(
         dropout_key=k_attn_drop, train=train, sequence_parallel=sequence_parallel,
         kv_cache=kv_cache,
     )
-    if kv_cache is not None:
-        attn_out, new_cache = attention(ln_out, params["attention"], cfg, **attn_kw)
-    else:
-        attn_out = attention(ln_out, params["attention"], cfg, **attn_kw)
-        new_cache = None
+    # named_scope: trace-time profiler annotation (telemetry.py --profile)
+    with jax.named_scope("attention"):
+        if kv_cache is not None:
+            attn_out, new_cache = attention(ln_out, params["attention"], cfg,
+                                            **attn_kw)
+        else:
+            attn_out = attention(ln_out, params["attention"], cfg, **attn_kw)
+            new_cache = None
 
     # MoE (num_experts > 1) replaces the dense MLP and adds a routing aux
     # loss threaded up through the stack scan (models/moe.py)
     def run_mlp(inp):
-        if cfg.num_experts > 1:
-            return moe_mlp(inp, params["mlp"], cfg)
-        return (
-            mlp(inp, params["mlp"], cfg, sequence_parallel=sequence_parallel),
-            None,
-        )
+        with jax.named_scope("mlp"):
+            if cfg.num_experts > 1:
+                return moe_mlp(inp, params["mlp"], cfg)
+            return (
+                mlp(inp, params["mlp"], cfg,
+                    sequence_parallel=sequence_parallel),
+                None,
+            )
 
     if cfg.parallel_attn:
         # Falcon: mlp feeds from the same (or its own) LN output; single
@@ -751,6 +756,7 @@ def transformer_stack(
 
     moe_on = cfg.num_experts > 1
 
+    @jax.named_scope("transformer_layer")
     def body(carry, scanned):
         h, aux_acc = carry if moe_on else (carry, None)
         if dropout_rates is not None:

@@ -282,6 +282,7 @@ def build_pipeline_loss_fn(
             emb_key0 = jax.random.fold_in(rng_key_, 1)
             lay_key0 = jax.random.fold_in(rng_key_, 2)
 
+            @jax.named_scope("pp_chunk")
             def run_chunk(h, v, m):
                 """Apply this device's chunk v; returns (h, aux [2]) where
                 aux is the chunk's accumulated MoE routing losses (zeros
@@ -474,6 +475,7 @@ def build_pipeline_grad_fn(
             emb_key0 = jax.random.fold_in(rng_key_, 1)
             lay_key0 = jax.random.fold_in(rng_key_, 2)
 
+            @jax.named_scope("pp_chunk_fwd")
             def chunk_fwd(h, layers_loc, m):
                 """(h, aux [2]): this stage's cl layers + its MoE routing
                 losses (zeros for dense models)."""
@@ -503,6 +505,7 @@ def build_pipeline_grad_fn(
                     jnp.arange(cl))
                 return h, aux
 
+            @jax.named_scope("pp_embed")
             def embed(emb_params, m):
                 toks_m = _index_mb(tokens_, m)
                 return embedding_forward(
@@ -513,6 +516,7 @@ def build_pipeline_grad_fn(
                     vocab_parallel_manual=True,
                 ).astype(cfg.compute_jnp_dtype)
 
+            @jax.named_scope("pp_head_ce")
             def head_ce(out, head_w_in, fnorm_in, m):
                 h_fin = apply_norm(
                     out, fnorm_in, cfg.normalization,

@@ -45,6 +45,7 @@ TB/W&B writer, and ``bench.py`` artifacts.
 
 from __future__ import annotations
 
+import json
 import math
 import os
 import signal
@@ -210,6 +211,23 @@ def dump_stacks_and_memory(printer: Callable[[str], None] = print) -> str:
                          f"{stats.get('peak_bytes_in_use', 'n/a')}")
     except Exception as e:       # diagnostics must never raise
         lines.append(f"(device stats unavailable: {e})")
+    # flight recorder (telemetry.py): the last K step records tell you what
+    # the run was doing when it wedged — MegaScale §5.3 forensics.  Dumped
+    # as flight_recorder.json next to the run's JSONL stream AND inlined in
+    # the printed report (the file may be unreachable post-mortem).
+    try:
+        from megatron_llm_tpu import telemetry
+
+        recorder = telemetry.get_flight_recorder()
+        if recorder is not None and len(recorder):
+            path = telemetry.dump_flight_recorder(reason="stack dump")
+            lines.append("==== watchdog: flight recorder "
+                         f"(last {len(recorder)} records"
+                         f"{', dumped to ' + path if path else ''}) ====")
+            for rec in recorder.records():
+                lines.append(json.dumps(rec))
+    except Exception as e:
+        lines.append(f"(flight recorder unavailable: {e})")
     dump = "\n".join(lines)
     printer(dump)
     return dump

@@ -1,0 +1,459 @@
+"""Unified runtime telemetry: throughput/MFU stream, structured JSONL log,
+flight recorder, in-loop profiler capture.
+
+Motivation (MegaScale, arXiv:2402.15627 §5): at scale, "is the run
+healthy and fast?" must be answerable from the run itself — per-step
+telemetry in a structured stream, in-situ profiler capture, and a flight
+recorder consulted on failure.  The reference Megatron-LM computes a
+throughput estimate inside ``training_log`` (arXiv:2104.04473;
+training.py:591-609) but has no machine-readable stream and no profiler
+integration; ``bench.py`` here measures MFU out-of-band only.  This
+module puts that layer *in* the training loop:
+
+* **ThroughputCalculator** — tokens/sec, tokens/sec/device, achieved
+  TFLOPs/device and MFU from the model-level ``flops_per_token()`` and
+  the per-chip peak-FLOPs table (shared with ``bench.py`` — one source
+  of truth).  MFU carries the same > ``MFU_SANITY_LIMIT`` fabrication
+  guard the bench uses: a physically impossible number means the timing
+  failed to sync with the device, and is reported as null, never as a
+  value.
+
+* **TelemetryStream** (``--structured_log_dir``) — one JSONL record per
+  log boundary: iteration, losses, grad_norm, lr, step time, throughput
+  / MFU, per-device ``memory_stats()``, recovery counters.  Records are
+  versioned (``schema``) and written line-buffered by process 0 only.
+
+* **FlightRecorder** — bounded in-memory deque of the last K step
+  records (lightweight per-iteration dispatch entries + the full
+  log-boundary records).  The resilience watchdog/crash path dumps it
+  next to its thread-stack report (``resilience.dump_stacks_and_memory``)
+  and, when a structured log dir exists, as ``flight_recorder.json``
+  beside the stream — MegaScale's "what were the last things the run
+  did" forensics.
+
+* **ProfilerSession** (``--profile --profile_step_start N
+  --profile_step_end M --profile_dir D``) — wraps the chosen step window
+  in ``jax.profiler`` trace capture during real training (subsuming
+  ``tools/profile_step.py``'s one-shot flow); ``--profiler_port`` starts
+  ``jax.profiler.start_server`` for live TensorBoard capture.
+  ``jax.named_scope`` annotations on the embedding / transformer layers
+  / pipeline stages make the resulting xplane legible.
+
+Everything here is host-side: nothing enters the jitted step, so
+telemetry costs nothing on the XLA program.  Collective discipline
+matches ``dist_signal_handler.py``: any cross-host reduction happens
+only at deterministic log boundaries (see ``timers.Timers``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from megatron_llm_tpu.global_vars import get_counters
+
+# ---------------------------------------------------------------------------
+# Peak FLOPs / MFU
+# ---------------------------------------------------------------------------
+
+# bf16 peak per chip, keyed by device_kind substrings; spellings vary
+# across libtpu versions (v5e reports "TPU v5 lite" or "TPU v5e").
+# Single source of truth — bench.py imports this table.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+# MFU above this is physically impossible — the timing loop failed to
+# sync with the device (bench.py round-3 caught a 1380-MFU "measurement"
+# this way).  Shared by bench.py (which aborts) and the runtime stream
+# (which reports null).
+MFU_SANITY_LIMIT = 0.95
+
+
+def peak_flops_for_kind(device_kind: str,
+                        assume_tpu: bool = False) -> Optional[float]:
+    """Peak bf16 FLOPs for a device_kind string, or None when the
+    hardware has no meaningful peak (CPU) — a null peak means MFU is
+    never fabricated.  ``assume_tpu`` supplies the v5e default for TPU
+    device kinds the table doesn't spell (new libtpu spellings)."""
+    for k, v in PEAK_FLOPS.items():
+        if k in device_kind:
+            return v
+    if assume_tpu or "TPU" in device_kind:
+        return 197e12
+    return None
+
+
+def peak_flops_for_local_device() -> Optional[float]:
+    """Peak FLOPs of this host's first device (None on CPU)."""
+    try:
+        dev = jax.local_devices()[0]
+    except Exception:
+        return None
+    on_tpu = jax.default_backend() in ("tpu", "axon") \
+        or "TPU" in dev.device_kind
+    return peak_flops_for_kind(dev.device_kind, assume_tpu=on_tpu)
+
+
+class ThroughputCalculator:
+    """Tokens/sec(/device), achieved TFLOPs/device and MFU from wall time.
+
+    ``flops_per_token`` is the model-level fwd+bwd estimate
+    (``model.flops_per_token()``, models/language_model.py); ``peak_flops``
+    the per-chip bf16 peak (None => MFU is always null).  All host-side
+    float arithmetic — free at log boundaries."""
+
+    def __init__(self, flops_per_token: Optional[float] = None,
+                 device_count: Optional[int] = None,
+                 peak_flops: Optional[float] = None):
+        self.flops_per_token = flops_per_token
+        self._device_count = device_count
+        self.peak_flops = peak_flops
+
+    @classmethod
+    def from_model(cls, model, device_count: Optional[int] = None,
+                   peak_flops: Optional[float] = "auto"):
+        """Build from any model exposing ``flops_per_token()`` (models
+        without one still get tokens/sec accounting)."""
+        fpt = None
+        fn = getattr(model, "flops_per_token", None)
+        if callable(fn):
+            try:
+                fpt = float(fn())
+            except Exception:
+                fpt = None
+        if peak_flops == "auto":
+            peak_flops = peak_flops_for_local_device()
+        return cls(flops_per_token=fpt, device_count=device_count,
+                   peak_flops=peak_flops)
+
+    @property
+    def device_count(self) -> int:
+        if self._device_count is None:
+            self._device_count = jax.device_count()
+        return self._device_count
+
+    def compute(self, tokens: float, elapsed_secs: float) -> Dict[str, Any]:
+        """One log boundary's throughput record.  ``tokens`` is the global
+        token count per iteration, ``elapsed_secs`` the per-iteration wall
+        time.  MFU is null when the peak is unknown (CPU) or the number
+        trips the fabrication guard — never a made-up value."""
+        n = max(self.device_count, 1)
+        tps = tokens / max(elapsed_secs, 1e-9)
+        out: Dict[str, Any] = {
+            "tokens_per_sec": tps,
+            "tokens_per_sec_per_device": tps / n,
+            "tflops_per_device": None,
+            "mfu": None,
+        }
+        if self.flops_per_token:
+            achieved = tps * self.flops_per_token / n
+            out["tflops_per_device"] = achieved / 1e12
+            if self.peak_flops:
+                mfu = achieved / self.peak_flops
+                out["mfu"] = mfu if mfu <= MFU_SANITY_LIMIT else None
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+class FlightRecorder:
+    """Bounded deque of the last K step records (MegaScale §5.3: the
+    record consulted when a run dies).  Two record kinds: ``dispatch``
+    (per-iteration, host-only — never syncs the device) and ``log`` (the
+    full log-boundary record)."""
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = int(capacity)
+        self._records: deque = deque(maxlen=max(self.capacity, 1))
+
+    def record(self, rec: Dict[str, Any]) -> None:
+        self._records.append(rec)
+
+    def records(self) -> List[Dict[str, Any]]:
+        return list(self._records)
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def dump(self, path: str, reason: str = "") -> str:
+        """Write the recorder as JSON (atomic: tmp + rename — the caller
+        may be a watchdog thread racing process death)."""
+        payload = {
+            "dumped_at_unix": time.time(),
+            "reason": reason,
+            "capacity": self.capacity,
+            "records": self.records(),
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Device memory
+# ---------------------------------------------------------------------------
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """``memory_stats()`` of one local device, reduced to the portable
+    keys (bytes_in_use, peak_bytes_in_use, largest_alloc_size, num_allocs
+    — whichever the backend reports).  {} when unavailable (CPU backends
+    often return None)."""
+    try:
+        if device is None:
+            device = jax.local_devices()[0]
+        stats = device.memory_stats() or {}
+    except Exception:
+        return {}
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_alloc_size", "num_allocs")
+    return {k: int(stats[k]) for k in keep if k in stats}
+
+
+# ---------------------------------------------------------------------------
+# Structured JSONL stream
+# ---------------------------------------------------------------------------
+
+TELEMETRY_SCHEMA_VERSION = 1
+STREAM_FILENAME = "telemetry.jsonl"
+FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
+
+
+class TelemetryStream:
+    """One JSONL record per log boundary under ``log_dir`` (process 0
+    writes; every process keeps the flight recorder).  Tracks running
+    aggregates for the end-of-run summary (mean MFU etc. — percentiles
+    are the offline ``tools/telemetry_report.py``'s job)."""
+
+    def __init__(self, log_dir: str, flight_recorder_size: int = 64):
+        self.log_dir = log_dir
+        self.flight_recorder = FlightRecorder(flight_recorder_size)
+        self._file = None
+        self._sums = {"steps": 0, "mfu": 0.0, "mfu_n": 0,
+                      "tokens_per_sec_per_device": 0.0, "step_time": 0.0}
+        if jax.process_index() == 0:
+            os.makedirs(log_dir, exist_ok=True)
+            self._file = open(os.path.join(log_dir, STREAM_FILENAME),
+                              "a", buffering=1)
+
+    def emit(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        """Stamp, persist, and flight-record one log-boundary record."""
+        rec = {"schema": TELEMETRY_SCHEMA_VERSION, "kind": "log",
+               "time_unix": time.time(), **record}
+        if self._file is not None:
+            self._file.write(json.dumps(rec) + "\n")
+        self.flight_recorder.record(rec)
+        s = self._sums
+        s["steps"] += 1
+        s["step_time"] += float(rec.get("step_time_secs") or 0.0)
+        s["tokens_per_sec_per_device"] += float(
+            rec.get("tokens_per_sec_per_device") or 0.0)
+        if rec.get("mfu") is not None:
+            s["mfu"] += float(rec["mfu"])
+            s["mfu_n"] += 1
+        return rec
+
+    def record_dispatch(self, rec: Dict[str, Any]) -> None:
+        """Lightweight per-iteration entry — host-side fields only, never
+        a device sync, so it is safe (and cheap) every step."""
+        self.flight_recorder.record({"kind": "dispatch",
+                                     "time_unix": time.time(), **rec})
+
+    def summary(self) -> Dict[str, Any]:
+        s = self._sums
+        n = max(s["steps"], 1)
+        return {
+            "log_boundaries": s["steps"],
+            "mean_step_time_secs": s["step_time"] / n,
+            "mean_tokens_per_sec_per_device":
+                s["tokens_per_sec_per_device"] / n,
+            "mean_mfu": (s["mfu"] / s["mfu_n"]) if s["mfu_n"] else None,
+        }
+
+    def dump_flight_recorder(self, reason: str = "") -> Optional[str]:
+        if not len(self.flight_recorder):
+            return None
+        path = os.path.join(self.log_dir, FLIGHT_RECORDER_FILENAME)
+        return self.flight_recorder.dump(path, reason=reason)
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+# Active stream registry: the watchdog/crash path (resilience.py) and the
+# wandb finish() summary reach the run's telemetry without threading it
+# through every call chain — same pattern as resilience's save-fault hook.
+_ACTIVE_STREAM: Optional[TelemetryStream] = None
+
+
+def install_stream(stream: Optional[TelemetryStream]) -> None:
+    global _ACTIVE_STREAM
+    _ACTIVE_STREAM = stream
+
+
+def get_stream() -> Optional[TelemetryStream]:
+    return _ACTIVE_STREAM
+
+
+def get_flight_recorder() -> Optional[FlightRecorder]:
+    return _ACTIVE_STREAM.flight_recorder if _ACTIVE_STREAM else None
+
+
+def dump_flight_recorder(reason: str = "") -> Optional[str]:
+    """Dump the active run's flight recorder next to its JSONL stream
+    (no-op without an installed stream or records).  Diagnostics path —
+    never raises."""
+    try:
+        if _ACTIVE_STREAM is None:
+            return None
+        return _ACTIVE_STREAM.dump_flight_recorder(reason=reason)
+    except Exception:
+        return None
+
+
+def run_summary() -> Optional[Dict[str, Any]]:
+    """The active stream's aggregate summary (wandb finish() pulls this)."""
+    return _ACTIVE_STREAM.summary() if _ACTIVE_STREAM else None
+
+
+# ---------------------------------------------------------------------------
+# In-loop profiler capture
+# ---------------------------------------------------------------------------
+
+class ProfilerSession:
+    """Wraps a chosen iteration window ``[step_start, step_end]`` in
+    ``jax.profiler`` trace capture during real training.  The loop calls
+    ``maybe_start(upcoming_iteration)`` before dispatch and
+    ``maybe_stop(completed_iteration, sync=...)`` after; ``sync`` blocks
+    on the step's outputs so the traced window contains the device work,
+    not just its dispatch.  One-shot: the window fires once per run."""
+
+    def __init__(self, profile_dir: str, step_start: int, step_end: int,
+                 port: Optional[int] = None):
+        if step_end < step_start:
+            raise ValueError(
+                f"profile_step_end ({step_end}) < profile_step_start "
+                f"({step_start})")
+        self.profile_dir = profile_dir
+        self.step_start = int(step_start)
+        self.step_end = int(step_end)
+        self.active = False
+        self.done = False
+        self._server = None
+        if port:
+            # live-capture endpoint (TensorBoard "capture profile")
+            self._server = jax.profiler.start_server(int(port))
+
+    def maybe_start(self, upcoming_iteration: int) -> bool:
+        if self.done or self.active \
+                or upcoming_iteration != self.step_start:
+            return False
+        os.makedirs(self.profile_dir, exist_ok=True)
+        jax.profiler.start_trace(self.profile_dir)
+        self.active = True
+        print(f" [profiler] trace started at iteration "
+              f"{upcoming_iteration} -> {self.profile_dir}", flush=True)
+        return True
+
+    def maybe_stop(self, completed_iteration: int,
+                   sync: Optional[Callable[[], Any]] = None) -> bool:
+        if not self.active or completed_iteration < self.step_end:
+            return False
+        if sync is not None:
+            sync()      # device work of the window lands inside the trace
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
+        print(f" [profiler] trace stopped after iteration "
+              f"{completed_iteration} (view: tensorboard --logdir "
+              f"{self.profile_dir}, profile plugin / Perfetto)", flush=True)
+        return True
+
+    def close(self) -> None:
+        """Stop an in-flight trace on any exit path (a truncated window
+        still yields a usable xplane)."""
+        if self.active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self.active = False
+            self.done = True
+
+
+# ---------------------------------------------------------------------------
+# Bundle + CLI wiring
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Telemetry:
+    """Everything the train loop needs, in one optional argument."""
+
+    throughput: Optional[ThroughputCalculator] = None
+    stream: Optional[TelemetryStream] = None
+    profiler: Optional[ProfilerSession] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def default(cls, model) -> "Telemetry":
+        """Throughput-only telemetry (free): every run reports
+        tokens/sec/device + MFU at log boundaries even with no flags."""
+        return cls(throughput=ThroughputCalculator.from_model(model))
+
+    def close(self) -> None:
+        if self.profiler is not None:
+            self.profiler.close()
+        if self.stream is not None:
+            if get_stream() is self.stream:
+                install_stream(None)
+            self.stream.close()
+
+
+def recovery_counters() -> Dict[str, int]:
+    from megatron_llm_tpu.resilience import recovery_counters as rc
+
+    return rc()
+
+
+def build_telemetry(args, model) -> Telemetry:
+    """CLI wiring: a Telemetry bundle from parsed args.  Always returns a
+    bundle (throughput accounting is free); the stream/profiler members
+    exist only when their flags ask for them."""
+    t = Telemetry.default(model)
+    log_dir = getattr(args, "structured_log_dir", None)
+    if log_dir:
+        t.stream = TelemetryStream(
+            log_dir,
+            flight_recorder_size=getattr(args, "flight_recorder_size", 64))
+        install_stream(t.stream)
+    if getattr(args, "profile", False):
+        profile_dir = getattr(args, "profile_dir", None) \
+            or (os.path.join(log_dir, "profile") if log_dir
+                else "profile_trace")
+        t.profiler = ProfilerSession(
+            profile_dir,
+            step_start=getattr(args, "profile_step_start", 10),
+            step_end=getattr(args, "profile_step_end", 12),
+            port=getattr(args, "profiler_port", None),
+        )
+    elif getattr(args, "profiler_port", None):
+        # a live-capture server without a pre-chosen window
+        jax.profiler.start_server(int(args.profiler_port))
+    return t

@@ -1,12 +1,24 @@
-"""Hierarchical timers with log levels.
+"""Hierarchical timers with log levels and cross-host reduction.
 
 Reference: ``megatron/timers.py:123-303`` — a registry of named timers with
-per-timer log levels (0-2) and optional barrier-synchronized start/stop.
+per-timer log levels (0-2), optional barrier-synchronized start/stop, and a
+``--timing_log_option`` (``max``/``minmax``/``all``) controlling how
+per-rank times are reduced for logging (reference timers.py:190-260 uses a
+torch.distributed all_gather).
 
-TPU adaptation: device work is async under jit; a wall-clock timer only
-sees dispatch time unless we block.  ``Timer.stop(barrier=True)`` calls
-``jax.block_until_ready`` on a sentinel (or ``jax.effects_barrier``), the
-XLA analogue of the reference's ``torch.cuda.synchronize``-backed barrier.
+TPU adaptations:
+
+* Device work is async under jit; a wall-clock timer only sees dispatch
+  time unless we block.  ``Timer.stop(barrier=True)`` calls
+  ``jax.effects_barrier()``, the XLA analogue of the reference's
+  ``torch.cuda.synchronize``-backed barrier.
+* The cross-host reduction uses ``process_allgather``
+  (jax.experimental.multihost_utils) instead of torch.distributed.  Like
+  every host collective here it is only safe when all processes reach it
+  together — call ``log``/``write``/``report`` at deterministic log
+  boundaries only (same discipline as ``dist_signal_handler.py``).
+  Single-host runs skip the collective entirely and degenerate to the
+  plain per-host value.
 """
 
 from __future__ import annotations
@@ -15,6 +27,8 @@ import time
 from typing import Dict, List, Optional
 
 import jax
+
+_LOG_OPTIONS = ("max", "minmax", "all")
 
 
 class Timer:
@@ -83,6 +97,9 @@ class Timers:
     """Reference: timers.py:123-303."""
 
     def __init__(self, log_level: int = 0, log_option: str = "minmax"):
+        if log_option not in _LOG_OPTIONS:
+            raise ValueError(
+                f"log_option {log_option!r} not in {_LOG_OPTIONS}")
         self._log_level = log_level
         self._log_option = log_option
         self._timers: Dict[str, Timer] = {}
@@ -114,18 +131,101 @@ class Timers:
                 out[n] = self._timers[n].elapsed(reset=reset) / normalizer
         return out
 
+    # -- cross-host reduction -------------------------------------------
+
+    def _gather_across_hosts(
+            self, elapsed: Dict[str, float]) -> Dict[str, List[float]]:
+        """Per-name list of per-host elapsed values.
+
+        Multi-host this is a ``process_allgather`` — a collective, so only
+        call from code paths every process reaches together (log
+        boundaries).  Single-host returns one-element lists with no
+        collective at all."""
+        if not elapsed or jax.process_count() == 1:
+            return {n: [v] for n, v in elapsed.items()}
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        # identical timer registries on every host (same code path), but
+        # sort so the gathered columns line up regardless of insert order
+        names = sorted(elapsed)
+        local = np.asarray([elapsed[n] for n in names], dtype=np.float64)
+        gathered = multihost_utils.process_allgather(local)  # (hosts, k)
+        gathered = np.asarray(gathered).reshape(jax.process_count(),
+                                                len(names))
+        return {n: [float(x) for x in gathered[:, i]]
+                for i, n in enumerate(names)}
+
+    # -- formatting per --timing_log_option -----------------------------
+
+    def _header(self) -> str:
+        # every variant keeps the literal "time (ms)" so greppability (and
+        # downstream log parsers) survive the option switch
+        if self._log_option == "minmax":
+            return "(min, max) time (ms)"
+        if self._log_option == "max":
+            return "max time (ms)"
+        return "time (ms) across hosts"
+
+    def _format_entry(self, values: List[float]) -> str:
+        ms = [v * 1000.0 for v in values]
+        if len(ms) == 1:
+            # single host: every option degenerates to the plain value
+            return f"{ms[0]:.2f}"
+        if self._log_option == "minmax":
+            return f"({min(ms):.2f}, {max(ms):.2f})"
+        if self._log_option == "max":
+            return f"{max(ms):.2f}"
+        return "[" + ", ".join(f"{m:.2f}" for m in ms) + "]"
+
+    def _format_line(self, gathered: Dict[str, List[float]]) -> str:
+        string = self._header()
+        for n, values in gathered.items():
+            string += f" | {n}: {self._format_entry(values)}"
+        return string
+
+    def _write_gathered(self, gathered: Dict[str, List[float]],
+                        writer, iteration: int):
+        for n, values in gathered.items():
+            if len(values) == 1:
+                writer.add_scalar(f"{n}-time", values[0], iteration)
+            elif self._log_option == "minmax":
+                writer.add_scalar(f"{n}-time-min", min(values), iteration)
+                writer.add_scalar(f"{n}-time-max", max(values), iteration)
+            elif self._log_option == "max":
+                writer.add_scalar(f"{n}-time-max", max(values), iteration)
+            else:
+                for r, v in enumerate(values):
+                    writer.add_scalar(f"{n}-time/host{r}", v, iteration)
+
+    # -- public reporting -----------------------------------------------
+
     def log(self, names=None, normalizer=1.0, reset=True, printer=print):
         elapsed = self.get_elapsed(names, reset=reset, normalizer=normalizer)
         if not elapsed:
             return
-        string = "time (ms)"
-        for n, e in elapsed.items():
-            string += f" | {n}: {e * 1000.0:.2f}"
-        printer(string)
+        printer(self._format_line(self._gather_across_hosts(elapsed)))
 
     def write(self, names, writer, iteration, normalizer=1.0, reset=False):
         """Write timer values to a tensorboard-like writer
         (reference: timers.py:264-303)."""
         elapsed = self.get_elapsed(names, reset=reset, normalizer=normalizer)
-        for n, e in elapsed.items():
-            writer.add_scalar(f"{n}-time", e, iteration)
+        self._write_gathered(self._gather_across_hosts(elapsed),
+                             writer, iteration)
+
+    def report(self, writer=None, iteration: int = 0, normalizer: float = 1.0,
+               names=None, printer=print):
+        """Write + log from ONE elapsed snapshot, then reset.
+
+        ``write()``-then-``log()`` is order-fragile: ``log(reset=True)``
+        zeroes the accumulators, so a caller that logs first writes zeros
+        (and writing first then logging reads each timer twice).  One
+        snapshot feeds both sinks; the cross-host gather also happens once
+        instead of twice."""
+        elapsed = self.get_elapsed(names, reset=True, normalizer=normalizer)
+        if not elapsed:
+            return
+        gathered = self._gather_across_hosts(elapsed)
+        if writer is not None:
+            self._write_gathered(gathered, writer, iteration)
+        printer(self._format_line(gathered))

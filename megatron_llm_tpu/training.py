@@ -175,14 +175,30 @@ def training_log(
     lr: float,
     writer=None,
     printer=print,
+    throughput: Optional[Dict] = None,
 ):
     """One console/TB log line (reference: training.py:462-641,
-    tokens/sec at :591-609)."""
+    tokens/sec at :591-609).
+
+    ``throughput`` is a ``telemetry.ThroughputCalculator.compute()``
+    record; when present the line carries tokens/sec/device, achieved
+    TFLOPs/device and MFU (null MFU fields — unknown peak, or the
+    fabrication guard — are simply omitted, never printed as numbers)."""
     tps = tokens_per_iter / max(elapsed_per_iter, 1e-9)
     line = (
         f" iteration {iteration:8d}/{train_iters:8d} |"
         f" elapsed time per iteration (ms): {elapsed_per_iter * 1000.0:.1f} |"
         f" tokens per second: {tps:.1f} |"
+    )
+    if throughput is not None:
+        line += (f" tokens per second per device:"
+                 f" {throughput['tokens_per_sec_per_device']:.1f} |")
+        if throughput.get("tflops_per_device") is not None:
+            line += (f" TFLOPs per device:"
+                     f" {throughput['tflops_per_device']:.1f} |")
+        if throughput.get("mfu") is not None:
+            line += f" MFU: {throughput['mfu'] * 100.0:.1f}% |"
+    line += (
         f" learning rate: {lr:.3E} |"
         f" lm loss: {float(metrics.get('lm loss', 0.0)):.6E} |"
         f" loss scale: {float(metrics.get('loss_scale', 1.0)):.1f} |"
@@ -203,6 +219,15 @@ def training_log(
             writer.add_scalar(k, float(v), iteration)
         writer.add_scalar("tokens_per_sec", tps, iteration)
         writer.add_scalar("learning_rate", lr, iteration)
+        if throughput is not None:
+            writer.add_scalar("tokens_per_sec_per_device",
+                              throughput["tokens_per_sec_per_device"],
+                              iteration)
+            if throughput.get("tflops_per_device") is not None:
+                writer.add_scalar("tflops_per_device",
+                                  throughput["tflops_per_device"], iteration)
+            if throughput.get("mfu") is not None:
+                writer.add_scalar("mfu", throughput["mfu"], iteration)
     return tps
 
 
@@ -242,6 +267,7 @@ def pretrain(
     log_world_size: bool = False,
     log_validation_ppl: bool = False,
     resilience=None,
+    telemetry=None,
 ):
     """Minimal-dependency pretrain loop (the full CLI driver lives in
     ``finetune.py`` / ``pretrain_gpt.py`` at the repo root).
@@ -277,12 +303,25 @@ def pretrain(
     rolling host snapshots, NaN/spike detection at check boundaries with
     rewind, and the hang watchdog around dispatch/sync.  All of it is
     host-side — the jitted step is untouched.
+
+    ``telemetry`` (a ``telemetry.Telemetry``) carries the observability
+    runtime: throughput/MFU accounting at log boundaries, the structured
+    JSONL stream + flight recorder, and in-loop profiler capture.  When
+    None, a default throughput-only bundle is built from the model so
+    tokens/sec/device + MFU appear in every run's log lines for free.
+    Like resilience, everything is host-side and (for the stream/flight
+    recorder) off the device-sync path except at log boundaries.
     """
     from megatron_llm_tpu import checkpointing
+    from megatron_llm_tpu.telemetry import Telemetry
     from megatron_llm_tpu.timers import Timers
 
     if timers is None:
         timers = Timers(log_level=2)
+    if telemetry is None:
+        telemetry = Telemetry.default(model)
+    stream = telemetry.stream
+    profiler = telemetry.profiler
     skip_iters = frozenset(skip_iters or ())
 
     num_micro = max(
@@ -384,6 +423,8 @@ def pretrain(
                                          scheduler)
             if injector is not None:
                 injector.before_iteration(iteration + 1)
+            if profiler is not None:
+                profiler.maybe_start(iteration + 1)
             timers("batch-generator", log_level=1).start()
             batch = next(batch_iterator)
             timers("batch-generator").stop()
@@ -422,12 +463,26 @@ def pretrain(
             if watchdog is not None:
                 watchdog.resume()   # (re)arms; first arm is post-compile
             iteration += 1
+            if profiler is not None:
+                # sync so the traced window contains the device work of
+                # its last step, not just that step's dispatch
+                profiler.maybe_stop(
+                    iteration,
+                    sync=lambda: jax.block_until_ready(metrics["lm loss"]))
             tokens = batch["tokens"].size
             counters["tokens"] += tokens
             # one sample == one sequence: every leading axis but seq
             # (reference tracks consumed_train_samples, training.py:700;
             # this feeds the checkpoint's consumed_samples field)
             counters["samples"] += tokens // batch["tokens"].shape[-1]
+            if stream is not None:
+                # host-side fields only — the per-iteration flight-recorder
+                # entry must never force a device sync
+                stream.record_dispatch({
+                    "iteration": iteration,
+                    "lr": float(lr),
+                    "tokens": int(tokens),
+                })
 
             at_log_boundary = bool(log_interval
                                    and iteration % log_interval == 0)
@@ -481,21 +536,52 @@ def pretrain(
                         use_writer.add_scalar(
                             "mem-bytes-in-use",
                             stats.get("bytes_in_use", 0), iteration)
+                        # reference training.py:580-589 also reports the
+                        # high-water mark and allocation count (backends
+                        # that don't track them just omit the scalars)
+                        if "peak_bytes_in_use" in stats:
+                            use_writer.add_scalar(
+                                "mem-peak-bytes-in-use",
+                                stats["peak_bytes_in_use"], iteration)
+                        if "num_allocs" in stats:
+                            use_writer.add_scalar(
+                                "mem-num-allocs",
+                                stats["num_allocs"], iteration)
                 log_metrics = {k: float(v) for k, v in metrics.items()}
                 if resilience is not None:
                     from megatron_llm_tpu.resilience import recovery_counters
                     log_metrics.update(recovery_counters())
+                throughput = (telemetry.throughput.compute(tokens, elapsed)
+                              if telemetry.throughput is not None else None)
                 training_log(
                     iteration, train_cfg.train_iters,
                     log_metrics,
                     elapsed, tokens, lr,
                     writer=use_writer,
+                    throughput=throughput,
                 )
-                if use_writer is not None:
-                    # write() before log(): log() resets the accumulators
-                    timers.write(timers.names(), use_writer, iteration,
-                                 normalizer=log_interval)
-                timers.log(normalizer=log_interval)
+                if stream is not None:
+                    from megatron_llm_tpu.resilience import recovery_counters
+                    from megatron_llm_tpu.telemetry import device_memory_stats
+                    stream.emit({
+                        "iteration": iteration,
+                        "train_iters": train_cfg.train_iters,
+                        "lm_loss": log_metrics.get("lm loss"),
+                        "grad_norm": log_metrics.get("grad_norm"),
+                        "loss_scale": log_metrics.get("loss_scale"),
+                        "skipped_iter": int(log_metrics.get("skipped_iter",
+                                                            0)),
+                        "learning_rate": float(lr),
+                        "step_time_secs": elapsed,
+                        "tokens_per_iter": int(tokens),
+                        **(throughput or {}),
+                        "memory": device_memory_stats(),
+                        "recovery": recovery_counters(),
+                    })
+                # one snapshot feeds writer + console; the old
+                # write()-then-log() pair double-read (and could
+                # double-reset) every timer
+                timers.report(use_writer, iteration, normalizer=log_interval)
                 if use_writer is not None and hasattr(use_writer, "flush"):
                     use_writer.flush()
                 if on_metrics is not None:
@@ -566,5 +652,9 @@ def pretrain(
         # saves so a durable checkpoint always gets its tracker
         if watchdog is not None:
             watchdog.stop()
+        if profiler is not None:
+            # a window truncated by exit/exception still yields a usable
+            # xplane (close() is a no-op when no trace is active)
+            profiler.close()
         checkpointing.finalize_async_saves()
     return params, opt_state, iteration

@@ -75,14 +75,29 @@ class WandbTBShim:
             summary = recovery_counters()
         except Exception:
             summary = None
+        # run-level telemetry aggregates (mean MFU, tokens/sec/device,
+        # step time) when a --structured_log_dir stream is active
+        try:
+            from megatron_llm_tpu.telemetry import run_summary
+
+            t_summary = run_summary()
+        except Exception:
+            t_summary = None
         self.flush()
         if self._wandb is not None:
             if summary:
                 for k, v in summary.items():
                     self._run.summary[f"recovery/{k}"] = v
+            if t_summary:
+                for k, v in t_summary.items():
+                    if v is not None:
+                        self._run.summary[f"telemetry/{k}"] = v
             self._run.finish()
         elif self._file is not None:
             if summary is not None:
                 self._file.write(json.dumps(
                     {"event": "recovery_summary", **summary}) + "\n")
+            if t_summary is not None:
+                self._file.write(json.dumps(
+                    {"event": "telemetry_summary", **t_summary}) + "\n")
             self._file.close()

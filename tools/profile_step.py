@@ -13,6 +13,12 @@ command:
 
 Prints the trace directory and the per-step wall times; the trace
 contains host + device planes (device plane only on real TPU).
+
+To profile a *real* training run (warm caches, real data, the actual
+step cadence) instead of this synthetic one-shot, use the in-loop
+capture window: ``--profile --profile_step_start N --profile_step_end M
+--profile_dir D`` on finetune.py / pretrain_gpt.py
+(megatron_llm_tpu/telemetry.py, docs/guide/observability.md).
 """
 
 import argparse

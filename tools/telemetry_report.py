@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Summarize a run's structured telemetry stream (telemetry.jsonl).
+
+Reads the JSONL written by ``--structured_log_dir`` (one record per log
+boundary, megatron_llm_tpu/telemetry.py) and prints:
+
+* a per-step table — iteration, loss, grad norm, step time,
+  tokens/sec/device, MFU, memory in use
+* aggregates — p50/p95 step time, mean/max MFU, mean tokens/sec/device
+* a recovery-event timeline — the log boundaries where any recovery
+  counter (rewinds, save_retries, watchdog_fires, signal_saves)
+  advanced, and by how much
+
+Pure stdlib + JSONL parsing — no jax import, so it runs anywhere the log
+file does (laptop, login node) and costs nothing to start.
+
+Usage:
+    python tools/telemetry_report.py RUN_DIR_OR_JSONL [--json]
+
+``--json`` emits the aggregates as one machine-readable JSON object
+(for CI trend tracking) instead of the human tables.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def load_records(path: str) -> List[Dict]:
+    """Accept a telemetry.jsonl file or the --structured_log_dir holding
+    one.  Unparseable lines are counted and skipped (a crash can truncate
+    the final line), never fatal."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.jsonl")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no telemetry stream at {path}")
+    records, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if rec.get("kind", "log") == "log":
+                records.append(rec)
+    if bad:
+        print(f"(skipped {bad} unparseable line{'s' if bad > 1 else ''})",
+              file=sys.stderr)
+    return records
+
+
+def percentile(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 100]); None on empty input."""
+    if not values:
+        return None
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+def _fmt(v, spec: str = ".3g", none: str = "-") -> str:
+    return none if v is None else format(v, spec)
+
+
+def per_step_table(records: List[Dict]) -> str:
+    header = (f"{'iter':>8} {'lm loss':>11} {'grad norm':>10} "
+              f"{'step ms':>9} {'tok/s/dev':>10} {'MFU':>6} "
+              f"{'mem MiB':>8}")
+    lines = [header, "-" * len(header)]
+    for r in records:
+        st = r.get("step_time_secs")
+        mem = (r.get("memory") or {}).get("bytes_in_use")
+        mfu = r.get("mfu")
+        lines.append(
+            f"{r.get('iteration', '?'):>8} "
+            f"{_fmt(r.get('lm_loss'), '.5e'):>11} "
+            f"{_fmt(r.get('grad_norm'), '.3f'):>10} "
+            f"{_fmt(st * 1000.0 if st is not None else None, '.1f'):>9} "
+            f"{_fmt(r.get('tokens_per_sec_per_device'), '.1f'):>10} "
+            f"{_fmt(mfu * 100.0 if mfu is not None else None, '.1f'):>6} "
+            f"{_fmt(mem / 2**20 if mem is not None else None, '.1f'):>8}")
+    return "\n".join(lines)
+
+
+def aggregates(records: List[Dict]) -> Dict:
+    step_times = [r["step_time_secs"] for r in records
+                  if r.get("step_time_secs") is not None]
+    mfus = [r["mfu"] for r in records if r.get("mfu") is not None]
+    tpsd = [r["tokens_per_sec_per_device"] for r in records
+            if r.get("tokens_per_sec_per_device") is not None]
+    return {
+        "log_boundaries": len(records),
+        "p50_step_time_secs": percentile(step_times, 50),
+        "p95_step_time_secs": percentile(step_times, 95),
+        "mean_mfu": sum(mfus) / len(mfus) if mfus else None,
+        "max_mfu": max(mfus) if mfus else None,
+        "mean_tokens_per_sec_per_device":
+            sum(tpsd) / len(tpsd) if tpsd else None,
+    }
+
+
+def recovery_timeline(records: List[Dict]) -> List[Dict]:
+    """Log boundaries where any recovery counter advanced, with deltas."""
+    events = []
+    prev: Dict[str, int] = {}
+    for r in records:
+        counters = r.get("recovery") or {}
+        deltas = {k: v - prev.get(k, 0)
+                  for k, v in counters.items() if v - prev.get(k, 0) > 0}
+        if deltas:
+            events.append({"iteration": r.get("iteration"), **deltas})
+        prev = counters or prev
+    return events
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a telemetry.jsonl stream")
+    ap.add_argument("path",
+                    help="telemetry.jsonl or the --structured_log_dir")
+    ap.add_argument("--json", action="store_true",
+                    help="emit aggregates + recovery timeline as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        records = load_records(args.path)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if not records:
+        print("no log records in stream", file=sys.stderr)
+        return 2
+
+    agg = aggregates(records)
+    timeline = recovery_timeline(records)
+
+    if args.json:
+        print(json.dumps({"aggregates": agg,
+                          "recovery_timeline": timeline}, indent=1))
+        return 0
+
+    print(per_step_table(records))
+    print()
+    p50, p95 = agg["p50_step_time_secs"], agg["p95_step_time_secs"]
+    print(f"log boundaries: {agg['log_boundaries']}")
+    print(f"step time p50: {_fmt(p50 * 1000.0 if p50 else None, '.1f')} ms"
+          f" | p95: {_fmt(p95 * 1000.0 if p95 else None, '.1f')} ms")
+    print(f"mean MFU: {_fmt(agg['mean_mfu'], '.4f')}"
+          f" | max MFU: {_fmt(agg['max_mfu'], '.4f')}")
+    print(f"mean tokens/sec/device: "
+          f"{_fmt(agg['mean_tokens_per_sec_per_device'], '.1f')}")
+    if timeline:
+        print("\nrecovery events:")
+        for ev in timeline:
+            deltas = ", ".join(f"{k}+{v}" for k, v in ev.items()
+                               if k != "iteration")
+            print(f"  iteration {ev['iteration']}: {deltas}")
+    else:
+        print("\nno recovery events")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
