@@ -88,6 +88,20 @@ def child_main():
     from megatron_llm_tpu.timers import Timers
     timers = Timers(log_level=2)
 
+    # span tracing + goodput + recompile accounting (tracing.py): the
+    # bench classifies its own wall-clock (compile/warmup vs measured
+    # steps) and reports goodput_pct / recompiles / straggler_events in
+    # the BENCH artifact — a recompile during the measured loop is a
+    # perf bug the artifact must confess to
+    from megatron_llm_tpu import tracing as trace_mod
+    tracer = trace_mod.SpanTracer(capacity=20000)
+    detector = trace_mod.RecompileDetector(tracer=tracer)
+    bundle = trace_mod.Tracing(
+        tracer=tracer, recompile=detector,
+        straggler=trace_mod.StragglerDetector(
+            tracer=tracer, printer=lambda s: log(f"child: {s}")))
+    trace_mod.install_tracing(bundle)
+
     kernels = {}
     if simulate:
         # pallas can't run on the CPU backend; pretend the smoke passed
@@ -255,26 +269,31 @@ def child_main():
         round trip and cannot lie about completion.  One shared helper so
         the sync protocol cannot drift between measurements."""
         tc0 = time.time()
+        detector.pause()        # warmup compiles are expected, not recompiles
         timers(f"{label}-compile-warmup", log_level=1).start()
-        for _ in range(2):
-            params, opt_state, m = step(params, opt_state, batch, key,
-                                        1e-4, 0.0)
-            float(m["lm loss"])
+        with tracer.span(f"{label}_warmup", "compile"):
+            for _ in range(2):
+                params, opt_state, m = step(params, opt_state, batch, key,
+                                            1e-4, 0.0)
+                float(m["lm loss"])
         timers(f"{label}-compile-warmup").stop()
+        detector.resume()
+        detector.mark_steady()  # any compile in the measured loop is a bug
         log(f"child: {label}: compile+warmup done in "
             f"{time.time() - tc0:.1f}s")
         iters = 0
         timers(f"{label}-measure", log_level=1).start()
         t0 = time.perf_counter()
-        while iters < max_iters:
-            params, opt_state, m = step(params, opt_state, batch, key,
-                                        1e-4, 0.0)
-            iters += 1
-            if iters % 5 == 0 or iters == max_iters:
-                float(m["lm loss"])      # true sync (see docstring)
-                if time.perf_counter() - t0 > budget_s:
-                    break
-        loss = float(m["lm loss"])
+        with tracer.span(f"{label}_measure", "step"):
+            while iters < max_iters:
+                params, opt_state, m = step(params, opt_state, batch, key,
+                                            1e-4, 0.0)
+                iters += 1
+                if iters % 5 == 0 or iters == max_iters:
+                    float(m["lm loss"])      # true sync (see docstring)
+                    if time.perf_counter() - t0 > budget_s:
+                        break
+            loss = float(m["lm loss"])
         timers(f"{label}-measure").stop()
         dt = (time.perf_counter() - t0) / iters
         log(f"child: {label}: timed {iters} iters, {dt*1000:.1f} ms/iter")
@@ -335,6 +354,15 @@ def child_main():
         rec["recovery"] = recovery_counters()
     except Exception:
         rec["recovery"] = None
+    # goodput attribution (tracing.py): measured-step share of the
+    # child's wall-clock, plus steady-state recompile count (anything
+    # nonzero means the measured loop retraced — the number above it is
+    # polluted) and straggler events (always 0 single-host)
+    g = tracer.goodput.summary()
+    rec["goodput_pct"] = round(g["goodput_pct"], 2)
+    rec["compile_secs"] = round(g["compile_secs"], 2)
+    rec["recompiles"] = int(detector.recompiles)
+    rec["straggler_events"] = int(bundle.straggler.total)
     # emit the PRIMARY result immediately — if the optional secondary
     # below hangs into the parent deadline, this artifact is already on
     # stdout (the parent takes the last JSON line it finds)

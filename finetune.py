@@ -312,6 +312,11 @@ def main():
 
     mesh = topology.get_mesh()
     model = model_provider(args)
+    # built before the checkpoint load so the startup restore lands in
+    # the trace (--trace_dir opens a checkpoint_load span)
+    from megatron_llm_tpu.telemetry import build_telemetry
+
+    telemetry = build_telemetry(args, model)
     tc = train_config_from_args(args)
     pc = parallel_config_from_args(args)
     num_micro = args.global_batch_size // (
@@ -534,11 +539,8 @@ def main():
                   for _ in range(args.eval_iters)]
         print(f" eval_only: validation loss "
               f"{sum(losses) / len(losses):.6E}")
+        telemetry.close()
         return
-
-    from megatron_llm_tpu.telemetry import build_telemetry
-
-    telemetry = build_telemetry(args, model)
 
     try:
         params, opt_state, it = pretrain(

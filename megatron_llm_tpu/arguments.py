@@ -376,6 +376,21 @@ def _add_telemetry_args(parser):
     g.add_argument("--profiler_port", type=int, default=None,
                    help="start jax.profiler.start_server on this port "
                         "for live TensorBoard capture")
+    # span tracing + goodput + straggler/recompile diagnostics
+    # (tracing.py; MegaScale §5's attribution layer)
+    g.add_argument("--trace_dir", type=str, default=None,
+                   help="enable span tracing: write a Chrome trace_event "
+                        "trace.json here (load in ui.perfetto.dev), turn "
+                        "on goodput accounting (goodput_pct in the JSONL "
+                        "stream + finish summary) and recompile/straggler "
+                        "detection; summarize with tools/trace_report.py")
+    g.add_argument("--trace_buffer_size", type=int, default=100000,
+                   help="span ring-buffer capacity; eviction drops the "
+                        "oldest events (count reported as dropped_events)")
+    g.add_argument("--straggler_threshold", type=float, default=1.5,
+                   help="flag a host as a straggler when its per-section "
+                        "time exceeds this multiple of the cross-host "
+                        "median at a log boundary")
 
 
 def _add_inference_args(parser):

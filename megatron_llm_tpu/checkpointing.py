@@ -40,6 +40,7 @@ from typing import Any, Optional, Tuple
 import jax
 import numpy as np
 
+from megatron_llm_tpu import tracing
 from megatron_llm_tpu.global_vars import get_counters
 
 CHECKPOINT_VERSION = 4.0  # reference latest is 3.0; 4.0 marks the TPU layout
@@ -264,14 +265,16 @@ def finalize_async_saves() -> None:
     path (incl. exceptions) flushes."""
     if not (_ASYNC["inflight"] or _ASYNC["slot"]):
         return
-    for key in ("model", "optim"):
-        if _ASYNC[key] is not None:
-            _ASYNC[key].wait_until_finished()
-    _ASYNC["inflight"] = False
-    if _ASYNC["slot"] is not None:
-        save_dir, iteration, release, tmp_dir, final_dir = _ASYNC["slot"]
-        _ASYNC["slot"] = None
-        _commit_checkpoint(save_dir, iteration, release, tmp_dir, final_dir)
+    with tracing.span("checkpoint_finalize", "checkpoint"):
+        for key in ("model", "optim"):
+            if _ASYNC[key] is not None:
+                _ASYNC[key].wait_until_finished()
+        _ASYNC["inflight"] = False
+        if _ASYNC["slot"] is not None:
+            save_dir, iteration, release, tmp_dir, final_dir = _ASYNC["slot"]
+            _ASYNC["slot"] = None
+            _commit_checkpoint(save_dir, iteration, release, tmp_dir,
+                               final_dir)
 
 
 def save_checkpoint(
@@ -335,13 +338,16 @@ def save_checkpoint(
                 _ASYNC["inflight"] = True
             else:
                 m_ckptr = o_ckptr = ocp.PyTreeCheckpointer()
-            m_ckptr.save(tmp_dir / "model", params, force=True)
-            if opt_tree is not None:
-                # drop None subtrees (sgd has no exp_avg_sq etc.)
-                o_ckptr.save(tmp_dir / "optim", opt_tree, force=True)
-            if jax.process_index() == 0:
-                with open(tmp_dir / "meta.json", "w") as f:
-                    json.dump(meta, f, indent=1)
+            with tracing.span("checkpoint_write", "checkpoint",
+                              iteration=int(iteration), attempt=attempt,
+                              async_save=async_save):
+                m_ckptr.save(tmp_dir / "model", params, force=True)
+                if opt_tree is not None:
+                    # drop None subtrees (sgd has no exp_avg_sq etc.)
+                    o_ckptr.save(tmp_dir / "optim", opt_tree, force=True)
+                if jax.process_index() == 0:
+                    with open(tmp_dir / "meta.json", "w") as f:
+                        json.dump(meta, f, indent=1)
             break
         except (IOError, OSError) as e:
             if async_save:
@@ -513,28 +519,31 @@ def load_checkpoint(
         return jax.tree_util.tree_map(
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree)
 
-    if not load_params:
-        # optimizer/scheduler-only restore (second phase of a CLI resume,
-        # once the optimizer exists to provide a template)
-        params = None
-    elif params_template is not None:
-        params = ckptr.restore(
-            ckpt_dir / "model",
-            restore_args=_restore_args_for(params_template))
-    else:
-        params = ckptr.restore(
-            ckpt_dir / "model",
-            restore_args=_host_restore_args(ckpt_dir / "model"))
-    if params is not None:
-        _verify_leaves(params, manifest.get("model"), "model")
+    with tracing.span("checkpoint_load", "checkpoint",
+                      iteration=int(iteration or 0)):
+        if not load_params:
+            # optimizer/scheduler-only restore (second phase of a CLI
+            # resume, once the optimizer exists to provide a template)
+            params = None
+        elif params_template is not None:
+            params = ckptr.restore(
+                ckpt_dir / "model",
+                restore_args=_restore_args_for(params_template))
+        else:
+            params = ckptr.restore(
+                ckpt_dir / "model",
+                restore_args=_host_restore_args(ckpt_dir / "model"))
+        if params is not None:
+            _verify_leaves(params, manifest.get("model"), "model")
 
-    opt_state = None
-    if not finetune and (ckpt_dir / "optim").exists() and opt_state_template is not None:
-        tmpl_tree = _opt_state_to_tree(opt_state_template)
-        tree = ckptr.restore(ckpt_dir / "optim",
-                             restore_args=_restore_args_for(tmpl_tree))
-        _verify_leaves(tree, manifest.get("optim"), "optim")
-        opt_state = _tree_to_opt_state(tree, opt_state_template)
+        opt_state = None
+        if not finetune and (ckpt_dir / "optim").exists() \
+                and opt_state_template is not None:
+            tmpl_tree = _opt_state_to_tree(opt_state_template)
+            tree = ckptr.restore(ckpt_dir / "optim",
+                                 restore_args=_restore_args_for(tmpl_tree))
+            _verify_leaves(tree, manifest.get("optim"), "optim")
+            opt_state = _tree_to_opt_state(tree, opt_state_template)
 
     if finetune:
         meta["iteration"] = 0

@@ -228,6 +228,17 @@ def dump_stacks_and_memory(printer: Callable[[str], None] = print) -> str:
                 lines.append(json.dumps(rec))
     except Exception as e:
         lines.append(f"(flight recorder unavailable: {e})")
+    # span trace (tracing.py): the Perfetto-loadable timeline of what ran
+    # when — written beside the JSONL stream so the post-mortem has the
+    # wall-clock story, not just the last K records
+    try:
+        from megatron_llm_tpu import tracing
+
+        tpath = tracing.dump_trace(reason="stack dump")
+        if tpath:
+            lines.append(f"==== watchdog: span trace dumped to {tpath} ====")
+    except Exception as e:
+        lines.append(f"(span trace unavailable: {e})")
     dump = "\n".join(lines)
     printer(dump)
     return dump
@@ -303,6 +314,14 @@ class HangWatchdog:
     def _fire(self, stalled: float) -> None:
         self.fired = True
         get_counters()["watchdog_fires"] += 1
+        try:
+            from megatron_llm_tpu import tracing
+
+            tracing.instant("watchdog_fire", "watchdog",
+                            stalled_secs=float(stalled),
+                            timeout_secs=self.timeout_secs)
+        except Exception:
+            pass
         self.printer(
             f" [watchdog] no iteration completed in {stalled:.1f}s "
             f"(timeout {self.timeout_secs:.1f}s) — dumping diagnostics")
@@ -449,6 +468,16 @@ class ResilienceManager:
         live trees, so sharding survives) and return
         ``(params, opt_state, iteration)``.  LR shrinks by
         ``rewind_lr_factor`` (applied by the driver via ``lr_scale``)."""
+        from megatron_llm_tpu import tracing
+
+        with tracing.span("rewind", "rewind",
+                          target_iteration=(self._snapshot.iteration
+                                            if self._snapshot else -1)):
+            return self._rewind_impl(live_params, live_opt_state, scheduler,
+                                     batch_iterator)
+
+    def _rewind_impl(self, live_params, live_opt_state, scheduler,
+                     batch_iterator):
         import jax
 
         assert self._snapshot is not None
@@ -507,13 +536,16 @@ class ResilienceManager:
         if self._snapshot is None:
             print(" [resilience] no snapshot to rescue-save", flush=True)
             return None
-        from megatron_llm_tpu import checkpointing
+        from megatron_llm_tpu import checkpointing, tracing
 
         snap = self._snapshot
-        path = checkpointing.save_checkpoint(
-            save_dir, snap.iteration, snap.params, snap.opt_state,
-            args=save_args, consumed_samples=get_counters().get("samples", 0),
-        )
+        with tracing.span("rescue_save", "checkpoint",
+                          iteration=snap.iteration):
+            path = checkpointing.save_checkpoint(
+                save_dir, snap.iteration, snap.params, snap.opt_state,
+                args=save_args,
+                consumed_samples=get_counters().get("samples", 0),
+            )
         print(f" [resilience] rescue checkpoint written: {path}", flush=True)
         return path
 

@@ -229,7 +229,7 @@ def device_memory_stats(device=None) -> Dict[str, int]:
 # Structured JSONL stream
 # ---------------------------------------------------------------------------
 
-TELEMETRY_SCHEMA_VERSION = 1
+TELEMETRY_SCHEMA_VERSION = 2  # 2: + interval_time_secs / goodput / tracing
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 
@@ -329,8 +329,21 @@ def dump_flight_recorder(reason: str = "") -> Optional[str]:
 
 
 def run_summary() -> Optional[Dict[str, Any]]:
-    """The active stream's aggregate summary (wandb finish() pulls this)."""
-    return _ACTIVE_STREAM.summary() if _ACTIVE_STREAM else None
+    """The active stream's aggregate summary (wandb finish() pulls this),
+    merged with the active tracer's goodput breakdown + recompile /
+    straggler counts when tracing is on."""
+    out = _ACTIVE_STREAM.summary() if _ACTIVE_STREAM else None
+    from megatron_llm_tpu import tracing
+
+    g = tracing.goodput_summary()
+    if g is not None:
+        out = dict(out or {})
+        out["goodput_pct"] = g["goodput_pct"]
+        out["goodput"] = g
+        out["recompiles"] = int(get_counters().get("recompiles", 0))
+        out["straggler_events"] = int(
+            get_counters().get("straggler_events", 0))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -409,6 +422,7 @@ class Telemetry:
     throughput: Optional[ThroughputCalculator] = None
     stream: Optional[TelemetryStream] = None
     profiler: Optional[ProfilerSession] = None
+    tracing: Optional[Any] = None       # a tracing.Tracing bundle
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -420,6 +434,9 @@ class Telemetry:
     def close(self) -> None:
         if self.profiler is not None:
             self.profiler.close()
+        if self.tracing is not None:
+            # writes the trace file, then uninstalls the module registry
+            self.tracing.close()
         if self.stream is not None:
             if get_stream() is self.stream:
                 install_stream(None)
@@ -456,4 +473,7 @@ def build_telemetry(args, model) -> Telemetry:
     elif getattr(args, "profiler_port", None):
         # a live-capture server without a pre-chosen window
         jax.profiler.start_server(int(args.profiler_port))
+    from megatron_llm_tpu import tracing as _tracing
+
+    t.tracing = _tracing.build_tracing(args)    # None without --trace_dir
     return t

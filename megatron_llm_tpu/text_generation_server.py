@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from megatron_llm_tpu.text_generation.api import (
@@ -24,6 +25,61 @@ from megatron_llm_tpu.text_generation.api import (
 
 MAX_PROMPTS = 128
 MAX_TOKENS = 1024
+
+
+class ServerMetrics:
+    """Serving-path observability (stdlib-only): request/error counts,
+    p50/p95 request latency over a bounded window, total tokens
+    generated.  Served by ``GET /metrics``; ``GET /health`` is the
+    liveness probe.  Thread-safe — the handler runs per-connection
+    threads under ``ThreadingHTTPServer``."""
+
+    def __init__(self, window: int = 512):
+        self._lock = threading.Lock()
+        self._window = max(int(window), 1)
+        self._latencies = []        # bounded: last `window` request secs
+        self.started_unix = time.time()
+        self.requests = 0
+        self.errors = 0
+        self.tokens_generated = 0
+
+    def observe(self, secs: float, status: int, tokens: int = 0) -> None:
+        with self._lock:
+            self.requests += 1
+            if status >= 400:
+                self.errors += 1
+            self.tokens_generated += max(int(tokens), 0)
+            self._latencies.append(float(secs))
+            if len(self._latencies) > self._window:
+                del self._latencies[:len(self._latencies) - self._window]
+
+    @staticmethod
+    def _percentile(values, q: float) -> float:
+        s = sorted(values)
+        return s[min(int(q * (len(s) - 1) + 0.5), len(s) - 1)]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = list(self._latencies)
+            out = {
+                "uptime_secs": time.time() - self.started_unix,
+                "requests": self.requests,
+                "errors": self.errors,
+                "tokens_generated": self.tokens_generated,
+            }
+        out["latency_p50_secs"] = self._percentile(lat, 0.50) if lat else None
+        out["latency_p95_secs"] = self._percentile(lat, 0.95) if lat else None
+        return out
+
+
+def _count_tokens(body: dict) -> int:
+    """Generated-token count from a successful /api response body (the
+    token lists include the prompt; this is a serving throughput gauge,
+    not an exact decode count)."""
+    toks = body.get("tokens")
+    if isinstance(toks, list):
+        return sum(len(t) for t in toks if isinstance(t, list))
+    return 0
 
 
 class MegatronGenerate:
@@ -142,28 +198,38 @@ class MegatronServer:
     def __init__(self, model, params, tokenizer, int8_kv_cache=False):
         self.generator = MegatronGenerate(model, params, tokenizer,
                                           int8_kv_cache=int8_kv_cache)
+        self.metrics = ServerMetrics()
 
     def run(self, host: str = "0.0.0.0", port: int = 5000):
         generator = self.generator
+        metrics = self.metrics
 
         class Handler(BaseHTTPRequestHandler):
-            def do_PUT(self):
-                if self.path not in ("/api", "/generate"):
-                    self.send_error(404)
-                    return
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(n) or b"{}")
-                except (ValueError, json.JSONDecodeError):
-                    self.send_error(400, "invalid JSON")
-                    return
-                code, body = generator.handle(payload)
+            def _send_json(self, code: int, body: dict):
                 data = json.dumps(body).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def do_PUT(self):
+                if self.path not in ("/api", "/generate"):
+                    self.send_error(404)
+                    return
+                t0 = time.perf_counter()
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    metrics.observe(time.perf_counter() - t0, 400)
+                    self.send_error(400, "invalid JSON")
+                    return
+                code, body = generator.handle(payload)
+                metrics.observe(time.perf_counter() - t0, code,
+                                tokens=(_count_tokens(body)
+                                        if code == 200 else 0))
+                self._send_json(code, body)
 
             do_POST = do_PUT
 
@@ -185,6 +251,14 @@ class MegatronServer:
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
+                elif self.path == "/health":
+                    # liveness: the server thread answers => alive (a
+                    # generation may still hold the model lock)
+                    self._send_json(200, {"status": "ok",
+                                          "uptime_secs": time.time()
+                                          - metrics.started_unix})
+                elif self.path == "/metrics":
+                    self._send_json(200, metrics.snapshot())
                 else:
                     self.send_error(404)
 

@@ -31,6 +31,30 @@ import jax
 _LOG_OPTIONS = ("max", "minmax", "all")
 
 
+def gather_across_hosts(elapsed: Dict[str, float]) -> Dict[str, List[float]]:
+    """Per-name list of per-host values (host index == list index).
+
+    Multi-host this is a ``process_allgather`` — a collective, so only
+    call from code paths every process reaches together (log
+    boundaries).  Single-host returns one-element lists with no
+    collective at all.  Module-level so the straggler detector
+    (``tracing.py``) and any other boundary-synchronized consumer share
+    the one implementation."""
+    if not elapsed or jax.process_count() == 1:
+        return {n: [v] for n, v in elapsed.items()}
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    # identical dicts on every host (same code path), but sort so the
+    # gathered columns line up regardless of insert order
+    names = sorted(elapsed)
+    local = np.asarray([elapsed[n] for n in names], dtype=np.float64)
+    gathered = multihost_utils.process_allgather(local)  # (hosts, k)
+    gathered = np.asarray(gathered).reshape(jax.process_count(), len(names))
+    return {n: [float(x) for x in gathered[:, i]]
+            for i, n in enumerate(names)}
+
+
 class Timer:
     def __init__(self, name: str):
         self.name = name
@@ -135,26 +159,9 @@ class Timers:
 
     def _gather_across_hosts(
             self, elapsed: Dict[str, float]) -> Dict[str, List[float]]:
-        """Per-name list of per-host elapsed values.
-
-        Multi-host this is a ``process_allgather`` — a collective, so only
-        call from code paths every process reaches together (log
-        boundaries).  Single-host returns one-element lists with no
-        collective at all."""
-        if not elapsed or jax.process_count() == 1:
-            return {n: [v] for n, v in elapsed.items()}
-        import numpy as np
-        from jax.experimental import multihost_utils
-
-        # identical timer registries on every host (same code path), but
-        # sort so the gathered columns line up regardless of insert order
-        names = sorted(elapsed)
-        local = np.asarray([elapsed[n] for n in names], dtype=np.float64)
-        gathered = multihost_utils.process_allgather(local)  # (hosts, k)
-        gathered = np.asarray(gathered).reshape(jax.process_count(),
-                                                len(names))
-        return {n: [float(x) for x in gathered[:, i]]
-                for i, n in enumerate(names)}
+        """See module-level :func:`gather_across_hosts` (kept as a method
+        for existing callers/tests)."""
+        return gather_across_hosts(elapsed)
 
     # -- formatting per --timing_log_option -----------------------------
 
@@ -221,11 +228,16 @@ class Timers:
         zeroes the accumulators, so a caller that logs first writes zeros
         (and writing first then logging reads each timer twice).  One
         snapshot feeds both sinks; the cross-host gather also happens once
-        instead of twice."""
+        instead of twice.
+
+        Returns the gathered per-host snapshot ({name: [secs per host]},
+        already normalized) so the caller can reuse the allgather — the
+        straggler detector feeds on exactly this."""
         elapsed = self.get_elapsed(names, reset=True, normalizer=normalizer)
         if not elapsed:
-            return
+            return {}
         gathered = self._gather_across_hosts(elapsed)
         if writer is not None:
             self._write_gathered(gathered, writer, iteration)
         printer(self._format_line(gathered))
+        return gathered

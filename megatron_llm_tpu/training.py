@@ -29,6 +29,7 @@ from megatron_llm_tpu.config import TrainConfig, TransformerConfig, ParallelConf
 from megatron_llm_tpu.optimizer import MegatronOptimizer, OptimizerParamScheduler
 from megatron_llm_tpu.optimizer.optimizer import global_grad_norm
 from megatron_llm_tpu import random as mrandom
+from megatron_llm_tpu import tracing
 from megatron_llm_tpu.global_vars import get_counters
 
 logger = logging.getLogger("megatron_llm_tpu")
@@ -176,6 +177,7 @@ def training_log(
     writer=None,
     printer=print,
     throughput: Optional[Dict] = None,
+    interval_time: Optional[float] = None,
 ):
     """One console/TB log line (reference: training.py:462-641,
     tokens/sec at :591-609).
@@ -183,13 +185,23 @@ def training_log(
     ``throughput`` is a ``telemetry.ThroughputCalculator.compute()``
     record; when present the line carries tokens/sec/device, achieved
     TFLOPs/device and MFU (null MFU fields — unknown peak, or the
-    fabrication guard — are simply omitted, never printed as numbers)."""
+    fabrication guard — are simply omitted, never printed as numbers).
+
+    ``elapsed_per_iter`` is *train-only* step time (eval and
+    checkpoint-save wall-clock excluded, so throughput/MFU reflect the
+    step the hardware actually ran); ``interval_time`` is the raw
+    log-interval wall per iteration including those sections — both are
+    reported so a gap between them is visible instead of silently
+    deflating MFU."""
     tps = tokens_per_iter / max(elapsed_per_iter, 1e-9)
     line = (
         f" iteration {iteration:8d}/{train_iters:8d} |"
         f" elapsed time per iteration (ms): {elapsed_per_iter * 1000.0:.1f} |"
-        f" tokens per second: {tps:.1f} |"
     )
+    if interval_time is not None:
+        line += (f" interval time per iteration (ms):"
+                 f" {interval_time * 1000.0:.1f} |")
+    line += f" tokens per second: {tps:.1f} |"
     if throughput is not None:
         line += (f" tokens per second per device:"
                  f" {throughput['tokens_per_sec_per_device']:.1f} |")
@@ -219,6 +231,9 @@ def training_log(
             writer.add_scalar(k, float(v), iteration)
         writer.add_scalar("tokens_per_sec", tps, iteration)
         writer.add_scalar("learning_rate", lr, iteration)
+        if interval_time is not None:
+            writer.add_scalar("interval-time-per-iteration", interval_time,
+                              iteration)
         if throughput is not None:
             writer.add_scalar("tokens_per_sec_per_device",
                               throughput["tokens_per_sec_per_device"],
@@ -322,6 +337,11 @@ def pretrain(
         telemetry = Telemetry.default(model)
     stream = telemetry.stream
     profiler = telemetry.profiler
+    trace = getattr(telemetry, "tracing", None)
+    if trace is not None:
+        tracing.install_tracing(trace)
+    recompile = trace.recompile if trace is not None else None
+    straggler = trace.straggler if trace is not None else None
     skip_iters = frozenset(skip_iters or ())
 
     num_micro = max(
@@ -375,6 +395,11 @@ def pretrain(
     iteration = start_iteration
     last_time = time.perf_counter()
     train_start = time.perf_counter()
+    # eval + checkpoint-save wall-clock inside the current log interval:
+    # subtracted from the interval so elapsed-per-iteration (and thus
+    # tokens/sec + MFU) measures the training step, not the pauses
+    # (mutable cell because _save below also accumulates into it)
+    non_train = [0.0]
     skip_step = None  # forward-only step, compiled lazily on first skip
 
     injector = resilience.injector if resilience is not None else None
@@ -399,21 +424,31 @@ def pretrain(
     def _save(it):
         if watchdog is not None:
             watchdog.pause()        # storage latency is not a hang
-        timers("save-checkpoint", log_level=0).start()
-        if save_fn is not None:
-            save_fn(save_dir, it, params, opt_state, scheduler)
-        else:
-            checkpointing.save_checkpoint(
-                save_dir, it, params, opt_state, scheduler,
-                consumed_samples=counters.get("samples", 0),
-                args=checkpointing.config_to_args(
-                    getattr(model, "cfg", None)),
-                async_save=async_save,
-            )
-        timers("save-checkpoint").stop()
+        t0 = time.perf_counter()
+        with tracing.span("checkpoint_save", "checkpoint", iteration=it):
+            timers("save-checkpoint", log_level=0).start()
+            if save_fn is not None:
+                save_fn(save_dir, it, params, opt_state, scheduler)
+            else:
+                checkpointing.save_checkpoint(
+                    save_dir, it, params, opt_state, scheduler,
+                    consumed_samples=counters.get("samples", 0),
+                    args=checkpointing.config_to_args(
+                        getattr(model, "cfg", None)),
+                    async_save=async_save,
+                )
+            timers("save-checkpoint").stop()
+        non_train[0] += time.perf_counter() - t0
         if watchdog is not None:
             watchdog.resume()
 
+    # one root span spans the whole loop (category "run" is trace-only,
+    # so goodput never counts it) — every second of the run nests under
+    # it, which is what makes the exported trace's coverage ~100%.
+    # Entered by hand so the loop body keeps its indentation; the
+    # finally below closes it on every exit path (SystemExit included).
+    root_span = tracing.span("train", "run", start_iteration=start_iteration)
+    root_span.__enter__()
     try:
         while iteration < train_cfg.train_iters:
             if resilience is not None and resilience.snapshot_due(iteration):
@@ -426,7 +461,8 @@ def pretrain(
             if profiler is not None:
                 profiler.maybe_start(iteration + 1)
             timers("batch-generator", log_level=1).start()
-            batch = next(batch_iterator)
+            with tracing.span("data_next", "data"):
+                batch = next(batch_iterator)
             timers("batch-generator").stop()
             if injector is not None:
                 batch = injector.poison_batch(iteration + 1, batch)
@@ -444,6 +480,10 @@ def pretrain(
                     metrics = {"lm loss": jnp.float32(float("nan")),
                                "skipped_iter": 1}
                 else:
+                    if recompile is not None:
+                        # the forward-only program's first compile is
+                        # expected — it must not count as a recompile
+                        recompile.pause()
                     if skip_step is None:
                         # eval_step is the same forward-only program; reuse
                         # its compilation when available
@@ -454,15 +494,31 @@ def pretrain(
                     # previous step must not masquerade as this iteration's
                     metrics = {"lm loss": skip_step(params, batch, step_key),
                                "skipped_iter": 1}
+                    if recompile is not None:
+                        recompile.resume()
             else:
                 timers("train-step", log_level=1).start()
-                params, opt_state, metrics = train_step(
-                    params, opt_state, batch, step_key, lr, wd
-                )
+                t_step0 = time.perf_counter()
+                with tracing.span("step", "step", iteration=iteration + 1):
+                    params, opt_state, metrics = train_step(
+                        params, opt_state, batch, step_key, lr, wd
+                    )
+                step_secs = time.perf_counter() - t_step0
                 timers("train-step").stop()
+                if recompile is not None:
+                    # a compile that ran inside the dispatch span is not
+                    # productive step time — reattribute it to 'compile'
+                    _, csecs = recompile.drain()
+                    if csecs > 0.0 and trace is not None:
+                        trace.tracer.goodput.move("step", "compile", csecs)
+                    recompile.observe_step_time(step_secs)
             if watchdog is not None:
                 watchdog.resume()   # (re)arms; first arm is post-compile
             iteration += 1
+            if recompile is not None and iteration == start_iteration + 1:
+                # the train-step program exists now; any later backend
+                # compile is a recompile (shape/layout leak in the loop)
+                recompile.mark_steady()
             if profiler is not None:
                 # sync so the traced window contains the device work of
                 # its last step, not just that step's dispatch
@@ -503,6 +559,7 @@ def pretrain(
                     if watchdog is not None:
                         watchdog.resume()
                     last_time = time.perf_counter()
+                    non_train[0] = 0.0
                     continue
 
             if at_log_boundary:
@@ -510,10 +567,18 @@ def pretrain(
                     metrics = dict(metrics)
                     metrics["params norm"] = global_grad_norm(params)
                 timers("train-step-sync", log_level=1).start()
-                jax.block_until_ready(metrics["lm loss"])
+                with tracing.span("step_sync", "step", iteration=iteration):
+                    jax.block_until_ready(metrics["lm loss"])
                 timers("train-step-sync").stop()
                 now = time.perf_counter()
-                elapsed = (now - last_time) / log_interval
+                # elapsed (-> tokens/sec, MFU) is train-only: eval and
+                # checkpoint-save wall inside the interval is subtracted,
+                # so a save-heavy interval no longer deflates MFU;
+                # interval_time keeps the raw wall for goodput honesty
+                interval_time = (now - last_time) / log_interval
+                elapsed = max(now - last_time - non_train[0], 1e-9) \
+                    / log_interval
+                non_train[0] = 0.0
                 last_time = now
                 # --tensorboard_log_interval is an absolute iteration
                 # interval (reference semantics); metrics only exist at log
@@ -559,11 +624,12 @@ def pretrain(
                     elapsed, tokens, lr,
                     writer=use_writer,
                     throughput=throughput,
+                    interval_time=interval_time,
                 )
                 if stream is not None:
                     from megatron_llm_tpu.resilience import recovery_counters
                     from megatron_llm_tpu.telemetry import device_memory_stats
-                    stream.emit({
+                    rec = {
                         "iteration": iteration,
                         "train_iters": train_cfg.train_iters,
                         "lm_loss": log_metrics.get("lm loss"),
@@ -573,15 +639,31 @@ def pretrain(
                                                             0)),
                         "learning_rate": float(lr),
                         "step_time_secs": elapsed,
+                        "interval_time_secs": interval_time,
                         "tokens_per_iter": int(tokens),
                         **(throughput or {}),
                         "memory": device_memory_stats(),
                         "recovery": recovery_counters(),
-                    })
+                    }
+                    if trace is not None:
+                        g = trace.goodput_summary()
+                        rec["goodput_pct"] = g["goodput_pct"]
+                        rec["goodput"] = {k: round(v, 4)
+                                          for k, v in g.items()}
+                        rec["recompiles"] = int(
+                            counters.get("recompiles", 0))
+                        rec["straggler_events"] = int(
+                            counters.get("straggler_events", 0))
+                    stream.emit(rec)
                 # one snapshot feeds writer + console; the old
                 # write()-then-log() pair double-read (and could
-                # double-reset) every timer
-                timers.report(use_writer, iteration, normalizer=log_interval)
+                # double-reset) every timer.  The gathered per-host
+                # snapshot doubles as the straggler detector's input —
+                # the allgather already happened at this boundary.
+                gathered = timers.report(use_writer, iteration,
+                                         normalizer=log_interval)
+                if straggler is not None and gathered:
+                    straggler.check(gathered, iteration)
                 if use_writer is not None and hasattr(use_writer, "flush"):
                     use_writer.flush()
                 if on_metrics is not None:
@@ -590,12 +672,22 @@ def pretrain(
             if eval_step is not None and eval_interval and iteration % eval_interval == 0:
                 if watchdog is not None:
                     watchdog.pause()    # eval has its own duration budget
-                timers("eval-time", log_level=0).start()
-                losses = []
-                for _ in range(eval_iters):
-                    eval_batch = next(eval_iterator)
-                    losses.append(float(eval_step(params, eval_batch, None)))
-                timers("eval-time").stop()
+                if recompile is not None:
+                    # eval's forward-only program compiles on first use —
+                    # an expected compile, not a recompile
+                    recompile.pause()
+                t_eval0 = time.perf_counter()
+                with tracing.span("eval", "eval", iteration=iteration):
+                    timers("eval-time", log_level=0).start()
+                    losses = []
+                    for _ in range(eval_iters):
+                        eval_batch = next(eval_iterator)
+                        losses.append(
+                            float(eval_step(params, eval_batch, None)))
+                    timers("eval-time").stop()
+                non_train[0] += time.perf_counter() - t_eval0
+                if recompile is not None:
+                    recompile.resume()
                 if watchdog is not None:
                     watchdog.resume()
                 val = sum(losses) / len(losses)
@@ -650,6 +742,7 @@ def pretrain(
         # every exit path — normal completion, sys.exit (raises
         # SystemExit), or an exception — flushes in-flight async
         # saves so a durable checkpoint always gets its tracker
+        root_span.__exit__(None, None, None)
         if watchdog is not None:
             watchdog.stop()
         if profiler is not None:

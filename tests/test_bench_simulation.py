@@ -63,6 +63,17 @@ def test_sim_flash_ok_runs_primary_and_secondary(tmp_path):
     # (the finally above already restored the artifact if not)
     assert polluted is None, \
         f"simulated record polluted the TPU cache: {polluted[:200]}"
+    _check_goodput_fields(rec)
+
+
+def _check_goodput_fields(rec):
+    """The BENCH json carries the tracing diagnostics: goodput share of
+    the child's wall-clock, warmup compile seconds, and the recompile /
+    straggler counts (both zero in a healthy fixed-shape run)."""
+    assert 0.0 < rec["goodput_pct"] <= 100.0
+    assert rec["compile_secs"] >= 0.0
+    assert rec["recompiles"] == 0
+    assert rec["straggler_events"] == 0
 
 
 def test_sim_flash_fail_falls_back(tmp_path):
@@ -73,3 +84,4 @@ def test_sim_flash_fail_falls_back(tmp_path):
     assert rec["seq2048"] is None
     assert rec["attention"] == "xla"
     assert rec["simulated"] is True
+    _check_goodput_fields(rec)

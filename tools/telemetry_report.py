@@ -96,6 +96,11 @@ def aggregates(records: List[Dict]) -> Dict:
     mfus = [r["mfu"] for r in records if r.get("mfu") is not None]
     tpsd = [r["tokens_per_sec_per_device"] for r in records
             if r.get("tokens_per_sec_per_device") is not None]
+    # tracing-era fields (schema 2, --trace_dir): goodput_pct is
+    # cumulative, so the final record carries the run-level number;
+    # recompiles/straggler_events are monotone counters
+    goodputs = [r["goodput_pct"] for r in records
+                if r.get("goodput_pct") is not None]
     return {
         "log_boundaries": len(records),
         "p50_step_time_secs": percentile(step_times, 50),
@@ -104,6 +109,12 @@ def aggregates(records: List[Dict]) -> Dict:
         "max_mfu": max(mfus) if mfus else None,
         "mean_tokens_per_sec_per_device":
             sum(tpsd) / len(tpsd) if tpsd else None,
+        "goodput_pct": goodputs[-1] if goodputs else None,
+        "recompiles": next((r["recompiles"] for r in reversed(records)
+                            if r.get("recompiles") is not None), None),
+        "straggler_events": next(
+            (r["straggler_events"] for r in reversed(records)
+             if r.get("straggler_events") is not None), None),
     }
 
 
@@ -157,6 +168,11 @@ def main(argv=None) -> int:
           f" | max MFU: {_fmt(agg['max_mfu'], '.4f')}")
     print(f"mean tokens/sec/device: "
           f"{_fmt(agg['mean_tokens_per_sec_per_device'], '.1f')}")
+    if agg["goodput_pct"] is not None:
+        print(f"goodput: {agg['goodput_pct']:.1f}%"
+              f" | recompiles: {_fmt(agg['recompiles'], 'd')}"
+              f" | straggler events: {_fmt(agg['straggler_events'], 'd')}"
+              f"  (full breakdown: tools/trace_report.py)")
     if timeline:
         print("\nrecovery events:")
         for ev in timeline:

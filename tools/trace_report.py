@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Summarize a run's span trace (trace.json) + telemetry stream.
+
+Reads the Chrome ``trace_event`` JSON written by ``--trace_dir``
+(megatron_llm_tpu/tracing.py) — the same file Perfetto loads — and
+prints:
+
+* a goodput breakdown — wall-clock attributed to productive-step /
+  compile / checkpoint / eval / rewind / data-stall / other, with
+  ``goodput_pct`` and a bar chart
+* span coverage — how much of the traced wall-clock any span accounts
+  for (the acceptance bar is >= 95%)
+* the top-N slowest spans (the root ``train`` span excluded — it always
+  "wins")
+* a recompile timeline — every steady-state backend compile, timestamped
+* a straggler timeline — per-host straggler events (which host, which
+  section, how far past the median)
+
+When the sibling ``telemetry.jsonl`` (``--structured_log_dir``) exists,
+the per-boundary ``goodput_pct`` trend is appended.
+
+Pure stdlib — no jax import, runs anywhere the files do.
+
+Usage:
+    python tools/trace_report.py TRACE_DIR_OR_JSON [--top N] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+GOODPUT_ORDER = ("step", "compile", "checkpoint", "eval", "rewind", "data",
+                 "other")
+BAR_WIDTH = 40
+
+
+def load_trace(path: str) -> Dict:
+    """Accept a trace.json file or the --trace_dir holding one."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "trace.json")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no trace at {path}")
+    with open(path) as f:
+        return json.load(f)
+
+
+def spans(trace: Dict) -> List[Dict]:
+    """The complete ('X') events, sorted by start time."""
+    evs = [e for e in trace.get("traceEvents", []) if e.get("ph") == "X"]
+    return sorted(evs, key=lambda e: e.get("ts", 0.0))
+
+
+def instants(trace: Dict, name: Optional[str] = None) -> List[Dict]:
+    return [e for e in trace.get("traceEvents", [])
+            if e.get("ph") == "i" and (name is None or e.get("name") == name)]
+
+
+def coverage(trace: Dict) -> Optional[float]:
+    """Fraction of the traced wall-clock covered by at least one span:
+    union of [ts, ts+dur) intervals over the trace's own extent.  None
+    when the trace holds no spans."""
+    xs = spans(trace)
+    if not xs:
+        return None
+    intervals = sorted((e["ts"], e["ts"] + e.get("dur", 0.0)) for e in xs)
+    lo = intervals[0][0]
+    hi = max(end for _, end in intervals)
+    if hi <= lo:
+        return None
+    covered, cur_start, cur_end = 0.0, intervals[0][0], intervals[0][1]
+    for start, end in intervals[1:]:
+        if start > cur_end:
+            covered += cur_end - cur_start
+            cur_start, cur_end = start, end
+        else:
+            cur_end = max(cur_end, end)
+    covered += cur_end - cur_start
+    return covered / (hi - lo)
+
+
+def goodput_breakdown(trace: Dict) -> Optional[Dict]:
+    return (trace.get("otherData") or {}).get("goodput")
+
+
+def top_spans(trace: Dict, n: int = 10) -> List[Dict]:
+    """Slowest spans by duration; the root 'train' span excluded."""
+    xs = [e for e in spans(trace) if e.get("name") != "train"]
+    xs.sort(key=lambda e: e.get("dur", 0.0), reverse=True)
+    return [{"name": e["name"], "category": e.get("cat", "?"),
+             "start_secs": e["ts"] / 1e6, "dur_secs": e.get("dur", 0.0) / 1e6,
+             "args": {k: v for k, v in (e.get("args") or {}).items()
+                      if k != "goodput"}}
+            for e in xs[:n]]
+
+
+def recompile_timeline(trace: Dict) -> List[Dict]:
+    out = []
+    for e in spans(trace):
+        if e.get("name") == "recompile":
+            out.append({"at_secs": e["ts"] / 1e6,
+                        "compile_secs": e.get("dur", 0.0) / 1e6})
+    for e in instants(trace, "suspected_recompile"):
+        out.append({"at_secs": e["ts"] / 1e6, "suspected": True,
+                    "step_secs": (e.get("args") or {}).get("step_secs")})
+    return sorted(out, key=lambda r: r["at_secs"])
+
+
+def straggler_timeline(trace: Dict) -> List[Dict]:
+    out = []
+    for e in instants(trace, "straggler"):
+        a = e.get("args") or {}
+        out.append({"at_secs": e["ts"] / 1e6,
+                    "iteration": a.get("iteration"),
+                    "host": a.get("host"), "section": a.get("section"),
+                    "secs": a.get("secs"), "median_secs": a.get("median_secs"),
+                    "ratio": a.get("ratio")})
+    return sorted(out, key=lambda r: r["at_secs"])
+
+
+def goodput_trend(log_dir: str) -> List[Dict]:
+    """Per-boundary goodput_pct from a sibling telemetry.jsonl (empty
+    when the stream is absent or predates tracing)."""
+    path = os.path.join(log_dir, "telemetry.jsonl") \
+        if os.path.isdir(log_dir) else log_dir
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "log" and rec.get("goodput_pct") is not None:
+                out.append({"iteration": rec.get("iteration"),
+                            "goodput_pct": rec["goodput_pct"]})
+    return out
+
+
+def _bar(frac: float) -> str:
+    n = int(round(max(min(frac, 1.0), 0.0) * BAR_WIDTH))
+    return "#" * n + "." * (BAR_WIDTH - n)
+
+
+def render(trace: Dict, top_n: int, trend: List[Dict]) -> str:
+    lines = []
+    g = goodput_breakdown(trace)
+    other = trace.get("otherData") or {}
+    if g:
+        wall = g.get("wall_secs") or 0.0
+        lines.append(f"goodput breakdown (wall {wall:.2f}s, goodput "
+                     f"{g.get('goodput_pct', 0.0):.1f}%):")
+        for cat in GOODPUT_ORDER:
+            secs = g.get(f"{cat}_secs", 0.0)
+            frac = secs / wall if wall else 0.0
+            lines.append(f"  {cat:>10} {secs:9.2f}s {frac * 100:5.1f}% "
+                         f"|{_bar(frac)}|")
+    else:
+        lines.append("(no goodput breakdown in trace)")
+    cov = coverage(trace)
+    if cov is not None:
+        lines.append(f"\nspan coverage of traced wall-clock: "
+                     f"{cov * 100:.1f}%")
+    dropped = other.get("dropped_events", 0)
+    if dropped:
+        lines.append(f"dropped events (ring eviction): {dropped} — oldest "
+                     f"history is gone; raise --trace_buffer_size")
+
+    tops = top_spans(trace, top_n)
+    if tops:
+        lines.append(f"\ntop {len(tops)} slowest spans:")
+        for s in tops:
+            extra = (" " + json.dumps(s["args"], sort_keys=True)
+                     if s["args"] else "")
+            lines.append(f"  {s['dur_secs'] * 1000:10.1f} ms  "
+                         f"{s['name']} [{s['category']}] "
+                         f"@ {s['start_secs']:.2f}s{extra}")
+
+    rec = recompile_timeline(trace)
+    lines.append(f"\nrecompiles: {other.get('recompiles', len(rec))}")
+    for r in rec:
+        if r.get("suspected"):
+            lines.append(f"  @ {r['at_secs']:.2f}s suspected (step "
+                         f"{(r.get('step_secs') or 0.0):.2f}s, outlier "
+                         f"heuristic)")
+        else:
+            lines.append(f"  @ {r['at_secs']:.2f}s backend compile "
+                         f"{r['compile_secs']:.2f}s after steady state")
+
+    st = straggler_timeline(trace)
+    lines.append(f"\nstraggler events: {other.get('straggler_events', len(st))}")
+    for s in st:
+        lines.append(f"  iteration {s['iteration']}: host {s['host']} "
+                     f"{s['section']} {(s['secs'] or 0.0) * 1000:.1f} ms = "
+                     f"{(s['ratio'] or 0.0):.2f}x median "
+                     f"({(s['median_secs'] or 0.0) * 1000:.1f} ms)")
+
+    if trend:
+        lines.append("\ngoodput_pct per log boundary:")
+        for t in trend:
+            lines.append(f"  iteration {t['iteration']:>8}: "
+                         f"{t['goodput_pct']:5.1f}% "
+                         f"|{_bar(t['goodput_pct'] / 100.0)}|")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a span trace (trace.json)")
+    ap.add_argument("path", help="trace.json or the --trace_dir")
+    ap.add_argument("--log_dir", default=None,
+                    help="telemetry.jsonl (or its dir) for the per-boundary "
+                         "goodput trend; defaults to the trace's own dir")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many slowest spans to list")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    args = ap.parse_args(argv)
+
+    try:
+        trace = load_trace(args.path)
+    except (FileNotFoundError, json.JSONDecodeError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    log_dir = args.log_dir
+    if log_dir is None:
+        log_dir = args.path if os.path.isdir(args.path) \
+            else os.path.dirname(os.path.abspath(args.path))
+    trend = goodput_trend(log_dir)
+
+    if args.json:
+        print(json.dumps({
+            "goodput": goodput_breakdown(trace),
+            "coverage": coverage(trace),
+            "dropped_events":
+                (trace.get("otherData") or {}).get("dropped_events", 0),
+            "top_spans": top_spans(trace, args.top),
+            "recompile_timeline": recompile_timeline(trace),
+            "straggler_timeline": straggler_timeline(trace),
+            "goodput_trend": trend,
+        }, indent=1))
+        return 0
+
+    print(render(trace, args.top, trend))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:         # e.g. piped into head
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
