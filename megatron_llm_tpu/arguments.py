@@ -398,6 +398,41 @@ def _add_inference_args(parser):
     g.add_argument("--inference_batch_times_seqlen_threshold", type=int,
                    default=512)
     g.add_argument("--max_tokens_to_oom", type=int, default=12000)
+    # REST server limits (text_generation_server.py; previously the
+    # hardcoded MAX_PROMPTS / MAX_TOKENS module constants)
+    g.add_argument("--serve_max_prompts", type=int, default=128,
+                   help="maximum prompts per /api request")
+    g.add_argument("--serve_max_tokens", type=int, default=1024,
+                   help="maximum tokens_to_generate per /api request")
+    g.add_argument("--log_requests", action="store_true",
+                   help="log each /api request payload (prompts are user "
+                        "data — off by default)")
+    # continuous-batching engine (serving/; docs/guide/serving.md)
+    g.add_argument("--serve_engine", action="store_true",
+                   help="serve through the continuous-batching engine "
+                        "(slot-based paged KV cache, token-level "
+                        "co-batching, SSE streaming) instead of one "
+                        "locked generate() per request")
+    g.add_argument("--serve_num_slots", type=int, default=8,
+                   help="decode batch rows (max concurrently running "
+                        "requests)")
+    g.add_argument("--serve_block_size", type=int, default=16,
+                   help="tokens per KV page")
+    g.add_argument("--serve_num_blocks", type=int, default=0,
+                   help="KV pool pages; 0 = full backing for every slot "
+                        "at serve_max_model_len (no oversubscription)")
+    g.add_argument("--serve_prefill_chunk", type=int, default=64,
+                   help="prompt tokens per prefill call (bounds how long "
+                        "a long prompt stalls running decodes)")
+    g.add_argument("--serve_max_queue_depth", type=int, default=64,
+                   help="admission-control queue bound; beyond it /api "
+                        "returns 429 with Retry-After")
+    g.add_argument("--serve_deadline_secs", type=float, default=120.0,
+                   help="per-request deadline (queued or running); 0 "
+                        "disables")
+    g.add_argument("--serve_max_model_len", type=int, default=0,
+                   help="max prompt+generated tokens per request; 0 = "
+                        "model max_position_embeddings")
 
 
 def _add_resilience_args(parser):

@@ -280,7 +280,81 @@ def attention(
         k = apply_rotary_emb(k, cos, sin, position_ids)
 
     new_cache = None
-    if kv_cache is not None and "rolling" in kv_cache:
+    if kv_cache is not None and ("k_pages" in kv_cache
+                                 or "k_pages_q" in kv_cache):
+        # PAGED cache (serving engine, serving/kv_blocks.py): one shared
+        # pool of [num_blocks, block_size] pages per layer; each batch row
+        # (a serving *slot*) owns a block table mapping its logical
+        # positions to pool blocks.  All slots share the pool, so HBM is
+        # sized for aggregate traffic, not num_slots x max_len — the
+        # ragged-paged-attention memory model (arXiv:2604.15464) without
+        # a custom kernel: scatter-on-write, gather-on-read, plain masked
+        # attention over the gathered view.  Shapes are fixed by the pool
+        # and table geometry, so a jitted step never recompiles as
+        # requests come and go.
+        #
+        # Keys: (k_pages|k_pages_q[, k_pages_scale]) [P, bs, g, d],
+        # same for v; block_tables [b, M] int32 (entries beyond a slot's
+        # allocation = 0, the reserved garbage block); context_lens [b]
+        # tokens already in cache; valid_lens [b] real tokens in this
+        # chunk (padded/inactive rows write to the garbage block).
+        bt = kv_cache["block_tables"]
+        ctx = kv_cache["context_lens"]
+        vlen = kv_cache["valid_lens"]
+        quantized = "k_pages_q" in kv_cache
+        pages_k = kv_cache["k_pages_q"] if quantized else kv_cache["k_pages"]
+        P, bs = pages_k.shape[:2]
+        M = bt.shape[1]
+        n = k.shape[1]
+        g, d = k.shape[2], k.shape[3]
+        j = jnp.arange(n)[None, :]
+        pos = ctx[:, None] + j                               # [b, n] abs pos
+        blk = jnp.take_along_axis(bt, jnp.clip(pos // bs, 0, M - 1), axis=1)
+        real = j < vlen[:, None]
+        # padded / inactive tokens land in garbage block 0 (duplicate
+        # scatter indices there are fine — nobody reads it unmasked)
+        dest = jnp.where(real, blk * bs + pos % bs, pos % bs)
+        dest = jnp.clip(dest, 0, P * bs - 1)
+        cdt = k.dtype
+        if quantized:
+            from megatron_llm_tpu.quantization import absmax_quantize_int8
+
+            kq, ks = absmax_quantize_int8(k, axis=-1)
+            vq, vs = absmax_quantize_int8(v, axis=-1)
+            ckq = kv_cache["k_pages_q"].reshape(P * bs, g, d).at[dest].set(kq)
+            cks = kv_cache["k_pages_scale"].reshape(P * bs, g).at[dest].set(ks)
+            cvq = kv_cache["v_pages_q"].reshape(P * bs, g, d).at[dest].set(vq)
+            cvs = kv_cache["v_pages_scale"].reshape(P * bs, g).at[dest].set(vs)
+            gk = ckq.reshape(P, bs, g, d)[bt]        # [b, M, bs, g, d]
+            gks = cks.reshape(P, bs, g)[bt]
+            gv = cvq.reshape(P, bs, g, d)[bt]
+            gvs = cvs.reshape(P, bs, g)[bt]
+            k = (gk.astype(cdt) * gks[..., None].astype(cdt)).reshape(
+                x.shape[0], M * bs, g, d)
+            v = (gv.astype(cdt) * gvs[..., None].astype(cdt)).reshape(
+                x.shape[0], M * bs, g, d)
+            new_cache = {
+                "k_pages_q": ckq.reshape(P, bs, g, d),
+                "k_pages_scale": cks.reshape(P, bs, g),
+                "v_pages_q": cvq.reshape(P, bs, g, d),
+                "v_pages_scale": cvs.reshape(P, bs, g),
+            }
+        else:
+            ck = kv_cache["k_pages"].reshape(P * bs, g, d).at[dest].set(k)
+            cv = kv_cache["v_pages"].reshape(P * bs, g, d).at[dest].set(v)
+            k = ck.reshape(P, bs, g, d)[bt].reshape(x.shape[0], M * bs, g, d)
+            v = cv.reshape(P, bs, g, d)[bt].reshape(x.shape[0], M * bs, g, d)
+            new_cache = {"k_pages": ck.reshape(P, bs, g, d),
+                         "v_pages": cv.reshape(P, bs, g, d)}
+        key_pos = jnp.arange(M * bs)
+        valid = key_pos[None, None, :] <= pos[:, :, None]    # [b, sq, sk]
+        if cfg.sliding_window_size is not None:
+            valid &= key_pos[None, None, :] > (pos[:, :, None]
+                                               - cfg.sliding_window_size)
+        attention_mask = ~valid[:, None]                     # [b, 1, sq, sk]
+        new_cache.update({"block_tables": bt, "context_lens": ctx + vlen,
+                          "valid_lens": vlen})
+    elif kv_cache is not None and "rolling" in kv_cache:
         # ROLLING cache (sliding-window models): a ring buffer of exactly
         # window slots — decode memory O(window), not O(total).  Slot
         # j holds the newest position == j (mod W) written so far; the

@@ -1,0 +1,37 @@
+"""Continuous-batching serving engine (slot-based paged KV cache).
+
+Layering: ``kv_blocks`` (host-side pool bookkeeping) -> ``request``
+(lifecycle + admission queue) -> ``scheduler`` (slot admission,
+prefill/decode interleaving) -> ``engine`` (the background thread and
+the jitted fixed-shape device programs).  The HTTP front-end lives in
+``megatron_llm_tpu.text_generation_server``.
+"""
+
+from megatron_llm_tpu.serving.engine import EngineConfig, InferenceEngine
+from megatron_llm_tpu.serving.kv_blocks import (
+    BlockManager,
+    NoCapacity,
+    derive_num_blocks,
+)
+from megatron_llm_tpu.serving.request import (
+    EngineError,
+    QueueFull,
+    Request,
+    RequestQueue,
+    SamplingParams,
+)
+from megatron_llm_tpu.serving.scheduler import Scheduler
+
+__all__ = [
+    "BlockManager",
+    "EngineConfig",
+    "EngineError",
+    "InferenceEngine",
+    "NoCapacity",
+    "QueueFull",
+    "Request",
+    "RequestQueue",
+    "SamplingParams",
+    "Scheduler",
+    "derive_num_blocks",
+]

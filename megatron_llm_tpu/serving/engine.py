@@ -1,0 +1,490 @@
+"""Continuous-batching inference engine.
+
+One background thread drives two jitted, fixed-shape device programs over
+a single paged KV pool (text_generation/generation.py
+``init_paged_kv_caches`` + the paged branch in models/transformer.py):
+
+* ``decode_step`` — ``[num_slots]`` rows, one token each.  Every live
+  request occupies a slot; empty slots ride along masked (their KV
+  writes land in the garbage block).  All sampling knobs, block tables,
+  lengths and PRNG keys are *traced* inputs, so requests join and leave
+  the batch with zero recompiles — the continuous-batching property.
+* ``prefill_step`` — ``[1, prefill_chunk]`` tokens of one request's
+  prompt.  Chunking fixes the shape (one compile for any prompt length)
+  and bounds how long a long prompt can stall decode: the scheduler
+  strictly alternates chunks with decode steps.
+
+Steady state is exactly these two programs plus a ``[1, V]`` first-token
+sampler; ``warmup()`` compiles all three, after which
+``tracing.RecompileDetector.mark_steady()`` holds (asserted in
+tests/test_serving_engine.py).
+
+Host/device split: the engine keeps ALL mutable per-slot state
+(last tokens, context lengths, sampling knobs, PRNG key chains) as host
+numpy arrays and passes them whole into the jitted calls.  Nothing
+touches jnp outside the three compiled programs — even per-slot updates
+on admission are numpy row writes — because a stray
+``device_array.at[python_int].set()`` or ``array[slot:slot+1]`` would
+compile a fresh tiny executable per distinct slot index and trip the
+recompile detector.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_llm_tpu import telemetry, tracing
+from megatron_llm_tpu.models.language_model import language_model_forward
+from megatron_llm_tpu.serving.kv_blocks import (
+    BlockManager,
+    derive_num_blocks,
+)
+from megatron_llm_tpu.serving.request import (
+    FINISH_ABORTED,
+    FINISH_DEADLINE,
+    FINISH_ERROR,
+    FINISH_LENGTH,
+    FINISH_STOP,
+    Request,
+    RequestQueue,
+    RequestState,
+    SamplingParams,
+)
+from megatron_llm_tpu.serving.scheduler import Scheduler
+from megatron_llm_tpu.text_generation.generation import init_paged_kv_caches
+from megatron_llm_tpu.text_generation.sampling import NEG_INF, sample_batched
+
+
+@dataclass
+class EngineConfig:
+    num_slots: int = 8              # decode batch rows
+    block_size: int = 16            # tokens per KV page
+    num_blocks: int = 0             # 0 = full per-slot backing (no oversub)
+    max_model_len: int = 0          # 0 = model max_position_embeddings
+    prefill_chunk: int = 64         # prompt tokens per prefill call
+    max_queue_depth: int = 64       # admission control (HTTP 429 beyond)
+    default_deadline_secs: float = 120.0  # 0 = no deadline
+    int8_kv_cache: bool = False
+
+
+def _key_from_seed(seed: int) -> np.ndarray:
+    # the two raw uint32 words of jax.random.PRNGKey(seed), built without
+    # a device computation: PRNGKey(int) embeds the seed as a compile
+    # constant, so calling it for a never-seen seed after warmup would
+    # trigger a fresh compile and break the zero-recompile guarantee
+    seed = int(seed)
+    return np.array([(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF],
+                    np.uint32)
+
+
+class InferenceEngine:
+    """Continuous-batching engine over one model + param set.
+
+    ``submit()`` is thread-safe and returns a :class:`Request` future;
+    the background thread (``start()``) moves requests through
+    prefill -> decode -> completion.  Tokenization stays with the
+    caller — the engine speaks token ids only."""
+
+    def __init__(self, model, params, config: Optional[EngineConfig] = None):
+        self.model = model
+        self.params = params
+        self.config = cfg = config or EngineConfig()
+        mcfg = model.cfg
+        if cfg.max_model_len <= 0:
+            cfg.max_model_len = int(mcfg.max_position_embeddings)
+        cfg.max_model_len = min(cfg.max_model_len,
+                                int(mcfg.max_position_embeddings))
+        max_blocks_per_slot = -(-cfg.max_model_len // cfg.block_size)
+        num_blocks = derive_num_blocks(
+            cfg.num_slots, cfg.block_size, cfg.max_model_len,
+            cfg.num_blocks or None)
+        self.blocks = BlockManager(num_blocks, cfg.block_size,
+                                   cfg.num_slots, max_blocks_per_slot)
+        self.queue = RequestQueue(cfg.max_queue_depth)
+        self.scheduler = Scheduler(self.queue, self.blocks,
+                                   cfg.max_model_len)
+        self._pages = init_paged_kv_caches(
+            mcfg, num_blocks, cfg.block_size,
+            quantized=cfg.int8_kv_cache)
+
+        S = cfg.num_slots
+        # host-side per-slot state; uploaded whole each step
+        self._last_tokens = np.zeros(S, np.int32)
+        self._context_lens = np.zeros(S, np.int32)
+        self._active = np.zeros(S, np.int32)
+        self._temps = np.ones(S, np.float32)
+        self._top_ks = np.zeros(S, np.int32)
+        self._top_ps = np.zeros(S, np.float32)
+        self._ban_a = np.full(S, -1, np.int32)
+        self._ban_b = np.full(S, -1, np.int32)
+        self._keys = np.zeros((S, 2), np.uint32)
+
+        self._decode_step = jax.jit(self._decode_impl)
+        self._prefill_step = jax.jit(self._prefill_impl)
+        self._sample_first = jax.jit(self._sample_first_impl)
+
+        # counters (read by stats()/the HTTP /metrics endpoint)
+        self.decode_steps = 0
+        self.prefill_chunks = 0
+        self.tokens_generated = 0
+        self.occupancy_sum = 0          # sum of active slots over decode steps
+        self.prefill_secs = 0.0
+        self.decode_secs = 0.0
+        self.finished: Dict[str, int] = {}
+        self.warmed_up = False
+
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._submit_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # jitted device programs (fixed shapes; everything traced)
+    # ------------------------------------------------------------------
+
+    def _layer_caches(self, pages, block_tables, context_lens, valid_lens):
+        return [dict(p, block_tables=block_tables,
+                     context_lens=context_lens, valid_lens=valid_lens)
+                for p in pages]
+
+    @staticmethod
+    def _strip_pages(new_caches):
+        return [{k: v for k, v in c.items() if "pages" in k}
+                for c in new_caches]
+
+    def _decode_impl(self, params, pages, last_tokens, context_lens,
+                     block_tables, active, temps, top_ks, top_ps,
+                     ban_a, ban_b, keys):
+        cfg = self.model.cfg
+        tokens = last_tokens[:, None]                       # [S, 1]
+        positions = context_lens[:, None]                   # [S, 1]
+        caches = self._layer_caches(pages, block_tables, context_lens,
+                                    active)
+        logits, new_caches = language_model_forward(
+            params, tokens, positions, None, cfg,
+            rng_key=None, train=False, kv_caches=caches)
+        logits = logits[:, 0, :].astype(jnp.float32)        # [S, V]
+        V = logits.shape[-1]
+        # ban pair (prevent_newline_after_colon): token b is illegal
+        # immediately after token a
+        banned = (ban_a >= 0) & (last_tokens == ban_a)
+        hit = jnp.arange(V)[None, :] == jnp.clip(ban_b, 0, V - 1)[:, None]
+        logits = jnp.where(banned[:, None] & hit, NEG_INF, logits)
+        sub = jax.vmap(lambda k: jax.random.split(k, 2))(keys)  # [S, 2, 2]
+        next_tokens = sample_batched(logits, sub[:, 0], top_ks, top_ps,
+                                     temps)
+        return next_tokens, self._strip_pages(new_caches), sub[:, 1]
+
+    def _prefill_impl(self, params, pages, tokens, start_pos, valid_len,
+                      block_table):
+        cfg = self.model.cfg
+        C = tokens.shape[1]
+        positions = (start_pos + jnp.arange(C))[None, :]    # [1, C]
+        caches = self._layer_caches(
+            pages, block_table, jnp.full((1,), start_pos, jnp.int32),
+            jnp.full((1,), valid_len, jnp.int32))
+        logits, new_caches = language_model_forward(
+            params, tokens, positions, None, cfg,
+            rng_key=None, train=False, kv_caches=caches)
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], valid_len - 1, axis=0, keepdims=False)
+        return last.astype(jnp.float32), self._strip_pages(new_caches)
+
+    def _sample_first_impl(self, logits, key, top_k, top_p, temp,
+                           ban_a, ban_b, last_prompt_tok):
+        logits = logits[None, :]                            # [1, V]
+        V = logits.shape[-1]
+        banned = (ban_a >= 0) & (last_prompt_tok == ban_a)
+        hit = jnp.arange(V)[None, :] == jnp.clip(ban_b, 0, V - 1)
+        logits = jnp.where(banned & hit, NEG_INF, logits)
+        sub = jax.random.split(key, 2)
+        tok = sample_batched(logits, sub[0][None], top_k[None],
+                             top_p[None], temp[None])
+        return tok[0], sub[1]
+
+    # ------------------------------------------------------------------
+    # submission (any thread)
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt_tokens: Sequence[int],
+               sampling: Optional[SamplingParams] = None,
+               stream: bool = False,
+               deadline_secs: Optional[float] = None) -> Request:
+        return self.submit_many([list(prompt_tokens)],
+                                [sampling or SamplingParams()],
+                                stream=stream,
+                                deadline_secs=deadline_secs)[0]
+
+    def submit_many(self, prompts: Sequence[Sequence[int]],
+                    samplings: Sequence[Optional[SamplingParams]],
+                    stream: bool = False,
+                    deadline_secs: Optional[float] = None) -> List[Request]:
+        """Atomic multi-request admission: validates and enqueues all, or
+        raises (ValueError -> HTTP 400, QueueFull -> HTTP 429) enqueueing
+        none."""
+        if deadline_secs is None:
+            deadline_secs = (self.config.default_deadline_secs or None)
+        reqs = []
+        for toks, sp in zip(prompts, samplings):
+            r = Request(toks, sp or SamplingParams(), stream=stream,
+                        deadline_secs=deadline_secs)
+            r._pc_submit = time.perf_counter()
+            self.scheduler.validate(r)
+            reqs.append(r)
+        with self._submit_lock:
+            self.queue.put_many(reqs)   # raises QueueFull atomically
+        self._wake.set()
+        return reqs
+
+    # ------------------------------------------------------------------
+    # engine thread
+    # ------------------------------------------------------------------
+
+    def start(self) -> "InferenceEngine":
+        assert self._thread is None, "engine already started"
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serving-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        for req in self.queue.drain():
+            req._finish(FINISH_ABORTED)
+        for req in list(self.scheduler.active.values()):
+            req._finish(FINISH_ABORTED)
+            self.scheduler.evict(req)
+        stream = telemetry.get_stream()
+        if stream is not None:
+            stream.emit({"kind": "serve", "event": "engine_stop",
+                         **self.stats()})
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                did_work = self.step()
+            except Exception as e:  # noqa: BLE001 - engine must survive
+                self._fail_all(f"{type(e).__name__}: {e}")
+                did_work = False
+            if not did_work:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+    def _fail_all(self, msg: str) -> None:
+        self._active[:] = 0
+        for req in list(self.scheduler.active.values()):
+            req._finish(FINISH_ERROR, error=msg)
+            self.scheduler.evict(req)
+            self._count_finish(FINISH_ERROR)
+
+    def step(self) -> bool:
+        """One scheduling decision + device call.  Returns False when
+        idle.  Public so tests can single-step the engine without the
+        background thread."""
+        sched = self.scheduler
+        for req in sched.sweep_deadlines():
+            req._finish(FINISH_DEADLINE)
+            self._retire(req)
+        for req in sched.admit():
+            self._on_admit(req)
+        kind, arg = sched.next_action()
+        if kind == "prefill":
+            self._run_prefill_chunk(arg)
+            return True
+        if kind == "decode":
+            self._run_decode(arg)
+            return True
+        return False
+
+    # -- admission ------------------------------------------------------
+
+    def _on_admit(self, req: Request) -> None:
+        s = req.slot
+        sp = req.sampling
+        self._temps[s] = sp.temperature
+        self._top_ks[s] = sp.top_k
+        self._top_ps[s] = sp.top_p
+        self._ban_a[s] = sp.ban_pair[0] if sp.ban_pair else -1
+        self._ban_b[s] = sp.ban_pair[1] if sp.ban_pair else -1
+        self._keys[s] = _key_from_seed(sp.seed)
+        self._active[s] = 0             # stays masked until prefill done
+        self._context_lens[s] = 0
+        tracing.instant("admit", "serve", request=req.id, slot=s,
+                        prompt_tokens=len(req.prompt_tokens))
+
+    # -- prefill --------------------------------------------------------
+
+    def _run_prefill_chunk(self, req: Request) -> None:
+        C = self.config.prefill_chunk
+        start = req.prefill_pos
+        chunk = req.prompt_tokens[start:start + C]
+        valid = len(chunk)
+        toks = np.zeros((1, C), np.int32)
+        toks[0, :valid] = chunk
+        table = self.blocks.tables[req.slot:req.slot + 1].copy()
+        t0 = time.perf_counter()
+        with tracing.span("prefill_chunk", "serve", request=req.id,
+                          tokens=valid):
+            last_logits, self._pages = self._prefill_step(
+                self.params, self._pages, toks, np.int32(start),
+                np.int32(valid), table)
+            done = start + valid >= len(req.prompt_tokens)
+            if done:
+                tok, new_key = self._sample_first(
+                    last_logits, self._keys[req.slot],
+                    self._top_ks[req.slot], self._top_ps[req.slot],
+                    self._temps[req.slot], self._ban_a[req.slot],
+                    self._ban_b[req.slot],
+                    np.int32(req.prompt_tokens[-1]))
+                tok = int(tok)
+                self._keys[req.slot] = np.asarray(new_key)
+            else:
+                jax.block_until_ready(self._pages[0])
+        self.prefill_secs += time.perf_counter() - t0
+        self.prefill_chunks += 1
+        req.prefill_pos = start + valid
+        if not done:
+            return
+        # prompt fully cached: request enters the decode batch
+        s = req.slot
+        req.state = RequestState.DECODE
+        self._context_lens[s] = len(req.prompt_tokens)
+        self._active[s] = 1
+        self._last_tokens[s] = tok
+        self._emit_and_check(req, tok)
+
+    # -- decode ---------------------------------------------------------
+
+    def _run_decode(self, slots: List[int]) -> None:
+        t0 = time.perf_counter()
+        with tracing.span("decode_step", "serve", batch=len(slots)):
+            next_tokens, self._pages, new_keys = self._decode_step(
+                self.params, self._pages, self._last_tokens,
+                self._context_lens, self.blocks.tables.copy(),
+                self._active, self._temps, self._top_ks, self._top_ps,
+                self._ban_a, self._ban_b, self._keys)
+            next_tokens = np.asarray(next_tokens)
+        # key chains advance ONLY for decoding slots: a slot mid-prefill
+        # keeps its admission-time seed key, so a request's sample stream
+        # depends on its seed alone, not on batch-mates' decode traffic
+        new_keys = np.asarray(new_keys)
+        for s in slots:
+            self._keys[s] = new_keys[s]
+        self.decode_secs += time.perf_counter() - t0
+        self.decode_steps += 1
+        self.occupancy_sum += len(slots)
+        for s in slots:
+            req = self.scheduler.active.get(s)
+            if req is None or req.state != RequestState.DECODE:
+                continue
+            # the step wrote last_tokens[s] into the cache at
+            # context_lens[s] and sampled the next token
+            self._context_lens[s] += 1
+            tok = int(next_tokens[s])
+            self._last_tokens[s] = tok
+            sp = req.sampling
+            if sp.top_p_decay > 0.0:
+                self._top_ps[s] = sp.top_p_at(len(req.out_tokens) + 1)
+            self._emit_and_check(req, tok)
+
+    # -- completion -----------------------------------------------------
+
+    def _emit_and_check(self, req: Request, tok: int) -> None:
+        prev = (req.out_tokens[-1] if req.out_tokens
+                else req.prompt_tokens[-1])
+        req._emit_token(tok)
+        self.tokens_generated += 1
+        sp = req.sampling
+        reason = None
+        if tok == sp.eod_id or tok in sp.stop_token_ids:
+            reason = FINISH_STOP
+        elif (prev, tok) in sp.stop_pairs:
+            reason = FINISH_STOP
+        elif len(req.out_tokens) >= sp.max_new_tokens:
+            reason = FINISH_LENGTH
+        if reason is not None:
+            req._finish(reason)
+            self._retire(req)
+
+    def _retire(self, req: Request) -> None:
+        s = req.slot
+        if s is not None:
+            self._active[s] = 0
+        self.scheduler.evict(req)
+        self._count_finish(req.finish_reason)
+        tracer = tracing.get_tracer()
+        pc0 = getattr(req, "_pc_submit", None)
+        if tracer is not None and pc0 is not None:
+            tracer.completed(
+                "request", "serve", pc0, time.perf_counter() - pc0,
+                request=req.id, prompt_tokens=len(req.prompt_tokens),
+                new_tokens=len(req.out_tokens),
+                finish_reason=req.finish_reason)
+        stream = telemetry.get_stream()
+        if stream is not None:
+            stream.emit({
+                "kind": "serve", "event": "request_done",
+                "request": req.id,
+                "prompt_tokens": len(req.prompt_tokens),
+                "new_tokens": len(req.out_tokens),
+                "finish_reason": req.finish_reason,
+                "ttft_secs": req.ttft_secs(),
+                "latency_secs": req.latency_secs(),
+                "queue_depth": self.queue.depth(),
+            })
+
+    def _count_finish(self, reason: Optional[str]) -> None:
+        if reason:
+            self.finished[reason] = self.finished.get(reason, 0) + 1
+
+    # ------------------------------------------------------------------
+    # warmup / stats
+    # ------------------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile the steady-state programs (prefill chunk, first-token
+        sampler, decode step) with one dummy greedy request.  Call before
+        ``tracing.RecompileDetector.mark_steady()`` — after this, serving
+        arbitrary requests triggers zero compiles."""
+        assert self._thread is None, "warm up before start()"
+        prompt = [1] * min(self.config.prefill_chunk + 1,
+                           max(self.config.max_model_len - 4, 1))
+        req = Request(prompt, SamplingParams(max_new_tokens=3,
+                                             temperature=0.0))
+        req._pc_submit = time.perf_counter()
+        self.queue.put(req)
+        deadline = time.monotonic() + 300.0
+        while req.state != RequestState.DONE:
+            if not self.step():
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError("engine warmup did not converge")
+        self.warmed_up = True
+        tracing.instant("engine_warm", "serve")
+
+    def stats(self) -> Dict[str, Any]:
+        s: Dict[str, Any] = dict(self.scheduler.stats())
+        dec = max(self.decode_steps, 1)
+        s.update({
+            "decode_steps": self.decode_steps,
+            "prefill_chunks": self.prefill_chunks,
+            "tokens_generated": self.tokens_generated,
+            "mean_batch_occupancy": self.occupancy_sum / dec,
+            "prefill_secs": round(self.prefill_secs, 6),
+            "decode_secs": round(self.decode_secs, 6),
+            "finished": dict(self.finished),
+            "warmed_up": self.warmed_up,
+        })
+        return s

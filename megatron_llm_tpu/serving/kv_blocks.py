@@ -1,0 +1,128 @@
+"""Slot-based block manager for the paged serving KV cache.
+
+The engine owns ONE fixed-shape pool of KV pages per layer
+(``[num_blocks, block_size, groups, head_dim]``, allocated by
+``text_generation.generation.init_paged_kv_caches``).  This module is the
+host-side bookkeeping over that pool: which *slot* (batch row of the
+jitted decode step) is live, which pool blocks each slot owns, and the
+``[num_slots, max_blocks_per_slot]`` block-table array the paged
+attention branch (models/transformer.py) consumes.
+
+Design points (Ragged Paged Attention, arXiv:2604.15464; vLLM's block
+manager):
+
+* **Block 0 is reserved as the garbage block.**  Padded prefill tokens
+  and inactive decode rows scatter their K/V there; table entries beyond
+  a slot's allocation also point at it.  Nothing ever reads it unmasked.
+* **Admission reserves a request's worst case** (prompt + max_new
+  tokens) up front.  No lazy growth means no mid-decode OOM and no
+  preemption machinery; the pool still beats a dense
+  ``[slots, max_len]`` cache because short requests hold few blocks and
+  the rest stay free for admission.
+* Everything here is plain numpy/ints — no jax, no device traffic.  The
+  engine uploads ``tables`` (whole array, a few KB) whenever an
+  allocation changes it; shapes never change, so the jitted step never
+  recompiles.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+GARBAGE_BLOCK = 0
+
+
+class NoCapacity(Exception):
+    """Not enough free blocks / slots for the requested admission."""
+
+
+class BlockManager:
+    """Allocates slots and pool blocks; owns the block-table array."""
+
+    def __init__(self, num_blocks: int, block_size: int, num_slots: int,
+                 max_blocks_per_slot: int):
+        assert num_blocks >= 2, "need at least one block beyond the garbage"
+        assert block_size >= 1 and num_slots >= 1
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_slots = int(num_slots)
+        self.max_blocks_per_slot = int(max_blocks_per_slot)
+        # LIFO free lists: hot blocks get reused while still in cache
+        self._free_blocks: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._free_slots: List[int] = list(range(num_slots - 1, -1, -1))
+        self._slot_blocks: Dict[int, List[int]] = {}
+        self.tables = np.full((num_slots, max_blocks_per_slot),
+                              GARBAGE_BLOCK, np.int32)
+        self._lock = threading.Lock()
+
+    # -- capacity -------------------------------------------------------
+
+    def blocks_needed(self, total_tokens: int) -> int:
+        return -(-max(int(total_tokens), 1) // self.block_size)
+
+    def can_admit(self, total_tokens: int) -> bool:
+        n = self.blocks_needed(total_tokens)
+        with self._lock:
+            return (bool(self._free_slots) and n <= len(self._free_blocks)
+                    and n <= self.max_blocks_per_slot)
+
+    # -- alloc / free ---------------------------------------------------
+
+    def alloc(self, total_tokens: int) -> int:
+        """Reserve a slot plus blocks covering ``total_tokens``; returns
+        the slot id.  Raises ``NoCapacity`` when slots or blocks run
+        out (the scheduler leaves the request queued and retries)."""
+        n = self.blocks_needed(total_tokens)
+        if n > self.max_blocks_per_slot:
+            raise ValueError(
+                f"request needs {n} blocks "
+                f"({total_tokens} tokens / block_size {self.block_size}) "
+                f"> max_blocks_per_slot {self.max_blocks_per_slot}")
+        with self._lock:
+            if not self._free_slots or n > len(self._free_blocks):
+                raise NoCapacity(
+                    f"no capacity: {len(self._free_slots)} free slots, "
+                    f"{len(self._free_blocks)} free blocks, need {n}")
+            slot = self._free_slots.pop()
+            blocks = [self._free_blocks.pop() for _ in range(n)]
+            self._slot_blocks[slot] = blocks
+            self.tables[slot, :] = GARBAGE_BLOCK
+            self.tables[slot, :n] = blocks
+            return slot
+
+    def free(self, slot: int) -> None:
+        with self._lock:
+            blocks = self._slot_blocks.pop(slot, None)
+            if blocks is None:
+                return
+            self._free_blocks.extend(blocks)
+            self._free_slots.append(slot)
+            self.tables[slot, :] = GARBAGE_BLOCK
+
+    # -- observability --------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            used = self.num_blocks - 1 - len(self._free_blocks)
+            return {
+                "blocks_total": self.num_blocks - 1,   # garbage excluded
+                "blocks_in_use": used,
+                "slots_total": self.num_slots,
+                "slots_in_use": self.num_slots - len(self._free_slots),
+            }
+
+
+def derive_num_blocks(num_slots: int, block_size: int,
+                      max_model_len: int,
+                      requested: Optional[int] = None) -> int:
+    """Pool size: the explicit ``requested`` count when given (allows
+    deliberate oversubscription — admission then backs off on blocks,
+    not slots), else enough for every slot at full length, plus the
+    garbage block."""
+    per_slot = -(-int(max_model_len) // int(block_size))
+    if requested:
+        return max(int(requested), 2)
+    return num_slots * per_slot + 1

@@ -1,0 +1,164 @@
+"""Continuous-batching scheduler.
+
+Owns the waiting queue, the :class:`~megatron_llm_tpu.serving.kv_blocks.
+BlockManager`, and the set of live slots, and decides what the engine
+thread runs next:
+
+* ``("prefill", request)`` — one chunk of one request's prompt.  Chunked
+  prefill bounds how long a long prompt can stall decode for everyone
+  else: after each chunk the scheduler re-offers a decode step to the
+  already-running slots (strict alternation when both kinds of work are
+  pending), so time-to-next-token for running requests stays bounded by
+  one chunk's latency.
+* ``("decode", slots)`` — one batched decode step for every slot whose
+  prefill has finished.
+* ``("idle", None)`` — nothing to do.
+
+Admission is capacity-reserving: a request only leaves the queue when a
+slot AND its worst-case block count (prompt + max_new_tokens) are both
+free (kv_blocks.py), so an admitted request can always run to
+completion — no preemption paths.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from megatron_llm_tpu.serving.kv_blocks import BlockManager, NoCapacity
+from megatron_llm_tpu.serving.request import (
+    FINISH_DEADLINE,
+    Request,
+    RequestQueue,
+    RequestState,
+)
+
+
+class Scheduler:
+    def __init__(self, queue: RequestQueue, blocks: BlockManager,
+                 max_model_len: int):
+        self.queue = queue
+        self.blocks = blocks
+        self.max_model_len = int(max_model_len)
+        self.active: Dict[int, Request] = {}     # slot -> request
+        self._last_was_prefill = False
+        # counters surfaced through engine stats / ServerMetrics
+        self.admitted = 0
+        self.rejected_len = 0
+        self.deadline_evictions = 0
+
+    # -- admission ------------------------------------------------------
+
+    def total_tokens(self, req: Request) -> int:
+        return len(req.prompt_tokens) + req.sampling.max_new_tokens
+
+    def validate(self, req: Request) -> None:
+        """Raises ValueError for requests that could never run (too long
+        for the model/pool) — callers map this to HTTP 400, not 429."""
+        total = self.total_tokens(req)
+        if total > self.max_model_len:
+            self.rejected_len += 1
+            raise ValueError(
+                f"prompt ({len(req.prompt_tokens)}) + max_new_tokens "
+                f"({req.sampling.max_new_tokens}) = {total} exceeds "
+                f"max_model_len {self.max_model_len}")
+        if self.blocks.blocks_needed(total) > self.blocks.max_blocks_per_slot:
+            self.rejected_len += 1
+            raise ValueError(
+                f"request needs more KV blocks than a slot can hold "
+                f"({total} tokens, block_size {self.blocks.block_size})")
+
+    def admit(self) -> List[Request]:
+        """Move queued requests into free slots (FIFO, head-of-line: we
+        stop at the first request that doesn't fit so arrival order is
+        preserved).  Returns the newly admitted requests."""
+        admitted: List[Request] = []
+        while True:
+            head = self.queue.peek()
+            if head is None:
+                break
+            if head.past_deadline():
+                self.queue.pop()
+                self.deadline_evictions += 1
+                head._finish(FINISH_DEADLINE)
+                continue
+            try:
+                slot = self.blocks.alloc(self.total_tokens(head))
+            except (NoCapacity, ValueError):
+                break
+            self.queue.pop()
+            head.slot = slot
+            head.state = RequestState.PREFILL
+            self.active[slot] = head
+            self.admitted += 1
+            admitted.append(head)
+        return admitted
+
+    # -- step selection -------------------------------------------------
+
+    def decode_slots(self) -> List[int]:
+        return [s for s, r in self.active.items()
+                if r.state == RequestState.DECODE]
+
+    def prefill_pending(self) -> Optional[Request]:
+        """Oldest admitted request with prompt tokens left to prefill."""
+        best = None
+        for r in self.active.values():
+            if r.state == RequestState.PREFILL and (
+                    best is None or r.t_submit < best.t_submit):
+                best = r
+        return best
+
+    def next_action(self) -> Tuple[str, object]:
+        pre = self.prefill_pending()
+        dec = self.decode_slots()
+        if pre is not None and dec:
+            # strict alternation: never run two prefill chunks back to
+            # back while decodable slots wait
+            if self._last_was_prefill:
+                self._last_was_prefill = False
+                return "decode", dec
+            self._last_was_prefill = True
+            return "prefill", pre
+        if pre is not None:
+            self._last_was_prefill = True
+            return "prefill", pre
+        if dec:
+            self._last_was_prefill = False
+            return "decode", dec
+        return "idle", None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def evict(self, req: Request) -> None:
+        """Release a finished request's slot and blocks (the caller has
+        already ``_finish``-ed it)."""
+        if req.slot is not None:
+            self.active.pop(req.slot, None)
+            self.blocks.free(req.slot)
+            req.slot = None
+
+    def sweep_deadlines(self, now: Optional[float] = None) -> List[Request]:
+        """Running requests past their deadline.  The engine finishes and
+        retires them (it owns the per-slot device-state rows that must be
+        cleared alongside the eviction); queued expiries are handled in
+        ``admit``."""
+        now = time.monotonic() if now is None else now
+        out = [r for r in self.active.values() if r.past_deadline(now)]
+        self.deadline_evictions += len(out)
+        return out
+
+    def has_work(self) -> bool:
+        return bool(self.active) or self.queue.depth() > 0
+
+    def stats(self) -> Dict[str, float]:
+        s = dict(self.blocks.stats())
+        s.update({
+            "queue_depth": self.queue.depth(),
+            "active_requests": len(self.active),
+            "decoding_requests": len(self.decode_slots()),
+            "admitted_total": self.admitted,
+            "rejected_len_total": self.rejected_len,
+            "deadline_evictions_total": self.deadline_evictions,
+        })
+        return s

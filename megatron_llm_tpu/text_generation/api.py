@@ -40,6 +40,65 @@ def _tokenize_prompts(tokenizer, prompts: Sequence[str], pad_id: int,
     return jnp.asarray(arr), jnp.asarray(lengths, jnp.int32)
 
 
+def _single_token_id(tokenizer, text, quiet=False):
+    # Resolve ``text`` to the single token id it produces
+    # mid-sequence.  BPE vocabs encode '\n' to one id; sentencepiece-
+    # style tokenizers can encode it to [] (stripped) or to multiple /
+    # context-dependent ids, where blindly taking ids[-1] would make
+    # the stop/ban target the wrong id and silently never fire.
+    ids = tokenizer.tokenize(text)
+    if len(ids) == 1:
+        return ids[0]
+    # Retry with a leading anchor: if 'a'+text adds exactly one id
+    # over 'a', that id is the real mid-sequence encoding.  Guarded:
+    # int-only tokenizers (NullTokenizer) raise on alphabetic input,
+    # and the graceful answer there is the old None-disable.
+    try:
+        anchor = tokenizer.tokenize("a")
+        ctx = tokenizer.tokenize("a" + text)
+    except Exception:
+        anchor = ctx = None
+    if ctx is not None and len(ctx) == len(anchor) + 1 \
+            and ctx[:len(anchor)] == anchor:
+        return ctx[-1]
+    if not quiet:  # "\n\n" callers expect multi-token encodings
+        import warnings
+        warnings.warn(
+            f"tokenizer encodes {text!r} to {len(ids)} ids "
+            f"({ids}); stop/ban rules targeting it are "
+            + ("disabled" if not ids
+               else "approximate (using last id)"))
+    return ids[-1] if ids else None
+
+
+def resolve_stop_rules(tokenizer, stop_on_eol=False,
+                       stop_on_double_eol=False,
+                       prevent_newline_after_colon=False):
+    """(extra_stop_ids, stop_pairs, ban_pairs) token-id rules for the
+    server's eol knobs — shared by the batch ``generate`` path and the
+    continuous-batching engine (serving/engine.py), so both stop/ban on
+    exactly the same ids."""
+    extra_stop, stop_pairs, ban_pairs = [], [], []
+    if stop_on_eol or stop_on_double_eol:
+        eol = _single_token_id(tokenizer, "\n")
+        if stop_on_eol and eol is not None:
+            extra_stop.append(eol)
+        if stop_on_double_eol:
+            # quiet: "\n\n" legitimately encodes to two eol ids on many
+            # tokenizers, and that case is fully handled by stop_pairs.
+            dbl = _single_token_id(tokenizer, "\n\n", quiet=True)
+            if dbl is not None and dbl != eol:
+                extra_stop.append(dbl)      # single '\n\n' merge token
+            if eol is not None:
+                stop_pairs.append((eol, eol))  # two consecutive newlines
+    if prevent_newline_after_colon:
+        colon = _single_token_id(tokenizer, ":")
+        eol = _single_token_id(tokenizer, "\n")
+        if colon is not None and eol is not None:
+            ban_pairs.append((colon, eol))
+    return tuple(extra_stop), tuple(stop_pairs), tuple(ban_pairs)
+
+
 def generate(
     model,
     params,
@@ -96,53 +155,10 @@ def generate(
               "rolling (sliding-window) cache engaged and has no int8 "
               "variant; KV stays bf16", flush=True)
 
-    def one_tok(text, quiet=False):
-        # Resolve ``text`` to the single token id it produces
-        # mid-sequence.  BPE vocabs encode '\n' to one id; sentencepiece-
-        # style tokenizers can encode it to [] (stripped) or to multiple /
-        # context-dependent ids, where blindly taking ids[-1] would make
-        # the stop/ban target the wrong id and silently never fire.
-        ids = tokenizer.tokenize(text)
-        if len(ids) == 1:
-            return ids[0]
-        # Retry with a leading anchor: if 'a'+text adds exactly one id
-        # over 'a', that id is the real mid-sequence encoding.  Guarded:
-        # int-only tokenizers (NullTokenizer) raise on alphabetic input,
-        # and the graceful answer there is the old None-disable.
-        try:
-            anchor = tokenizer.tokenize("a")
-            ctx = tokenizer.tokenize("a" + text)
-        except Exception:
-            anchor = ctx = None
-        if ctx is not None and len(ctx) == len(anchor) + 1 \
-                and ctx[:len(anchor)] == anchor:
-            return ctx[-1]
-        if not quiet:  # "\n\n" callers expect multi-token encodings
-            import warnings
-            warnings.warn(
-                f"tokenizer encodes {text!r} to {len(ids)} ids "
-                f"({ids}); stop/ban rules targeting it are "
-                + ("disabled" if not ids
-                   else "approximate (using last id)"))
-        return ids[-1] if ids else None
-
-    extra_stop, stop_pairs, ban_pairs = [], [], []
-    if stop_on_eol or stop_on_double_eol:
-        eol = one_tok("\n")
-        if stop_on_eol and eol is not None:
-            extra_stop.append(eol)
-        if stop_on_double_eol:
-            # quiet: "\n\n" legitimately encodes to two eol ids on many
-            # tokenizers, and that case is fully handled by stop_pairs.
-            dbl = one_tok("\n\n", quiet=True)
-            if dbl is not None and dbl != eol:
-                extra_stop.append(dbl)      # single '\n\n' merge token
-            if eol is not None:
-                stop_pairs.append((eol, eol))  # two consecutive newlines
-    if prevent_newline_after_colon:
-        colon, eol = one_tok(":"), one_tok("\n")
-        if colon is not None and eol is not None:
-            ban_pairs.append((colon, eol))
+    extra_stop, stop_pairs, ban_pairs = resolve_stop_rules(
+        tokenizer, stop_on_eol=stop_on_eol,
+        stop_on_double_eol=stop_on_double_eol,
+        prevent_newline_after_colon=prevent_newline_after_colon)
 
     out_tokens, _, log_probs = generate_tokens(
         model, params, toks, lens, jax.random.PRNGKey(seed),

@@ -63,3 +63,59 @@ def sample(
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = modify_logits(logits, top_k, top_p, temperature)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def modify_logits_batched(
+    logits: jax.Array,          # [S, V]
+    top_k: jax.Array,           # [S] int32 (0 = off)
+    top_p: jax.Array,           # [S] float32 (0 or 1 = off)
+    temperature: jax.Array,     # [S] float32 (0 = greedy rows, untouched)
+) -> jax.Array:
+    """Per-row traced sampling knobs — the serving engine's decode step
+    co-batches requests with different params in one fixed-shape call, so
+    none of them can be static (a static knob would recompile the step
+    whenever a new request joins the batch).  Same semantics as
+    ``modify_logits`` applied row-wise: temperature scale, then top-k,
+    then top-p over the top-k-filtered distribution."""
+    logits = logits.astype(jnp.float32)
+    V = logits.shape[-1]
+    t = temperature[:, None]
+    logits = jnp.where(t > 0.0, logits / jnp.maximum(t, 1e-6), logits)
+    # top-k: value of each row's k-th largest logit via one descending sort
+    sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(
+        sorted_l, jnp.clip(top_k - 1, 0, V - 1)[:, None], axis=-1)
+    k_active = (top_k > 0) & (top_k < V)
+    logits = jnp.where(k_active[:, None] & (logits < kth), NEG_INF, logits)
+    # top-p on the filtered rows (matches modify_logits' ordering: the
+    # cumulative mass is taken over what survived top-k)
+    sorted_p = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_p, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum((cum - probs) < top_p[:, None], axis=-1,
+                         keepdims=True) - 1
+    cutoff = jnp.take_along_axis(sorted_p, jnp.maximum(cutoff_idx, 0),
+                                 axis=-1)
+    p_active = (top_p > 0.0) & (top_p < 1.0)
+    return jnp.where(p_active[:, None] & (logits < cutoff), NEG_INF, logits)
+
+
+def sample_batched(
+    logits: jax.Array,          # [S, V]
+    keys: jax.Array,            # [S, 2] uint32 — one PRNG chain per slot
+    top_k: jax.Array,
+    top_p: jax.Array,
+    temperature: jax.Array,
+) -> jax.Array:
+    """Row-wise ``sample``: greedy rows (temperature 0 or top_k 1) take
+    the raw argmax exactly like ``sample``'s greedy branch; the rest draw
+    from the filtered distribution with their own PRNG key, so a
+    request's sample stream is independent of who it shares the batch
+    with."""
+    greedy = (temperature <= 0.0) | (top_k == 1)
+    filtered = modify_logits_batched(logits, top_k, top_p, temperature)
+    drawn = jax.vmap(lambda l, k: jax.random.categorical(k, l))(
+        filtered, keys)
+    return jnp.where(greedy,
+                     jnp.argmax(logits.astype(jnp.float32), axis=-1),
+                     drawn).astype(jnp.int32)

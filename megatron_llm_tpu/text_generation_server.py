@@ -8,6 +8,20 @@ while other ranks spin in a broadcast loop.
 TPU: a stdlib ``http.server`` implementation (Flask is not in the image)
 with the same ``PUT /api`` contract and validation rules; there is no
 broadcast loop — one controller drives all chips.
+
+Two dispatch paths behind the same contract:
+
+* **legacy** (no engine): one ``generate_and_post_process`` call per
+  request under a lock — one generation in flight, others queue on the
+  lock.  Always used for beam search, logprobs, and
+  ``tokens_to_generate == 0``.
+* **engine** (``serving.InferenceEngine`` passed in, e.g. via
+  ``tools/run_text_generation_server.py --serve_engine``): requests are
+  token-level co-batched by the continuous-batching engine, so N
+  concurrent clients share decode steps instead of serializing.
+  Admission control maps a full engine queue to HTTP 429 with a
+  ``Retry-After`` header, and ``PUT /api/stream`` serves tokens
+  incrementally as Server-Sent Events.
 """
 
 from __future__ import annotations
@@ -21,10 +35,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from megatron_llm_tpu.text_generation.api import (
     beam_search_and_post_process,
     generate_and_post_process,
+    resolve_stop_rules,
 )
 
-MAX_PROMPTS = 128
-MAX_TOKENS = 1024
+MAX_PROMPTS = 128       # defaults; override with --serve_max_prompts /
+MAX_TOKENS = 1024       # --serve_max_tokens (arguments.py)
 
 
 class ServerMetrics:
@@ -32,7 +47,11 @@ class ServerMetrics:
     p50/p95 request latency over a bounded window, total tokens
     generated.  Served by ``GET /metrics``; ``GET /health`` is the
     liveness probe.  Thread-safe — the handler runs per-connection
-    threads under ``ThreadingHTTPServer``."""
+    threads under ``ThreadingHTTPServer``.
+
+    When the continuous-batching engine is active, ``snapshot()`` also
+    carries its counters (queue depth, batch occupancy, prefill vs
+    decode time, per-reason completions) under ``"engine"``."""
 
     def __init__(self, window: int = 512):
         self._lock = threading.Lock()
@@ -41,13 +60,21 @@ class ServerMetrics:
         self.started_unix = time.time()
         self.requests = 0
         self.errors = 0
+        self.throttled = 0          # 429s (admission control)
+        self.streamed = 0           # SSE requests served
         self.tokens_generated = 0
+        self.engine_stats_fn = None  # set when an engine is attached
 
-    def observe(self, secs: float, status: int, tokens: int = 0) -> None:
+    def observe(self, secs: float, status: int, tokens: int = 0,
+                streamed: bool = False) -> None:
         with self._lock:
             self.requests += 1
             if status >= 400:
                 self.errors += 1
+            if status == 429:
+                self.throttled += 1
+            if streamed:
+                self.streamed += 1
             self.tokens_generated += max(int(tokens), 0)
             self._latencies.append(float(secs))
             if len(self._latencies) > self._window:
@@ -65,10 +92,18 @@ class ServerMetrics:
                 "uptime_secs": time.time() - self.started_unix,
                 "requests": self.requests,
                 "errors": self.errors,
+                "throttled": self.throttled,
+                "streamed": self.streamed,
                 "tokens_generated": self.tokens_generated,
             }
         out["latency_p50_secs"] = self._percentile(lat, 0.50) if lat else None
         out["latency_p95_secs"] = self._percentile(lat, 0.95) if lat else None
+        fn = self.engine_stats_fn
+        if fn is not None:
+            try:
+                out["engine"] = fn()
+            except Exception:
+                pass
         return out
 
 
@@ -85,120 +120,302 @@ def _count_tokens(body: dict) -> int:
 class MegatronGenerate:
     """Request validation + dispatch (reference: text_generation_server.py:31)."""
 
-    def __init__(self, model, params, tokenizer, int8_kv_cache=False):
+    def __init__(self, model, params, tokenizer, int8_kv_cache=False,
+                 engine=None, log_requests=False,
+                 max_prompts=None, max_tokens=None):
         self.model = model
         self.params = params
         self.tokenizer = tokenizer
         self.int8_kv_cache = int8_kv_cache
+        self.engine = engine
+        self.log_requests = bool(log_requests)
+        self.max_prompts = int(max_prompts or MAX_PROMPTS)
+        self.max_tokens = int(max_tokens or MAX_TOKENS)
         self.lock = threading.Lock()
 
-    def handle(self, payload: dict):
+    # -- validation -----------------------------------------------------
+
+    def _parse(self, payload: dict):
+        """Full request validation.  Returns ``(None, knobs)`` on
+        success or ``((code, body), None)`` — every malformed input is a
+        JSON 400, never a dead socket."""
         if "prompts" not in payload:
-            return 400, {"message": "prompts argument required"}
+            return (400, {"message": "prompts argument required"}), None
         if "max_len" in payload:
-            return 400, {"message": "max_len is no longer used.  Replace "
-                                    "with tokens_to_generate"}
+            return (400, {"message": "max_len is no longer used.  Replace "
+                                     "with tokens_to_generate"}), None
         if "sentences" in payload:
-            return 400, {"message": "sentences is no longer used.  Replace "
-                                    "with prompts"}
+            return (400, {"message": "sentences is no longer used.  "
+                                     "Replace with prompts"}), None
         prompts = payload["prompts"]
         if not isinstance(prompts, list) or not prompts:
-            return 400, {"message": "prompts must be a non-empty list"}
-        if len(prompts) > MAX_PROMPTS:
-            return 400, {"message": f"maximum number of prompts is {MAX_PROMPTS}"}
+            return (400, {"message": "prompts must be a non-empty list"}), \
+                None
+        if len(prompts) > self.max_prompts:
+            return (400, {"message": f"maximum number of prompts is "
+                                     f"{self.max_prompts}"}), None
         add_BOS = bool(payload.get("add_BOS", False))
         if not add_BOS and any(len(p) == 0 for p in prompts
                                if isinstance(p, str)):
-            return 400, {"message": "Empty prompts require add_BOS=true"}
+            return (400, {"message": "Empty prompts require add_BOS=true"}), \
+                None
         tokens_to_generate = payload.get("tokens_to_generate", 64)
         if not isinstance(tokens_to_generate, int) or tokens_to_generate < 0:
-            return 400, {"message": "tokens_to_generate must be an integer >= 0"}
-        if tokens_to_generate > MAX_TOKENS:
-            return 400, {"message": f"maximum tokens_to_generate is {MAX_TOKENS}"}
-        logprobs = bool(payload.get("logprobs", False))
+            return (400, {"message": "tokens_to_generate must be an "
+                                     "integer >= 0"}), None
+        if tokens_to_generate > self.max_tokens:
+            return (400, {"message": f"maximum tokens_to_generate is "
+                                     f"{self.max_tokens}"}), None
+        top_k = int(payload.get("top_k", 0))
+        if top_k < 0 or top_k > 1000:
+            return (400, {"message": "top_k must be in [0, 1000]"}), None
+        top_p = float(payload.get("top_p", 0.0))
+        if top_p < 0.0 or top_p > 1.0:
+            return (400, {"message": "top_p must be in [0, 1]"}), None
+        temperature = float(payload.get("temperature", 1.0))
+        # 0.0 is an explicit, supported value: greedy decoding (matches
+        # sampling.sample, which argmaxes at temperature 0)
+        if temperature < 0.0 or temperature > 100.0:
+            return (400, {"message": "temperature must be in [0, 100] "
+                                     "(0 = greedy)"}), None
+        top_p_decay = float(payload.get("top_p_decay", 0.0))
+        if top_p_decay < 0.0 or top_p_decay > 1.0:
+            return (400, {"message": "top_p_decay must be in [0, 1]"}), None
+        if top_p_decay > 0.0 and top_p == 0.0:
+            return (400, {"message": "top_p_decay requires top_p"}), None
+        top_p_bound = float(payload.get("top_p_bound", 0.0))
+        if "top_p_bound" in payload and (top_p_bound <= 0.0
+                                         or top_p_bound > top_p):
+            return (400, {"message": "top_p_bound must be in (0, top_p]"}), \
+                None
+        knobs = {
+            "prompts": prompts,
+            "add_BOS": add_BOS,
+            "tokens_to_generate": tokens_to_generate,
+            "top_k": top_k,
+            "top_p": top_p,
+            "temperature": temperature,
+            "top_p_decay": top_p_decay,
+            "top_p_bound": top_p_bound,
+            "logprobs": bool(payload.get("logprobs", False)),
+            "stop_on_eol": bool(payload.get("stop_on_eol", False)),
+            "stop_on_double_eol": bool(payload.get("stop_on_double_eol",
+                                                   False)),
+            "prevent_newline_after_colon": bool(
+                payload.get("prevent_newline_after_colon", False)),
+            "beam_width": payload.get("beam_width", None),
+            "stop_token": payload.get("stop_token", None),
+            "length_penalty": float(payload.get("length_penalty", 1.0)),
+            "random_seed": int(payload.get("random_seed", 0)),
+            "no_log": bool(payload.get("no_log", False)),
+        }
+        return None, knobs
+
+    def _log(self, payload: dict, knobs: dict) -> None:
+        # request logging is opt-in (--log_requests): prompts are user
+        # data and do not belong in server logs by default
+        if self.log_requests and not knobs["no_log"]:
+            print(json.dumps(payload), flush=True)
+
+    # -- dispatch -------------------------------------------------------
+
+    def handle(self, payload: dict):
         try:
-            return self._handle_sampling(payload, prompts,
-                                         tokens_to_generate, logprobs,
-                                         add_BOS)
+            err, knobs = self._parse(payload)
         except (TypeError, ValueError) as exc:
             # e.g. a null/None knob from a UI with a cleared field:
             # int(None)/float(None) must be a 400, not a dead socket
             return 400, {"message": f"malformed parameter: {exc}"}
+        if err is not None:
+            return err
+        self._log(payload, knobs)
+        use_engine = (self.engine is not None
+                      and knobs["beam_width"] is None
+                      and not knobs["logprobs"]
+                      and knobs["tokens_to_generate"] > 0)
+        if use_engine:
+            return self._handle_engine(knobs)
+        return self._handle_legacy(knobs)
 
-    def _handle_sampling(self, payload, prompts, tokens_to_generate,
-                         logprobs, add_BOS):
-        top_k = int(payload.get("top_k", 0))
-        if top_k < 0 or top_k > 1000:
-            return 400, {"message": "top_k must be in [0, 1000]"}
-        top_p = float(payload.get("top_p", 0.0))
-        if top_p < 0.0 or top_p > 1.0:
-            return 400, {"message": "top_p must be in [0, 1]"}
-        temperature = float(payload.get("temperature", 1.0))
-        if temperature < 0.0 or temperature > 100.0:
-            return 400, {"message": "temperature must be in (0, 100]"}
-        top_p_decay = float(payload.get("top_p_decay", 0.0))
-        if top_p_decay < 0.0 or top_p_decay > 1.0:
-            return 400, {"message": "top_p_decay must be in [0, 1]"}
-        if top_p_decay > 0.0 and top_p == 0.0:
-            return 400, {"message": "top_p_decay requires top_p"}
-        top_p_bound = float(payload.get("top_p_bound", 0.0))
-        if "top_p_bound" in payload and (top_p_bound <= 0.0
-                                         or top_p_bound > top_p):
-            return 400, {"message": "top_p_bound must be in (0, top_p]"}
-        stop_on_double_eol = bool(payload.get("stop_on_double_eol", False))
-        stop_on_eol = bool(payload.get("stop_on_eol", False))
-        prevent_newline_after_colon = bool(
-            payload.get("prevent_newline_after_colon", False))
-        no_log = bool(payload.get("no_log", False))
-        beam_width = payload.get("beam_width", None)
-        stop_token = payload.get("stop_token", None)
-        length_penalty = float(payload.get("length_penalty", 1.0))
-        random_seed = int(payload.get("random_seed", 0))
-        if not no_log:
-            print(json.dumps(payload), flush=True)
-
+    def _handle_legacy(self, knobs: dict):
         with self.lock:  # single in-flight generation (reference uses a lock)
-            if beam_width is not None:
-                if len(prompts) > 1:
+            if knobs["beam_width"] is not None:
+                if len(knobs["prompts"]) > 1:
                     return 400, {"message": "beam search requires one prompt"}
                 texts, scores = beam_search_and_post_process(
-                    self.model, self.params, self.tokenizer, prompts,
-                    tokens_to_generate=tokens_to_generate,
-                    beam_size=int(beam_width),
-                    length_penalty=length_penalty,
-                    stop_token=(int(stop_token) if stop_token is not None
-                                else None),
+                    self.model, self.params, self.tokenizer,
+                    knobs["prompts"],
+                    tokens_to_generate=knobs["tokens_to_generate"],
+                    beam_size=int(knobs["beam_width"]),
+                    length_penalty=knobs["length_penalty"],
+                    stop_token=(int(knobs["stop_token"])
+                                if knobs["stop_token"] is not None else None),
                 )
                 return 200, {"text": texts, "scores": scores.tolist()}
             texts, segments, log_probs, tokens = generate_and_post_process(
-                self.model, self.params, self.tokenizer, prompts,
-                tokens_to_generate=tokens_to_generate,
-                return_output_log_probs=logprobs,
-                top_k_sampling=top_k,
-                top_p_sampling=top_p,
-                temperature=temperature,
-                random_seed=random_seed,
-                add_BOS=add_BOS,
-                top_p_decay=top_p_decay,
-                top_p_bound=top_p_bound,
-                stop_on_eol=stop_on_eol,
-                stop_on_double_eol=stop_on_double_eol,
-                prevent_newline_after_colon=prevent_newline_after_colon,
+                self.model, self.params, self.tokenizer, knobs["prompts"],
+                tokens_to_generate=knobs["tokens_to_generate"],
+                return_output_log_probs=knobs["logprobs"],
+                top_k_sampling=knobs["top_k"],
+                top_p_sampling=knobs["top_p"],
+                temperature=knobs["temperature"],
+                random_seed=knobs["random_seed"],
+                add_BOS=knobs["add_BOS"],
+                top_p_decay=knobs["top_p_decay"],
+                top_p_bound=knobs["top_p_bound"],
+                stop_on_eol=knobs["stop_on_eol"],
+                stop_on_double_eol=knobs["stop_on_double_eol"],
+                prevent_newline_after_colon=knobs[
+                    "prevent_newline_after_colon"],
                 int8_kv_cache=self.int8_kv_cache,
             )
             out = {"text": texts, "segments": segments, "tokens": tokens}
-            if logprobs:
+            if knobs["logprobs"]:
                 out["logprobs"] = log_probs.tolist()
             return 200, out
+
+    # -- engine path ----------------------------------------------------
+
+    def _tokenize(self, prompt: str, add_BOS: bool):
+        toks = self.tokenizer.tokenize(prompt)
+        if add_BOS:
+            bos = getattr(self.tokenizer, "bos_token_id", None)
+            if bos is None:
+                bos = self.tokenizer.eod
+            toks = [bos] + list(toks)
+        return list(toks)
+
+    def _sampling_params(self, knobs: dict, index: int):
+        from megatron_llm_tpu.serving.request import SamplingParams
+
+        extra_stop, stop_pairs, ban_pairs = resolve_stop_rules(
+            self.tokenizer,
+            stop_on_eol=knobs["stop_on_eol"],
+            stop_on_double_eol=knobs["stop_on_double_eol"],
+            prevent_newline_after_colon=knobs[
+                "prevent_newline_after_colon"])
+        return SamplingParams(
+            max_new_tokens=knobs["tokens_to_generate"],
+            temperature=knobs["temperature"],
+            top_k=knobs["top_k"],
+            top_p=knobs["top_p"],
+            top_p_decay=knobs["top_p_decay"],
+            top_p_bound=knobs["top_p_bound"],
+            # distinct streams for identical prompts in one batch, while
+            # a single-prompt request reproduces random_seed exactly
+            seed=knobs["random_seed"] + index,
+            eod_id=getattr(self.tokenizer, "eod", None),
+            stop_token_ids=extra_stop,
+            stop_pairs=stop_pairs,
+            ban_pair=(ban_pairs[0] if ban_pairs else None),
+        )
+
+    def _submit_engine(self, knobs: dict, stream: bool = False):
+        """Returns (None, requests) or ((code, body), None)."""
+        from megatron_llm_tpu.serving.request import QueueFull
+
+        try:
+            token_lists = [self._tokenize(p, knobs["add_BOS"])
+                           for p in knobs["prompts"]]
+            samplings = [self._sampling_params(knobs, i)
+                         for i in range(len(token_lists))]
+            reqs = self.engine.submit_many(token_lists, samplings,
+                                           stream=stream)
+            return None, reqs
+        except QueueFull as exc:
+            return (429, {"message": str(exc),
+                          "retry_after_secs": exc.retry_after_secs}), None
+        except ValueError as exc:
+            return (400, {"message": str(exc)}), None
+
+    def _result_timeout(self) -> float:
+        dl = getattr(self.engine.config, "default_deadline_secs", 0) or 0
+        return dl + 60.0 if dl else 600.0
+
+    def _handle_engine(self, knobs: dict):
+        from megatron_llm_tpu.serving.request import EngineError
+
+        err, reqs = self._submit_engine(knobs)
+        if err is not None:
+            return err
+        texts, segments, tokens = [], [], []
+        timeout = self._result_timeout()
+        for r in reqs:
+            try:
+                r.result(timeout=timeout)
+            except EngineError as exc:
+                return 500, {"message": f"engine error: {exc}"}
+            except TimeoutError:
+                return 500, {"message": "generation timed out"}
+            if r.finish_reason == "deadline":
+                return 503, {"message": "request deadline exceeded "
+                                        "before completion"}
+            row = r.tokens
+            tokens.append(row)
+            texts.append(self.tokenizer.detokenize(row))
+            segments.append([self.tokenizer.detokenize([t]) for t in row])
+        return 200, {"text": texts, "segments": segments, "tokens": tokens}
+
+    def handle_stream(self, payload: dict):
+        """SSE path (``PUT /api/stream``): returns ``(code, body, None)``
+        on rejection or ``(200, {}, events)`` where ``events`` yields one
+        JSON-able dict per token and a final ``{"done": ...}`` record."""
+        try:
+            err, knobs = self._parse(payload)
+        except (TypeError, ValueError) as exc:
+            return 400, {"message": f"malformed parameter: {exc}"}, None
+        if err is not None:
+            return err[0], err[1], None
+        if self.engine is None:
+            return 400, {"message": "streaming requires the continuous-"
+                                    "batching engine (start the server "
+                                    "with --serve_engine)"}, None
+        if len(knobs["prompts"]) != 1:
+            return 400, {"message": "streaming supports a single prompt"}, \
+                None
+        if knobs["beam_width"] is not None or knobs["logprobs"]:
+            return 400, {"message": "streaming does not support beam "
+                                    "search or logprobs"}, None
+        if knobs["tokens_to_generate"] == 0:
+            return 400, {"message": "streaming requires "
+                                    "tokens_to_generate > 0"}, None
+        self._log(payload, knobs)
+        err, reqs = self._submit_engine(knobs, stream=True)
+        if err is not None:
+            return err[0], err[1], None
+        req = reqs[0]
+        tokenizer = self.tokenizer
+        timeout = self._result_timeout()
+
+        def events():
+            for kind, val in req.events(timeout=timeout):
+                if kind == "token":
+                    yield {"token": val,
+                           "segment": tokenizer.detokenize([val])}
+                elif kind == "done":
+                    yield {"done": True, "finish_reason": val,
+                           "text": tokenizer.detokenize(req.tokens),
+                           "tokens": req.tokens}
+                else:   # "error"
+                    yield {"done": True, "finish_reason": "error",
+                           "message": str(val)}
+
+        return 200, {}, events()
 
 
 class MegatronServer:
     """reference: text_generation_server.py:234-241."""
 
-    def __init__(self, model, params, tokenizer, int8_kv_cache=False):
-        self.generator = MegatronGenerate(model, params, tokenizer,
-                                          int8_kv_cache=int8_kv_cache)
+    def __init__(self, model, params, tokenizer, int8_kv_cache=False,
+                 engine=None, log_requests=False,
+                 max_prompts=None, max_tokens=None):
+        self.generator = MegatronGenerate(
+            model, params, tokenizer, int8_kv_cache=int8_kv_cache,
+            engine=engine, log_requests=log_requests,
+            max_prompts=max_prompts, max_tokens=max_tokens)
         self.metrics = ServerMetrics()
+        if engine is not None:
+            self.metrics.engine_stats_fn = engine.stats
 
     def run(self, host: str = "0.0.0.0", port: int = 5000):
         generator = self.generator
@@ -210,17 +427,26 @@ class MegatronServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                if code == 429:
+                    self.send_header("Retry-After", str(max(int(
+                        body.get("retry_after_secs", 1)), 1)))
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _read_payload(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
             def do_PUT(self):
+                if self.path in ("/api/stream", "/generate/stream"):
+                    self._do_stream()
+                    return
                 if self.path not in ("/api", "/generate"):
                     self.send_error(404)
                     return
                 t0 = time.perf_counter()
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    payload = self._read_payload()
                 except (ValueError, json.JSONDecodeError):
                     metrics.observe(time.perf_counter() - t0, 400)
                     self.send_error(400, "invalid JSON")
@@ -230,6 +456,38 @@ class MegatronServer:
                                 tokens=(_count_tokens(body)
                                         if code == 200 else 0))
                 self._send_json(code, body)
+
+            def _do_stream(self):
+                t0 = time.perf_counter()
+                try:
+                    payload = self._read_payload()
+                except (ValueError, json.JSONDecodeError):
+                    metrics.observe(time.perf_counter() - t0, 400)
+                    self.send_error(400, "invalid JSON")
+                    return
+                code, body, events = generator.handle_stream(payload)
+                if events is None:
+                    metrics.observe(time.perf_counter() - t0, code)
+                    self._send_json(code, body)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                n_tokens = 0
+                try:
+                    for ev in events:
+                        if "token" in ev:
+                            n_tokens += 1
+                        self.wfile.write(b"data: "
+                                         + json.dumps(ev).encode()
+                                         + b"\n\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass        # client went away mid-stream
+                metrics.observe(time.perf_counter() - t0, 200,
+                                tokens=n_tokens, streamed=True)
 
             do_POST = do_PUT
 

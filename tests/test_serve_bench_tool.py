@@ -1,0 +1,114 @@
+"""tools/serve_bench.py smoke tests against a canned stdlib HTTP stub —
+no model, no jax: the bench must measure and aggregate correctly, and
+its CLI must emit the table and --json forms."""
+
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import serve_bench  # noqa: E402
+
+
+@pytest.fixture()
+def stub_server():
+    """Mimics the /api, /api/stream and /metrics contract with canned
+    responses (every request generates 3 tokens on a 2-token prompt)."""
+    metrics = {"requests": 0, "errors": 0, "throttled": 0}
+
+    class H(BaseHTTPRequestHandler):
+        def _json(self, code, body):
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            metrics["requests"] += 1
+            if self.path == "/api":
+                self._json(200, {"text": ["1 2 9 9 9"],
+                                 "tokens": [[1, 2, 9, 9, 9]]})
+            elif self.path == "/api/stream":
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                for t in (9, 9, 9):
+                    self.wfile.write(
+                        b"data: " + json.dumps({"token": t}).encode()
+                        + b"\n\n")
+                self.wfile.write(
+                    b"data: " + json.dumps(
+                        {"done": True, "finish_reason": "length",
+                         "tokens": [1, 2, 9, 9, 9]}).encode() + b"\n\n")
+            else:
+                metrics["errors"] += 1
+                self._json(404, {"message": "nope"})
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._json(200, dict(metrics))
+            else:
+                self._json(404, {"message": "nope"})
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_run_bench_aggregates(stub_server):
+    r = serve_bench.run_bench(stub_server, clients=3, requests=7, tokens=3)
+    assert r["requests"] == 7 and r["ok"] == 7 and r["errors"] == 0
+    assert r["status_counts"] == {"200": 7}
+    assert r["tokens_total"] == 7 * 5
+    assert r["tokens_per_sec"] > 0 and r["requests_per_sec"] > 0
+    assert r["latency_p50_secs"] is not None
+    assert r["latency_p99_secs"] >= r["latency_p95_secs"] \
+        >= r["latency_p50_secs"]
+    assert r["server_metrics_delta"]["requests"] == 7
+
+
+def test_run_bench_stream_measures_ttft(stub_server):
+    r = serve_bench.run_bench(stub_server, clients=2, requests=4,
+                              tokens=3, stream=True)
+    assert r["ok"] == 4
+    assert r["tokens_total"] == 4 * 3        # streamed tokens only
+    assert r["ttft_mean_secs"] is not None and r["ttft_p50_secs"] >= 0
+
+
+def test_run_bench_poisson_arrivals(stub_server):
+    r = serve_bench.run_bench(stub_server, clients=2, requests=4,
+                              tokens=3, rate=200.0)
+    assert r["ok"] == 4 and r["rate"] == 200.0
+
+
+def test_cli_json_and_table(stub_server, capsys):
+    rc = serve_bench.main(["--url", stub_server, "--clients", "2",
+                           "--requests", "3", "--tokens", "3", "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] == 3
+    rc = serve_bench.main(["--url", stub_server, "--clients", "2",
+                           "--requests", "3", "--tokens", "3"])
+    assert rc == 0
+    table = capsys.readouterr().out
+    assert "latency p95" in table and "throughput" in table
+
+
+def test_percentile_helper():
+    assert serve_bench._percentile([], 0.5) is None
+    assert serve_bench._percentile([3.0], 0.99) == 3.0
+    vals = [float(i) for i in range(1, 101)]
+    assert serve_bench._percentile(vals, 0.50) == pytest.approx(50.0, abs=1)
+    assert serve_bench._percentile(vals, 0.95) == pytest.approx(95.0, abs=1)

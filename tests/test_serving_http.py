@@ -1,0 +1,280 @@
+"""HTTP serving tests against the REAL MegatronServer handler with the
+continuous-batching engine behind it: N concurrent clients all get 200s,
+metrics carry request/error counts and sane percentiles, 400 paths
+return JSON (never a dead socket), admission control returns 429 +
+Retry-After, /api/stream serves SSE, and request logging is gated behind
+--log_requests."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+from megatron_llm_tpu.serving import (
+    EngineConfig,
+    InferenceEngine,
+    SamplingParams,
+)
+from megatron_llm_tpu.text_generation_server import MegatronServer
+
+
+class _FakeTokenizer:
+    vocab_size = 64
+    eod = 63
+    pad = 0
+
+    def tokenize(self, text):
+        return [int(t) % 64 for t in text.split()]
+
+    def detokenize(self, ids):
+        return " ".join(str(i) for i in ids)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = llama_config("tiny", num_layers=2, seq_length=64,
+                       max_position_embeddings=64, padded_vocab_size=64,
+                       use_flash_attn=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def served(model_and_params):
+    """MegatronServer.run (the real handler) on an ephemeral port, with
+    an engine doing the generating."""
+    model, params = model_and_params
+    engine = InferenceEngine(model, params, EngineConfig(
+        num_slots=4, block_size=8, prefill_chunk=16, max_model_len=64,
+        max_queue_depth=32, default_deadline_secs=60.0))
+    engine.warmup()
+    engine.start()
+    server = MegatronServer(model, params, _FakeTokenizer(),
+                            engine=engine, max_prompts=4, max_tokens=32)
+    t = threading.Thread(target=server.run,
+                         kwargs={"host": "127.0.0.1", "port": 0},
+                         daemon=True)
+    t.start()
+    for _ in range(100):
+        if getattr(server, "httpd", None) is not None:
+            break
+        time.sleep(0.05)
+    assert getattr(server, "httpd", None) is not None
+    port = server.httpd.server_address[1]
+    yield server, engine, f"http://127.0.0.1:{port}"
+    server.httpd.shutdown()
+    engine.stop()
+
+
+def _put(url, payload, path="/api"):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(), method="PUT")
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _put_expect_error(url, payload, path="/api"):
+    try:
+        _put(url, payload, path)
+        raise AssertionError("expected HTTPError")
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else None), e.headers
+
+
+def test_concurrent_clients_all_200_and_metrics(served):
+    server, engine, url = served
+    n = 16
+    occ0, dec0 = engine.occupancy_sum, engine.decode_steps
+    results = [None] * n
+
+    def client(i):
+        results[i] = _put(url, {"prompts": [f"{1 + i} 2 3"],
+                                "tokens_to_generate": 12,
+                                "temperature": 0.0, "no_log": True})
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for status, body in results:
+        assert status == 200
+        assert len(body["tokens"]) == 1 and len(body["text"]) == 1
+        assert len(body["tokens"][0]) > 3    # prompt + generated
+    # acceptance: decode batch occupancy > 1 under 16-client load
+    occ = (engine.occupancy_sum - occ0) / max(engine.decode_steps - dec0, 1)
+    assert occ > 1.0, f"no co-batching over HTTP: occupancy {occ}"
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+        m = json.loads(resp.read())
+    assert m["requests"] >= n and m["errors"] == 0
+    assert m["latency_p50_secs"] is not None
+    assert m["latency_p95_secs"] >= m["latency_p50_secs"] > 0
+    # engine counters ride /metrics
+    assert m["engine"]["decode_steps"] > 0
+    assert m["engine"]["mean_batch_occupancy"] > 0
+    assert "queue_depth" in m["engine"]
+
+
+def test_engine_response_matches_legacy_contract_shape(served):
+    _, _, url = served
+    status, body = _put(url, {"prompts": ["5 6 7"],
+                              "tokens_to_generate": 4,
+                              "temperature": 0.0, "no_log": True})
+    assert status == 200
+    assert set(body) == {"text", "segments", "tokens"}
+    row = body["tokens"][0]
+    assert row[:3] == [5, 6, 7]
+    assert body["text"][0] == " ".join(str(t) for t in row)
+    assert body["segments"][0] == [str(t) for t in row]
+
+
+def test_temperature_zero_is_greedy_and_message_fixed(served):
+    """Satellite: temperature 0.0 is an accepted, explicit greedy knob;
+    the rejection message matches the actual range."""
+    _, _, url = served
+    s0, b0 = _put(url, {"prompts": ["5 6 7"], "tokens_to_generate": 6,
+                        "temperature": 0.0, "no_log": True})
+    s1, b1 = _put(url, {"prompts": ["5 6 7"], "tokens_to_generate": 6,
+                        "top_k": 1, "no_log": True})
+    assert s0 == s1 == 200
+    assert b0["tokens"] == b1["tokens"]      # both greedy
+    code, body, _ = _put_expect_error(
+        url, {"prompts": ["1"], "tokens_to_generate": 4,
+              "temperature": -0.5, "no_log": True})
+    assert code == 400
+    assert "[0, 100]" in body["message"]
+    code, body, _ = _put_expect_error(
+        url, {"prompts": ["1"], "tokens_to_generate": 4,
+              "temperature": 101.0, "no_log": True})
+    assert code == 400
+
+
+def test_400_paths_return_json_not_dead_socket(served):
+    _, _, url = served
+    cases = [
+        {"prompts": []},
+        {"prompts": ["1 2"], "top_k": None},
+        {"prompts": ["1 2"], "tokens_to_generate": -1},
+        {"prompts": ["1 2"], "tokens_to_generate": 33},   # > max_tokens=32
+        {"prompts": ["a", "b", "c", "d", "e"]},           # > max_prompts=4
+        {"max_len": 5},
+    ]
+    for payload in cases:
+        code, body, _ = _put_expect_error(url, payload)
+        assert code == 400, payload
+        assert isinstance(body, dict) and "message" in body, payload
+
+
+def test_streaming_sse_over_http(served):
+    _, _, url = served
+    req = urllib.request.Request(
+        url + "/api/stream",
+        data=json.dumps({"prompts": ["5 6 7"], "tokens_to_generate": 6,
+                         "temperature": 0.0, "no_log": True}).encode(),
+        method="PUT")
+    events = []
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        for raw in resp:
+            line = raw.strip()
+            if line.startswith(b"data: "):
+                events.append(json.loads(line[len(b"data: "):]))
+    assert len(events) >= 2                 # incremental chunks + done
+    assert all("token" in e for e in events[:-1])
+    last = events[-1]
+    assert last["done"] and last["finish_reason"] in ("stop", "length")
+    assert last["tokens"][:3] == [5, 6, 7]
+    streamed_ids = [e["token"] for e in events[:-1]]
+    assert last["tokens"][3:] == streamed_ids
+
+
+def test_streaming_multi_prompt_rejected(served):
+    _, _, url = served
+    code, body, _ = _put_expect_error(
+        url, {"prompts": ["1", "2"], "tokens_to_generate": 4,
+              "no_log": True}, path="/api/stream")
+    assert code == 400 and "single prompt" in body["message"]
+
+
+def test_admission_control_429_with_retry_after(model_and_params):
+    """A saturated engine queue maps to HTTP 429 + Retry-After (the
+    engine is never started, so the queue only fills)."""
+    model, params = model_and_params
+    engine = InferenceEngine(model, params, EngineConfig(
+        num_slots=2, block_size=8, prefill_chunk=16, max_model_len=64,
+        max_queue_depth=1))
+    engine.submit([1, 2], SamplingParams(max_new_tokens=4))  # fill queue
+    server = MegatronServer(model, params, _FakeTokenizer(), engine=engine)
+    t = threading.Thread(target=server.run,
+                         kwargs={"host": "127.0.0.1", "port": 0},
+                         daemon=True)
+    t.start()
+    for _ in range(100):
+        if getattr(server, "httpd", None) is not None:
+            break
+        time.sleep(0.05)
+    url = f"http://127.0.0.1:{server.httpd.server_address[1]}"
+    try:
+        code, body, headers = _put_expect_error(
+            url, {"prompts": ["1 2"], "tokens_to_generate": 4,
+                  "no_log": True})
+        assert code == 429
+        assert "message" in body and "retry_after_secs" in body
+        assert int(headers["Retry-After"]) >= 1
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+            m = json.loads(resp.read())
+        assert m["throttled"] == 1
+    finally:
+        server.httpd.shutdown()
+        engine.stop()
+
+
+def test_log_requests_gating(served, capsys):
+    """Satellite: payload logging is off by default, on with
+    --log_requests, and still suppressible per-request via no_log."""
+    server, _, _ = served
+    gen = server.generator
+    payload = {"prompts": ["5 6"], "tokens_to_generate": 2,
+               "temperature": 0.0}
+    assert gen.log_requests is False
+    code, _ = gen.handle(dict(payload))
+    assert code == 200
+    assert json.dumps(payload) not in capsys.readouterr().out
+    gen.log_requests = True
+    try:
+        code, _ = gen.handle(dict(payload))
+        assert code == 200
+        assert '"prompts": ["5 6"]' in capsys.readouterr().out
+        code, _ = gen.handle(dict(payload, no_log=True))
+        assert code == 200
+        assert '"prompts": ["5 6"]' not in capsys.readouterr().out
+    finally:
+        gen.log_requests = False
+
+
+def test_deadline_maps_to_503(model_and_params):
+    """A request whose deadline expires mid-flight is a 503, not a 200
+    with silently truncated output."""
+    model, params = model_and_params
+    engine = InferenceEngine(model, params, EngineConfig(
+        num_slots=2, block_size=8, prefill_chunk=16, max_model_len=64,
+        default_deadline_secs=1e-4))
+    engine.warmup()
+    engine.start()
+    server = MegatronServer(model, params, _FakeTokenizer(), engine=engine)
+    try:
+        code, body = server.generator.handle(
+            {"prompts": ["1 2 3 4"], "tokens_to_generate": 32,
+             "no_log": True})
+        assert code == 503
+        assert "deadline" in body["message"]
+    finally:
+        engine.stop()

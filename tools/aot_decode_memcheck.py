@@ -55,6 +55,9 @@ ROWS = {
 
 def run_row(name: str) -> dict:
     spec = ROWS[name]
+    # off-GCP the metadata server 403s and libtpu retries each variable
+    # 30x with backoff before the topology init can proceed — skip it
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
     import jax
     import jax.numpy as jnp
     from jax.experimental import topologies

@@ -186,6 +186,9 @@ def _abstract_with_shardings(tree, specs, mesh):
 
 def run_config(name: str) -> dict:
     spec = CONFIGS[name]
+    # off-GCP the metadata server 403s and libtpu retries each variable
+    # 30x with backoff before the topology init can proceed — skip it
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
     import jax
     import jax.numpy as jnp
     from jax.experimental import topologies

@@ -65,6 +65,9 @@ SHAPES = {
 def run_trial(label: str, seq: int, mb: int, fused: bool,
               vocab: int = 32000, fused_ce: bool = False,
               shape: str = "bench") -> dict:
+    # off-GCP the metadata server 403s and libtpu retries each variable
+    # 30x with backoff before the topology init can proceed — skip it
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
     import jax
     import jax.numpy as jnp
     from jax.experimental import topologies

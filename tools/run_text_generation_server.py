@@ -62,8 +62,35 @@ def main():
         print(f" int8 weights: {qb/1e6:.1f} MB int8 + {fb/1e6:.1f} MB float")
     params = sh.shard_params(params, specs)
     tokenizer = global_vars.get_tokenizer()
+    engine = None
+    if args.serve_engine:
+        from megatron_llm_tpu.serving import EngineConfig, InferenceEngine
+
+        engine = InferenceEngine(model, params, EngineConfig(
+            num_slots=args.serve_num_slots,
+            block_size=args.serve_block_size,
+            num_blocks=args.serve_num_blocks,
+            max_model_len=args.serve_max_model_len,
+            prefill_chunk=args.serve_prefill_chunk,
+            max_queue_depth=args.serve_max_queue_depth,
+            default_deadline_secs=args.serve_deadline_secs,
+            int8_kv_cache=args.int8_kv_cache,
+        ))
+        print(" * warming up serving engine (compiling prefill/decode "
+              "programs)...", flush=True)
+        engine.warmup()
+        from megatron_llm_tpu import tracing
+        tr = tracing.get_tracing()
+        if tr is not None and tr.recompile is not None:
+            tr.recompile.mark_steady()
+        engine.start()
     MegatronServer(model, params, tokenizer,
-                   int8_kv_cache=args.int8_kv_cache).run(args.host, args.port)
+                   int8_kv_cache=args.int8_kv_cache,
+                   engine=engine,
+                   log_requests=args.log_requests,
+                   max_prompts=args.serve_max_prompts,
+                   max_tokens=args.serve_max_tokens,
+                   ).run(args.host, args.port)
 
 
 if __name__ == "__main__":

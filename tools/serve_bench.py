@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Load generator for the REST text-generation server (stdlib-only).
+
+Drives N concurrent clients against ``PUT /api`` (or ``/api/stream``
+with ``--stream``, which also measures true time-to-first-token), with
+either closed-loop arrivals (each client fires its next request as soon
+as the previous returns) or open-loop Poisson arrivals (``--rate``
+requests/sec across the fleet — the shape real traffic has, and the one
+that exposes queueing).
+
+Reports a latency table (mean/p50/p95/p99), TTFT, token throughput, and
+the server's own /metrics delta; ``--json`` emits one machine-readable
+object instead.
+
+Examples::
+
+    python tools/serve_bench.py --port 5000 --clients 16 --requests 64
+    python tools/serve_bench.py --clients 8 --rate 4 --stream --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def _percentile(values, q: float):
+    if not values:
+        return None
+    s = sorted(values)
+    return s[min(int(q * (len(s) - 1) + 0.5), len(s) - 1)]
+
+
+def _fetch_metrics(base_url: str, timeout: float = 10.0):
+    try:
+        with urllib.request.urlopen(base_url + "/metrics",
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except Exception:
+        return None
+
+
+def _one_request(base_url: str, payload: dict, stream: bool,
+                 timeout: float) -> dict:
+    """Returns {ok, status, secs, ttft_secs, tokens, error?}."""
+    path = "/api/stream" if stream else "/api"
+    req = urllib.request.Request(
+        base_url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="PUT")
+    t0 = time.perf_counter()
+    ttft = None
+    tokens = 0
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            if stream:
+                for raw in resp:
+                    line = raw.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    ev = json.loads(line[len(b"data: "):])
+                    if "token" in ev:
+                        if ttft is None:
+                            ttft = time.perf_counter() - t0
+                        tokens += 1
+                    if ev.get("done"):
+                        break
+            else:
+                body = json.loads(resp.read())
+                ttft = time.perf_counter() - t0
+                toks = body.get("tokens")
+                if isinstance(toks, list):
+                    tokens = sum(len(t) for t in toks
+                                 if isinstance(t, list))
+            return {"ok": True, "status": 200,
+                    "secs": time.perf_counter() - t0,
+                    "ttft_secs": ttft, "tokens": tokens}
+    except urllib.error.HTTPError as e:
+        e.read()
+        return {"ok": False, "status": e.code,
+                "secs": time.perf_counter() - t0, "ttft_secs": None,
+                "tokens": 0, "retry_after": e.headers.get("Retry-After")}
+    except Exception as e:  # noqa: BLE001 - a bench must not die mid-run
+        return {"ok": False, "status": 0,
+                "secs": time.perf_counter() - t0, "ttft_secs": None,
+                "tokens": 0, "error": f"{type(e).__name__}: {e}"}
+
+
+def run_bench(base_url: str, clients: int = 4, requests: int = 16,
+              tokens: int = 32, prompt: str = "1 2 3 4",
+              rate: float = 0.0, stream: bool = False,
+              timeout: float = 300.0, seed: int = 0) -> dict:
+    """Drive the load and aggregate results (importable — the tier-1
+    smoke test calls this directly against an in-process server)."""
+    results = []
+    results_lock = threading.Lock()
+    payload = {"prompts": [prompt], "tokens_to_generate": int(tokens),
+               "no_log": True}
+    n_total = max(int(requests), 1)
+    issued = {"n": 0}
+    issue_lock = threading.Lock()
+    rng = random.Random(seed)
+    start_gate = threading.Event()
+
+    def take_ticket() -> bool:
+        with issue_lock:
+            if issued["n"] >= n_total:
+                return False
+            issued["n"] += 1
+            return True
+
+    def client_loop():
+        start_gate.wait()
+        while take_ticket():
+            if rate > 0:
+                # open-loop Poisson arrivals across the fleet: each
+                # client sleeps an exponential gap scaled by fleet size
+                time.sleep(rng.expovariate(rate / max(clients, 1)))
+            r = _one_request(base_url, payload, stream, timeout)
+            with results_lock:
+                results.append(r)
+
+    m0 = _fetch_metrics(base_url)
+    threads = [threading.Thread(target=client_loop, daemon=True)
+               for _ in range(max(int(clients), 1))]
+    for t in threads:
+        t.start()
+    t_start = time.perf_counter()
+    start_gate.set()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    m1 = _fetch_metrics(base_url)
+
+    ok = [r for r in results if r["ok"]]
+    lat = [r["secs"] for r in ok]
+    ttft = [r["ttft_secs"] for r in ok if r["ttft_secs"] is not None]
+    total_tokens = sum(r["tokens"] for r in ok)
+    by_status = {}
+    for r in results:
+        by_status[str(r["status"])] = by_status.get(str(r["status"]), 0) + 1
+    out = {
+        "url": base_url,
+        "clients": clients,
+        "requests": len(results),
+        "ok": len(ok),
+        "errors": len(results) - len(ok),
+        "status_counts": by_status,
+        "wall_secs": wall,
+        "requests_per_sec": len(ok) / wall if wall > 0 else None,
+        "tokens_total": total_tokens,
+        "tokens_per_sec": total_tokens / wall if wall > 0 else None,
+        "latency_mean_secs": sum(lat) / len(lat) if lat else None,
+        "latency_p50_secs": _percentile(lat, 0.50),
+        "latency_p95_secs": _percentile(lat, 0.95),
+        "latency_p99_secs": _percentile(lat, 0.99),
+        "ttft_mean_secs": sum(ttft) / len(ttft) if ttft else None,
+        "ttft_p50_secs": _percentile(ttft, 0.50),
+        "ttft_p95_secs": _percentile(ttft, 0.95),
+        "stream": stream,
+        "rate": rate,
+    }
+    if m0 is not None and m1 is not None:
+        out["server_metrics_delta"] = {
+            "requests": m1.get("requests", 0) - m0.get("requests", 0),
+            "errors": m1.get("errors", 0) - m0.get("errors", 0),
+            "throttled": m1.get("throttled", 0) - m0.get("throttled", 0),
+        }
+        if isinstance(m1.get("engine"), dict):
+            out["server_engine"] = m1["engine"]
+    return out
+
+
+def _fmt(v, unit=""):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3f}{unit}"
+    return f"{v}{unit}"
+
+
+def print_table(r: dict) -> None:
+    rows = [
+        ("requests (ok/total)", f"{r['ok']}/{r['requests']}"),
+        ("status counts", json.dumps(r["status_counts"])),
+        ("wall time", _fmt(r["wall_secs"], "s")),
+        ("throughput", _fmt(r["requests_per_sec"], " req/s")),
+        ("token throughput", _fmt(r["tokens_per_sec"], " tok/s")),
+        ("latency mean", _fmt(r["latency_mean_secs"], "s")),
+        ("latency p50", _fmt(r["latency_p50_secs"], "s")),
+        ("latency p95", _fmt(r["latency_p95_secs"], "s")),
+        ("latency p99", _fmt(r["latency_p99_secs"], "s")),
+        ("ttft mean", _fmt(r["ttft_mean_secs"], "s")),
+        ("ttft p50", _fmt(r["ttft_p50_secs"], "s")),
+        ("ttft p95", _fmt(r["ttft_p95_secs"], "s")),
+    ]
+    eng = r.get("server_engine")
+    if eng:
+        rows += [
+            ("engine occupancy", _fmt(eng.get("mean_batch_occupancy"))),
+            ("engine decode steps", _fmt(eng.get("decode_steps"))),
+            ("engine prefill chunks", _fmt(eng.get("prefill_chunks"))),
+        ]
+    w = max(len(k) for k, _ in rows)
+    print(f"serve_bench: {r['clients']} clients -> {r['url']}"
+          + (" (stream)" if r["stream"] else ""))
+    for k, v in rows:
+        print(f"  {k:<{w}}  {v}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=5000)
+    p.add_argument("--url", default=None,
+                   help="full base URL (overrides --host/--port)")
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--requests", type=int, default=16,
+                   help="total requests across all clients")
+    p.add_argument("--tokens", type=int, default=32,
+                   help="tokens_to_generate per request")
+    p.add_argument("--prompt", default="1 2 3 4")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="open-loop Poisson arrival rate in req/s across "
+                        "the fleet (0 = closed loop)")
+    p.add_argument("--stream", action="store_true",
+                   help="use /api/stream (measures true TTFT)")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one JSON object instead of the table")
+    args = p.parse_args(argv)
+    base_url = args.url or f"http://{args.host}:{args.port}"
+    r = run_bench(base_url, clients=args.clients, requests=args.requests,
+                  tokens=args.tokens, prompt=args.prompt, rate=args.rate,
+                  stream=args.stream, timeout=args.timeout, seed=args.seed)
+    if args.as_json:
+        print(json.dumps(r, indent=2))
+    else:
+        print_table(r)
+    return 0 if r["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
