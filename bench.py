@@ -48,6 +48,11 @@ def log(msg):
 A100_REFERENCE_MFU = 0.47  # BASELINE.md derivation
 
 
+class _SkipSecondary(Exception):
+    """Control-flow marker: an optional post-primary measurement bows out
+    without being reported as a failure."""
+
+
 def child_main():
     log("child: importing jax")
     import jax  # noqa: E402
@@ -260,7 +265,10 @@ def child_main():
 
     def timed_run(step, params, opt_state, batch, *, max_iters, budget_s,
                   label):
-        """2 warmup steps + adaptive timed loop; returns (dt, iters, loss).
+        """2 warmup steps + adaptive timed loop; returns
+        (dt, iters, loss, params, opt_state) — the returned state handles
+        are the *live* post-step buffers (the inputs are donated away on
+        the first call), so a follow-up measurement can reuse them.
 
         Every sync is a host-side scalar fetch: on the axon remote
         platform ``block_until_ready`` on the first enqueued execution
@@ -297,7 +305,7 @@ def child_main():
         timers(f"{label}-measure").stop()
         dt = (time.perf_counter() - t0) / iters
         log(f"child: {label}: timed {iters} iters, {dt*1000:.1f} ms/iter")
-        return dt, iters, loss
+        return dt, iters, loss, params, opt_state
 
     toks = jnp.asarray(rng.randint(0, cfg.padded_vocab_size,
                                    (num_micro, micro_batch, seq)))
@@ -307,9 +315,10 @@ def child_main():
         "loss_mask": jnp.ones_like(toks, jnp.float32),
     }
     log("child: compiling train step (first call)")
-    dt, iters, loss = timed_run(step, params, opt_state, batch,
-                                max_iters=30 if on_tpu else 3,
-                                budget_s=20.0, label="primary")
+    dt, iters, loss, params, opt_state = timed_run(
+        step, params, opt_state, batch,
+        max_iters=30 if on_tpu else 3,
+        budget_s=20.0, label="primary")
     # per-phase report via the same Timers subsystem the train loop logs
     # with (megatron_llm_tpu/timers.py)
     timers.log(printer=lambda s: log(f"child: {s}"))
@@ -366,7 +375,45 @@ def child_main():
     # emit the PRIMARY result immediately — if the optional secondary
     # below hangs into the parent deadline, this artifact is already on
     # stdout (the parent takes the last JSON line it finds)
+    rec["layer_stats_overhead_pct"] = None
     print(json.dumps(rec), flush=True)
+
+    # model-health observatory overhead (health.py): the same step with
+    # per-layer stats enabled, timed under the identical sync protocol.
+    # The stats are computed every iteration here (the host fetch at
+    # --log_layer_stats_interval is off the measured path), so this is an
+    # upper bound on the interval-10 cost.  A regression >= 3% ms/iter on
+    # real hardware is a hard failure — the observatory must never
+    # silently tax the hot path.
+    # (skipped on the pure-CPU fallback child: that path exists to salvage
+    # a number from a broken TPU env and must not spend a second compile)
+    try:
+        if not on_tpu:
+            raise _SkipSecondary
+        log("child: layer-stats overhead measurement")
+        step_ls = build_train_step(model, opt, pc, num_micro,
+                                   log_layer_stats=True)
+        dt_ls, _, _, params, opt_state = timed_run(
+            step_ls, params, opt_state, batch,
+            max_iters=30, budget_s=10.0, label="layer-stats")
+        overhead_pct = (dt_ls - dt) / dt * 100.0
+        rec["layer_stats_overhead_pct"] = round(overhead_pct, 2)
+        log(f"child: layer-stats overhead {overhead_pct:+.2f}% ms/iter "
+            f"({dt_ls*1000:.1f} vs {dt*1000:.1f})")
+        print(json.dumps(rec), flush=True)
+        if on_tpu and not simulate and overhead_pct >= 3.0:
+            log(f"child: LAYER_STATS_OVERHEAD_REGRESSION "
+                f"{overhead_pct:.2f}% >= 3% — fix health.py before "
+                f"shipping (the BENCH record above already carries the "
+                f"number)")
+            sys.exit(4)
+    except SystemExit:
+        raise
+    except _SkipSecondary:
+        log("child: cpu fallback — layer-stats overhead not measured")
+    except Exception as e:
+        log(f"child: layer-stats overhead measurement failed (primary "
+            f"unaffected): {type(e).__name__}: {str(e)[:150]}")
 
     # secondary measurement at seq 2048 (the rounds-3/4 primary shape,
     # kept for cross-round comparability now that the primary is the
@@ -392,9 +439,9 @@ def child_main():
                                          (1, mb2, sec_seq)))
             b2 = {"tokens": t2, "labels": jnp.roll(t2, -1, axis=-1),
                   "loss_mask": jnp.ones_like(t2, jnp.float32)}
-            dt2, it2, _ = timed_run(step2, params2, os2, b2,
-                                    max_iters=10, budget_s=10.0,
-                                    label="seq2048")
+            dt2, it2, _, _, _ = timed_run(step2, params2, os2, b2,
+                                          max_iters=10, budget_s=10.0,
+                                          label="seq2048")
             tps2 = mb2 * sec_seq / dt2
             mfu2 = tps2 * model2.flops_per_token() / peak if peak else None
             if mfu2 is not None and mfu2 > MFU_SANITY_LIMIT:

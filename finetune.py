@@ -501,8 +501,9 @@ def main():
         from megatron_llm_tpu.parallel.pipeline import (
             build_pipeline_train_step,
         )
-        custom_step = build_pipeline_train_step(model, optimizer, pc,
-                                                num_micro)
+        custom_step = build_pipeline_train_step(
+            model, optimizer, pc, num_micro,
+            layer_stats=args.log_layer_stats_interval > 0)
         opt_state = opt_state or optimizer.init(params)
     from megatron_llm_tpu.timers import Timers
 
@@ -555,6 +556,7 @@ def main():
                           log_option=args.timing_log_option),
             log_params_norm=args.log_params_norm,
             log_num_zeros_in_grad=args.log_num_zeros_in_grad,
+            log_layer_stats_interval=args.log_layer_stats_interval,
             writer=writer,
             tensorboard_log_interval=args.tensorboard_log_interval,
             log_memory=args.log_memory_to_tensorboard,

@@ -328,6 +328,14 @@ def _add_logging_args(parser):
                    choices=["max", "minmax", "all"])
     g.add_argument("--log_params_norm", action="store_true")
     g.add_argument("--log_num_zeros_in_grad", action="store_true")
+    g.add_argument("--log_layer_stats_interval", type=int, default=0,
+                   help="model-health observatory (health.py): every N "
+                        "iterations emit per-layer grad/param/update L2 "
+                        "norms + non-finite grad counts, computed on-"
+                        "device inside the jitted step (fixed shape, zero "
+                        "steady-state recompiles), into JSONL/TensorBoard/"
+                        "flight recorder; a NaN/spike rewind then names "
+                        "the offending layers. 0 (default) disables")
     g.add_argument("--log_batch_size_to_tensorboard", action="store_true")
     g.add_argument("--log_memory_to_tensorboard", action="store_true")
     g.add_argument("--log_world_size_to_tensorboard", action="store_true")

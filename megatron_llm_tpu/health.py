@@ -1,0 +1,246 @@
+"""Model-health observatory: per-layer gradient/update/parameter diagnostics.
+
+The train step can optionally emit a fixed-shape per-group stats pytree —
+one slot per top-level param subtree (embedding, each transformer layer,
+final norm, lm head): grad L2 norm, param L2 norm, update L2 norm and
+non-finite grad count. Everything here reduces on-device inside the
+already-jitted step: the stats are `[G]` arrays whose length depends only
+on the param tree structure, so enabling them adds exactly one fixed-shape
+output and zero steady-state recompiles.
+
+Grouping is by pytree path (the same `jax.tree_util` path keys the
+optimizer's weight-decay mask uses), so model refactors that keep the
+top-level layout — ``embedding`` / ``transformer.layers`` (stacked, leading
+axis = layer) / ``transformer.final_norm`` / ``lm_head`` — keep their
+group names, and unknown top-level subtrees degrade to their own group
+instead of breaking.
+
+Note on pipeline parallelism with interleaved (vpp) schedules: the stacked
+``layers`` leaves are laid out stage-major, so ``layer_003`` names the
+fourth stacked row, which is not the fourth layer in execution order. With
+``vpp`` unset (or 1) row order equals layer order.
+
+Host-side helpers (`to_record`, `find_offenders`, `describe_offenders`)
+turn a fetched stats dict into the JSONL record shape and into a human
+diagnosis ("first group with non-finite grads", grad-norm outliers vs. the
+median) used by the resilience rewind path and `tools/health_report.py`.
+"""
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Update-to-weight ratios outside this band usually mean the LR is badly
+# tuned for that tensor (too small: frozen; too large: thrashing). Shared
+# convention with tools/health_report.py (stdlib-only, so it keeps its own
+# copy of the numbers).
+UPDATE_RATIO_BAND = (1e-4, 1e-2)
+
+LAYER_GROUP_FMT = "layer_{:03d}"
+
+
+def _path_names(path) -> List[str]:
+    return [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+
+
+def _classify(path) -> Tuple[bool, str]:
+    """Map a pytree path to (is_stacked_layers, group_name).
+
+    Stacked transformer layers (any path passing through a ``layers`` key)
+    report per-leading-axis-row stats; every other leaf folds into a group
+    named after its most specific stable ancestor.
+    """
+    names = _path_names(path)
+    if not names:
+        return False, "params"
+    if "layers" in names:
+        return True, "layers"
+    if names[0] == "transformer":
+        return False, names[1] if len(names) > 1 else "transformer"
+    return False, names[0]
+
+
+def layer_group_names(params) -> List[str]:
+    """Deterministic group names for a param tree: ``embedding`` first (when
+    present), then one ``layer_NNN`` per stacked transformer-layer row, then
+    the remaining top-level groups in flatten order (``final_norm``,
+    ``lm_head``, ...)."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    num_layers = 0
+    others: List[str] = []
+    for path, leaf in leaves:
+        stacked, g = _classify(path)
+        if stacked:
+            num_layers = max(num_layers, int(leaf.shape[0]))
+        elif g not in others:
+            others.append(g)
+    names: List[str] = []
+    if "embedding" in others:
+        names.append("embedding")
+        others.remove("embedding")
+    names.extend(LAYER_GROUP_FMT.format(i) for i in range(num_layers))
+    names.extend(others)
+    return names
+
+
+def _layer_slot(names: Sequence[str]) -> int:
+    first = LAYER_GROUP_FMT.format(0)
+    return names.index(first) if first in names else len(names)
+
+
+def _group_sumsq(tree, names: Sequence[str]) -> jnp.ndarray:
+    """Per-group sum of squares, [G] fp32."""
+    start = _layer_slot(names)
+    acc = jnp.zeros((len(names),), dtype=jnp.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        stacked, g = _classify(path)
+        x = jnp.square(leaf.astype(jnp.float32))
+        if stacked:
+            rows = jnp.sum(x, axis=tuple(range(1, x.ndim)))
+            acc = acc.at[start:start + leaf.shape[0]].add(rows)
+        else:
+            acc = acc.at[names.index(g)].add(jnp.sum(x))
+    return acc
+
+
+def _group_nonfinite(tree, names: Sequence[str]) -> jnp.ndarray:
+    """Per-group count of non-finite entries, [G] int32."""
+    start = _layer_slot(names)
+    acc = jnp.zeros((len(names),), dtype=jnp.int32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        stacked, g = _classify(path)
+        bad = (~jnp.isfinite(leaf.astype(jnp.float32))).astype(jnp.int32)
+        if stacked:
+            rows = jnp.sum(bad, axis=tuple(range(1, bad.ndim)))
+            acc = acc.at[start:start + leaf.shape[0]].add(rows)
+        else:
+            acc = acc.at[names.index(g)].add(jnp.sum(bad))
+    return acc
+
+
+def compute_layer_stats(params, grads, updates=None) -> Dict[str, jnp.ndarray]:
+    """On-device per-group stats for one optimizer step.
+
+    All inputs share the param tree structure; `grads` should be the
+    unscaled, pre-clip gradients (so grad norms partition the global grad
+    norm) and `updates` the applied master-weight delta (zero on a skipped
+    overflow step). Returns fixed-shape `[G]` arrays:
+
+      grad_norm, param_norm, update_norm (fp32), nonfinite_grads (int32)
+
+    in `layer_group_names(params)` order. Differentiation-free; safe to
+    call inside jit (and inside pipeline-sharded steps: the accumulating
+    scatter-adds reduce sharded layer rows under GSPMD like any other
+    reduction).
+    """
+    names = layer_group_names(params)
+    stats = {
+        "grad_norm": jnp.sqrt(_group_sumsq(grads, names)),
+        "param_norm": jnp.sqrt(_group_sumsq(params, names)),
+        "nonfinite_grads": _group_nonfinite(grads, names),
+    }
+    if updates is not None:
+        stats["update_norm"] = jnp.sqrt(_group_sumsq(updates, names))
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# Host-side: JSONL records and offender diagnosis.
+# ---------------------------------------------------------------------------
+
+def to_record(names: Sequence[str], stats) -> Dict[str, Any]:
+    """Fetched stats dict -> the JSONL / flight-recorder record shape.
+
+    `stats` values are host arrays (post `jax.device_get`). Non-finite
+    floats become the strings "nan"/"inf"/"-inf" so the record stays plain
+    JSON. Adds the derived per-group update-to-weight ratio.
+    """
+    def _num(x):
+        x = float(x)
+        if math.isfinite(x):
+            return x
+        return "nan" if math.isnan(x) else ("inf" if x > 0 else "-inf")
+
+    rec: Dict[str, Any] = {"groups": list(names)}
+    for key in ("grad_norm", "param_norm", "update_norm"):
+        if key in stats:
+            rec[key] = [_num(v) for v in stats[key]]
+    if "nonfinite_grads" in stats:
+        rec["nonfinite_grads"] = [int(v) for v in stats["nonfinite_grads"]]
+    if "update_norm" in rec and "param_norm" in rec:
+        ratios = []
+        for u, p in zip(rec["update_norm"], rec["param_norm"]):
+            if isinstance(u, str) or isinstance(p, str) or p <= 0.0:
+                ratios.append(None)
+            else:
+                ratios.append(u / p)
+        rec["update_ratio"] = ratios
+    return rec
+
+
+def record_value(rec_val) -> float:
+    """Inverse of to_record's non-finite string encoding."""
+    if isinstance(rec_val, str):
+        return {"nan": math.nan, "inf": math.inf, "-inf": -math.inf}.get(
+            rec_val, math.nan)
+    return float(rec_val)
+
+
+def derived_params_norm(record: Dict[str, Any]) -> float:
+    """Global params norm from the per-group partition of sum-of-squares —
+    exact (up to fp rounding), so --log_params_norm needs no second
+    whole-tree reduction when layer stats are on."""
+    return math.sqrt(sum(record_value(v) ** 2
+                         for v in record.get("param_norm", [])))
+
+
+def find_offenders(record: Dict[str, Any], top_k: int = 3,
+                   outlier_factor: float = 4.0) -> Dict[str, Any]:
+    """Diagnose a layer-stats record: which groups look responsible?
+
+    Returns {"first_nonfinite", "nonfinite" (all such groups),
+    "outliers": [{"group", "grad_norm", "ratio_to_median"}] (top_k, only
+    groups whose finite grad norm exceeds outlier_factor x the median)}.
+    """
+    groups = record.get("groups", [])
+    nf = record.get("nonfinite_grads") or [0] * len(groups)
+    gn = [record_value(v) for v in record.get("grad_norm", [])]
+    nonfinite = [g for g, n in zip(groups, nf) if n > 0]
+    finite = sorted(v for v in gn if math.isfinite(v))
+    outliers: List[Dict[str, Any]] = []
+    if finite:
+        mid = len(finite) // 2
+        median = (finite[mid] if len(finite) % 2 else
+                  0.5 * (finite[mid - 1] + finite[mid]))
+        if median > 0.0:
+            ranked = sorted(
+                ((v / median, g, v) for g, v in zip(groups, gn)
+                 if math.isfinite(v) and v > outlier_factor * median),
+                reverse=True)
+            outliers = [{"group": g, "grad_norm": v, "ratio_to_median": r}
+                        for r, g, v in ranked[:top_k]]
+    return {
+        "first_nonfinite": nonfinite[0] if nonfinite else None,
+        "nonfinite": nonfinite,
+        "outliers": outliers,
+    }
+
+
+def describe_offenders(offenders: Dict[str, Any]) -> Optional[str]:
+    """One-line human summary for rewind logs / flight-recorder dump
+    reasons; None when nothing looks wrong."""
+    parts = []
+    nonfinite = offenders.get("nonfinite") or []
+    if nonfinite:
+        shown = ", ".join(nonfinite[:4])
+        more = f" (+{len(nonfinite) - 4} more)" if len(nonfinite) > 4 else ""
+        parts.append(f"non-finite grads in [{shown}{more}], "
+                     f"first: {offenders['first_nonfinite']}")
+    outliers = offenders.get("outliers") or []
+    if outliers:
+        shown = ", ".join(f"{o['group']} ({o['ratio_to_median']:.1f}x median)"
+                          for o in outliers)
+        parts.append(f"grad-norm outliers: {shown}")
+    return "; ".join(parts) if parts else None

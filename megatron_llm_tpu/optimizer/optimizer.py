@@ -21,6 +21,7 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from megatron_llm_tpu import health
 from megatron_llm_tpu.config import TrainConfig
 from megatron_llm_tpu.optimizer.grad_scaler import (
     ConstantGradScaler,
@@ -138,12 +139,18 @@ class MegatronOptimizer:
         state: OptimizerState,
         lr,
         weight_decay: Optional[float] = None,
+        *,
+        layer_stats: bool = False,
     ):
         """One optimizer step.  ``grads`` are the *scaled* grads in fp32
         (the train step multiplies the loss by the current scale).
 
         Returns (new_params, new_state, stats) with stats =
-        {'grad_norm', 'found_inf', 'loss_scale'}.
+        {'grad_norm', 'found_inf', 'loss_scale'}; with ``layer_stats``
+        also 'layer_stats': fixed-shape per-group [G] arrays from
+        ``health.compute_layer_stats`` (grad norms over the unscaled
+        pre-clip grads so they partition 'grad_norm'; update norms over
+        the applied master delta, zero on an overflow-skipped step).
         """
         cfg = self.cfg
         wd = cfg.weight_decay if weight_decay is None else weight_decay
@@ -160,6 +167,7 @@ class MegatronOptimizer:
         found_inf = ~finite
 
         # global-norm clip (reference: clip_grads.py:16-107)
+        unclipped_grads = grads
         grad_norm = global_grad_norm(grads)
         if cfg.clip_grad > 0.0:
             clip_coeff = jnp.minimum(1.0, cfg.clip_grad / (grad_norm + 1.0e-6))
@@ -248,6 +256,14 @@ class MegatronOptimizer:
             "found_inf": found_inf,
             "loss_scale": scale,
         }
+        if layer_stats:
+            updates = jax.tree_util.tree_map(
+                lambda n, o: n.astype(jnp.float32) - o.astype(jnp.float32),
+                new_masters, masters,
+            )
+            stats["layer_stats"] = health.compute_layer_stats(
+                masters, unclipped_grads, updates
+            )
         return new_params, new_state, stats
 
     # ------------------------------------------------------------------

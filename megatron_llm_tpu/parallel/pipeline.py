@@ -694,6 +694,7 @@ def build_pipeline_train_step(
     num_microbatches: int,
     *,
     schedule: Optional[str] = None,
+    layer_stats: bool = False,
 ):
     """Pipelined analogue of ``training.build_train_step``: full global batch
     through the pipeline, then the functional optimizer step.
@@ -701,6 +702,14 @@ def build_pipeline_train_step(
     ``schedule``: '1f1b' (manual backward, O(S) activation stash; V=1 only)
     or 'stream' (autodiff engine, supports VPP).  Default: 1f1b when
     vpp==1, stream otherwise.
+
+    ``layer_stats`` threads the model-health observatory (``health.py``)
+    through both schedules: the grads the pipeline grad fn returns are a
+    full (pp-sharded) param-tree pytree at the top level of the jitted
+    step, so the per-group reductions run under GSPMD exactly like the
+    single-program path and ``metrics['layer_stats']`` matches it.  NB
+    with interleaved VPP the stacked-layer rows are stage-major, so the
+    ``layer_NNN`` group names index stacked rows, not execution order.
     """
     pp = parallel_cfg.pipeline_model_parallel_size
     vpp = parallel_cfg.virtual_pipeline_model_parallel_size or 1
@@ -728,7 +737,7 @@ def build_pipeline_train_step(
             out = grad_fn(params, batch, rng_key, scale)
             loss, grads = out[0], out[1]
             new_params, new_opt_state, stats = optimizer.step(
-                params, grads, opt_state, lr, wd
+                params, grads, opt_state, lr, wd, layer_stats=layer_stats
             )
             metrics = {
                 "lm loss": loss,
@@ -736,6 +745,8 @@ def build_pipeline_train_step(
                 "loss_scale": stats["loss_scale"],
                 "skipped_iter": stats["found_inf"].astype(jnp.int32),
             }
+            if layer_stats:
+                metrics["layer_stats"] = stats["layer_stats"]
             if moe_on:
                 moe_metrics(metrics, out[2])
             return new_params, new_opt_state, metrics
@@ -758,7 +769,7 @@ def build_pipeline_train_step(
         loss, moe_aux = lfaux if moe_on else (lfaux, None)
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         new_params, new_opt_state, stats = optimizer.step(
-            params, grads, opt_state, lr, wd
+            params, grads, opt_state, lr, wd, layer_stats=layer_stats
         )
         metrics = {
             "lm loss": loss,
@@ -766,6 +777,8 @@ def build_pipeline_train_step(
             "loss_scale": stats["loss_scale"],
             "skipped_iter": stats["found_inf"].astype(jnp.int32),
         }
+        if layer_stats:
+            metrics["layer_stats"] = stats["layer_stats"]
         if moe_on:
             moe_metrics(metrics, moe_aux)
         return new_params, new_opt_state, metrics

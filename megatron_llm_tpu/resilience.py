@@ -384,6 +384,11 @@ class ResilienceManager:
         self._bad_streak = 0
         self._rewinds = 0
         self._snapshot: Optional[_Snapshot] = None
+        # latest model-health record (health.to_record shape) + iteration,
+        # fed by the driver when --log_layer_stats_interval is on; lets a
+        # rewind name the offending layers instead of just "non-finite loss"
+        self._layer_stats: Optional[dict] = None
+        self._layer_stats_iteration: Optional[int] = None
         if injector is not None:
             set_save_fault_hook(injector.maybe_fail_save)
 
@@ -457,6 +462,28 @@ class ResilienceManager:
                          else b * self._ema + (1.0 - b) * loss)
         return bad
 
+    def observe_layer_stats(self, iteration: int, record: dict,
+                            announce: bool = False) -> None:
+        """Store the latest per-layer health record (``health.to_record``
+        shape).  With ``announce`` (the driver sets it on a bad check),
+        print the offender diagnosis right next to the bad-step line so the
+        console names suspects before any rewind happens."""
+        self._layer_stats = record
+        self._layer_stats_iteration = iteration
+        if announce:
+            desc = self._offender_summary()
+            if desc is not None:
+                print(f" [resilience] suspect layers at iteration "
+                      f"{iteration}: {desc}", flush=True)
+
+    def _offender_summary(self) -> Optional[str]:
+        if self._layer_stats is None:
+            return None
+        from megatron_llm_tpu import health
+
+        return health.describe_offenders(
+            health.find_offenders(self._layer_stats))
+
     def should_rewind(self) -> bool:
         return (self.rewind_enabled
                 and self._snapshot is not None
@@ -508,10 +535,31 @@ class ResilienceManager:
         if batch_iterator is not None:
             for _ in range(self.config.skip_data_batches):
                 next(batch_iterator)
+        suspects = self._offender_summary()
         print(f" [resilience] rewind #{self._rewinds} -> iteration "
               f"{snap.iteration} (lr_scale={self.lr_scale:g}); the "
-              f"offending data window is skipped (iterator moves forward)",
+              f"offending data window is skipped (iterator moves forward)"
+              + (f"; suspect layers: {suspects}" if suspects else ""),
               flush=True)
+        if self._layer_stats is not None:
+            # leave the forensic trail: a "health" record in the flight
+            # recorder (carrying the full per-layer stats of the bad step)
+            # and a dump whose reason names the suspects
+            from megatron_llm_tpu import health, telemetry
+
+            fr = telemetry.get_flight_recorder()
+            if fr is not None:
+                fr.record({
+                    "kind": "health",
+                    "time_unix": time.time(),
+                    "iteration": self._layer_stats_iteration,
+                    "rewind": self._rewinds,
+                    "offenders": health.find_offenders(self._layer_stats),
+                    "layer_stats": self._layer_stats,
+                })
+            telemetry.dump_flight_recorder(
+                reason=f"rewind #{self._rewinds}"
+                       + (f": {suspects}" if suspects else ""))
         return params, opt_state, snap.iteration
 
     # -- watchdog wiring ----------------------------------------------------

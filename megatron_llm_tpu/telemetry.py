@@ -229,7 +229,10 @@ def device_memory_stats(device=None) -> Dict[str, int]:
 # Structured JSONL stream
 # ---------------------------------------------------------------------------
 
-TELEMETRY_SCHEMA_VERSION = 2  # 2: + interval_time_secs / goodput / tracing
+# 2: + interval_time_secs / goodput / tracing
+# 3: + layer_stats (per-group grad/param/update norms, non-finite counts —
+#    see health.py) on records at --log_layer_stats_interval boundaries
+TELEMETRY_SCHEMA_VERSION = 3
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 

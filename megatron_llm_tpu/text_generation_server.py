@@ -107,6 +107,51 @@ class ServerMetrics:
         return out
 
 
+def prometheus_exposition(snapshot: dict,
+                          prefix: str = "megatron_serve_") -> str:
+    """Render a ``ServerMetrics.snapshot()`` dict as Prometheus text
+    exposition format (0.0.4) so standard scrapers can hit ``/metrics``
+    without a JSON-translating sidecar.  Nested dicts (the ``engine``
+    block, its per-reason completion counts) flatten into underscore-
+    joined names; None values (e.g. empty-window percentiles) are
+    omitted; everything is exported as a gauge — the scraper cannot tell
+    a monotone counter from a level, and gauge is always safe."""
+    lines = []
+
+    def emit(name, value):
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return
+        name = "".join(c if (c.isalnum() and c.isascii()) or c == "_"
+                       else "_" for c in name)
+        if name and name[0].isdigit():
+            name = "_" + name
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {float(value):g}")
+
+    def walk(d, path):
+        for k, v in sorted(d.items()):
+            if isinstance(v, dict):
+                walk(v, f"{path}{k}_")
+            else:
+                emit(f"{path}{k}", v)
+
+    walk(snapshot, prefix)
+    return "\n".join(lines) + "\n"
+
+
+def _wants_prometheus(path: str, accept: str) -> bool:
+    """Content negotiation for /metrics: an explicit ?format=prometheus
+    query wins; otherwise an Accept header preferring text/plain (what
+    the Prometheus scraper sends) selects the text exposition."""
+    query = path.partition("?")[2]
+    for pair in query.split("&"):
+        if pair.partition("=")[::2] == ("format", "prometheus"):
+            return True
+    accept = accept.lower()
+    return ("text/plain" in accept or "openmetrics" in accept) \
+        and "application/json" not in accept
+
+
 def _count_tokens(body: dict) -> int:
     """Generated-token count from a successful /api response body (the
     token lists include the prompt; this is a serving throughput gauge,
@@ -515,8 +560,21 @@ class MegatronServer:
                     self._send_json(200, {"status": "ok",
                                           "uptime_secs": time.time()
                                           - metrics.started_unix})
-                elif self.path == "/metrics":
-                    self._send_json(200, metrics.snapshot())
+                elif self.path == "/metrics" \
+                        or self.path.startswith("/metrics?"):
+                    snap = metrics.snapshot()
+                    if _wants_prometheus(self.path,
+                                         self.headers.get("Accept", "")):
+                        data = prometheus_exposition(snap).encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                    else:
+                        self._send_json(200, snap)
                 else:
                     self.send_error(404)
 

@@ -28,11 +28,17 @@ import jax.numpy as jnp
 from megatron_llm_tpu.config import TrainConfig, TransformerConfig, ParallelConfig
 from megatron_llm_tpu.optimizer import MegatronOptimizer, OptimizerParamScheduler
 from megatron_llm_tpu.optimizer.optimizer import global_grad_norm
+from megatron_llm_tpu import health
 from megatron_llm_tpu import random as mrandom
 from megatron_llm_tpu import tracing
 from megatron_llm_tpu.global_vars import get_counters
 
 logger = logging.getLogger("megatron_llm_tpu")
+
+# --log_params_norm without layer stats re-reduces the whole param tree at
+# every log boundary; jit once so it compiles a single cached program
+# instead of retracing op-by-op eagerly each time
+_params_norm_jit = jax.jit(global_grad_norm)
 
 
 def average_losses_across_data_parallel_group(losses):
@@ -55,6 +61,7 @@ def build_train_step(
     loss_func: Callable = default_loss_func,
     forward_only: bool = False,
     log_num_zeros_in_grad: bool = False,
+    log_layer_stats: bool = False,
 ):
     """Compile one global training step.
 
@@ -142,7 +149,7 @@ def build_train_step(
             body, zeros, (batch, jnp.arange(num_microbatches))
         )
         new_params, new_opt_state, stats = optimizer.step(
-            params, grads, opt_state, lr, wd
+            params, grads, opt_state, lr, wd, layer_stats=log_layer_stats
         )
         metrics = {
             "lm loss": jnp.mean(losses),
@@ -150,6 +157,11 @@ def build_train_step(
             "loss_scale": stats["loss_scale"],
             "skipped_iter": stats["found_inf"].astype(jnp.int32),
         }
+        if log_layer_stats:
+            # fixed-shape [G] arrays — one extra fused output, no shape
+            # dependence on anything but the param tree, so steady state
+            # stays zero-recompile
+            metrics["layer_stats"] = stats["layer_stats"]
         if log_num_zeros_in_grad:   # reference --log_num_zeros_in_grad
             metrics["num zeros"] = sum(
                 jnp.sum(g == 0.0)
@@ -274,6 +286,7 @@ def pretrain(
     save_fn=None,
     log_params_norm: bool = False,
     log_num_zeros_in_grad: bool = False,
+    log_layer_stats_interval: int = 0,
     writer=None,
     tensorboard_log_interval: int = 1,
     async_save: bool = False,
@@ -318,6 +331,13 @@ def pretrain(
     rolling host snapshots, NaN/spike detection at check boundaries with
     rewind, and the hang watchdog around dispatch/sync.  All of it is
     host-side — the jitted step is untouched.
+
+    ``log_layer_stats_interval`` (reference-free; see ``health.py``) arms
+    the model-health observatory: the train step emits per-group
+    grad/param/update norms + non-finite grad counts on-device, the host
+    fetches them at log boundaries (feeding --log_params_norm and the
+    resilience NaN localizer) and emits the full record into JSONL /
+    TensorBoard every ``interval`` iterations.
 
     ``telemetry`` (a ``telemetry.Telemetry``) carries the observability
     runtime: throughput/MFU accounting at log boundaries, the structured
@@ -382,6 +402,7 @@ def pretrain(
         train_step = build_train_step(
             model, optimizer, parallel_cfg, num_micro, loss_func,
             log_num_zeros_in_grad=log_num_zeros_in_grad,
+            log_layer_stats=log_layer_stats_interval > 0,
         )
     eval_step = (
         build_train_step(model, optimizer, parallel_cfg, num_micro, loss_func,
@@ -401,6 +422,15 @@ def pretrain(
     # (mutable cell because _save below also accumulates into it)
     non_train = [0.0]
     skip_step = None  # forward-only step, compiled lazily on first skip
+    ls_names = None   # health group names, resolved on first stats fetch
+
+    def _layer_stats_record(ls_dev):
+        """device stats dict -> host JSONL record ({groups, grad_norm,
+        param_norm, update_norm, update_ratio, nonfinite_grads})."""
+        nonlocal ls_names
+        if ls_names is None:
+            ls_names = health.layer_group_names(params)
+        return health.to_record(ls_names, jax.device_get(ls_dev))
 
     injector = resilience.injector if resilience is not None else None
     watchdog = resilience.watchdog if resilience is not None else None
@@ -551,6 +581,13 @@ def pretrain(
                 bad = resilience.record_metrics(
                     iteration, loss_val,
                     None if gn is None else float(gn))
+                if bad and "layer_stats" in metrics:
+                    # NaN localization: hand the sentinel this step's
+                    # per-group stats so the rewind names the offenders
+                    resilience.observe_layer_stats(
+                        iteration,
+                        _layer_stats_record(metrics["layer_stats"]),
+                        announce=True)
                 if bad and resilience.should_rewind():
                     if watchdog is not None:
                         watchdog.pause()
@@ -563,9 +600,35 @@ def pretrain(
                     continue
 
             if at_log_boundary:
+                ls_host = None
+                if "layer_stats" in metrics:
+                    # pop before the float() conversion below — the [G]
+                    # arrays are fetched once here (a few KB, no extra
+                    # device work) and fan out to params norm, resilience,
+                    # TensorBoard and the JSONL record
+                    metrics = dict(metrics)
+                    ls_host = _layer_stats_record(metrics.pop("layer_stats"))
+                    if resilience is not None:
+                        resilience.observe_layer_stats(iteration, ls_host)
+                at_stats_boundary = bool(
+                    ls_host is not None and log_layer_stats_interval
+                    and iteration % log_layer_stats_interval == 0)
                 if log_params_norm:     # reference --log_params_norm
                     metrics = dict(metrics)
-                    metrics["params norm"] = global_grad_norm(params)
+                    if ls_host is not None:
+                        # the per-group norms partition the sum of squares
+                        # — derive the global norm on host instead of
+                        # re-reducing the whole tree on device
+                        metrics["params norm"] = health.derived_params_norm(
+                            ls_host)
+                    else:
+                        if recompile is not None:
+                            # first use compiles the cached standalone
+                            # reduction — expected, not a recompile
+                            recompile.pause()
+                        metrics["params norm"] = _params_norm_jit(params)
+                        if recompile is not None:
+                            recompile.resume()
                 timers("train-step-sync", log_level=1).start()
                 with tracing.span("step_sync", "step", iteration=iteration):
                     jax.block_until_ready(metrics["lm loss"])
@@ -612,6 +675,21 @@ def pretrain(
                             use_writer.add_scalar(
                                 "mem-num-allocs",
                                 stats["num_allocs"], iteration)
+                    if at_stats_boundary:
+                        # grouped scalars: layer_stats/<stat>/<group>
+                        ur = ls_host.get("update_ratio")
+                        for i, g in enumerate(ls_host["groups"]):
+                            for key in ("grad_norm", "param_norm",
+                                        "update_norm"):
+                                if key in ls_host:
+                                    use_writer.add_scalar(
+                                        f"layer_stats/{key}/{g}",
+                                        health.record_value(ls_host[key][i]),
+                                        iteration)
+                            if ur is not None and ur[i] is not None:
+                                use_writer.add_scalar(
+                                    f"layer_stats/update_ratio/{g}",
+                                    ur[i], iteration)
                 log_metrics = {k: float(v) for k, v in metrics.items()}
                 if resilience is not None:
                     from megatron_llm_tpu.resilience import recovery_counters
@@ -654,6 +732,8 @@ def pretrain(
                             counters.get("recompiles", 0))
                         rec["straggler_events"] = int(
                             counters.get("straggler_events", 0))
+                    if at_stats_boundary:
+                        rec["layer_stats"] = ls_host
                     stream.emit(rec)
                 # one snapshot feeds writer + console; the old
                 # write()-then-log() pair double-read (and could

@@ -74,6 +74,9 @@ def _check_goodput_fields(rec):
     assert rec["compile_secs"] >= 0.0
     assert rec["recompiles"] == 0
     assert rec["straggler_events"] == 0
+    # the layer-stats secondary ran (simulated TPU branch): overhead is a
+    # measured number, not the None placeholder
+    assert isinstance(rec["layer_stats_overhead_pct"], (int, float))
 
 
 def test_sim_flash_fail_falls_back(tmp_path):

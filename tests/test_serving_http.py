@@ -260,6 +260,62 @@ def test_log_requests_gating(served, capsys):
         gen.log_requests = False
 
 
+def test_prometheus_exposition_format():
+    """Unit: the text-exposition renderer flattens nested dicts, skips
+    bools/None/non-numerics, sanitizes names, and types every sample."""
+    from megatron_llm_tpu.text_generation_server import prometheus_exposition
+
+    text = prometheus_exposition({
+        "requests": 3,
+        "latency_p50_secs": 0.5,
+        "latency_p95_secs": None,          # empty-window percentile
+        "flag": True,                      # bools are not gauges
+        "note": "hi",                      # nor strings
+        "engine": {"queue_depth": 2, "completed": {"eos!": 1}},
+    })
+    assert text.endswith("\n")
+    lines = text.splitlines()
+    assert "# TYPE megatron_serve_requests gauge" in lines
+    assert "megatron_serve_requests 3" in lines
+    assert "megatron_serve_latency_p50_secs 0.5" in lines
+    assert "megatron_serve_engine_queue_depth 2" in lines
+    assert "megatron_serve_engine_completed_eos_ 1" in lines   # sanitized
+    assert not any("p95" in l or "flag" in l or "note" in l for l in lines)
+    # every sample line is preceded by its TYPE line
+    for i, l in enumerate(lines):
+        if not l.startswith("#"):
+            name = l.split()[0]
+            assert lines[i - 1] == f"# TYPE {name} gauge"
+
+
+def test_metrics_content_negotiation(served):
+    """/metrics serves JSON by default, Prometheus text exposition with
+    ?format=prometheus or an Accept: text/plain header."""
+    _, _, url = served
+    _put(url, {"prompts": ["1 2"], "tokens_to_generate": 2,
+               "temperature": 0.0, "no_log": True})
+
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+        assert resp.headers["Content-Type"].startswith("application/json")
+        json.loads(resp.read())
+
+    with urllib.request.urlopen(url + "/metrics?format=prometheus",
+                                timeout=30) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == \
+            "text/plain; version=0.0.4; charset=utf-8"
+        body = resp.read().decode()
+    assert "# TYPE megatron_serve_requests gauge" in body
+    assert "megatron_serve_uptime_secs" in body
+    assert "megatron_serve_engine_queue_depth" in body
+
+    req = urllib.request.Request(url + "/metrics",
+                                 headers={"Accept": "text/plain"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert b"megatron_serve_requests" in resp.read()
+
+
 def test_deadline_maps_to_503(model_and_params):
     """A request whose deadline expires mid-flight is a 503, not a 200
     with silently truncated output."""

@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Per-layer model-health report from a run's telemetry stream.
+
+Reads the JSONL written by ``--structured_log_dir`` and digests the
+``layer_stats`` records that ``--log_layer_stats_interval`` adds to it
+(schema 3, megatron_llm_tpu/health.py):
+
+* per-group norm trajectories — grad norm first -> last (with the max),
+  final param norm, median and last update-to-weight ratio
+* anomaly flags —
+    NONFINITE  the group reported non-finite gradients at some boundary
+    GRAD>kxMED the group's grad norm exceeded k x the median across
+               groups at some boundary (k = --outlier_factor)
+    UPD-RATIO  the group's median update ratio sits outside the healthy
+               [1e-4, 1e-2] band (too small: effectively frozen; too
+               large: the LR is thrashing that tensor)
+* a NaN-event timeline — which boundaries had non-finite grads, and in
+  which groups (first offender leads)
+
+Pure stdlib — no jax import, runs anywhere the log file does.
+
+Usage:
+    python tools/health_report.py RUN_DIR_OR_JSONL [--json]
+        [--outlier_factor K] [--last N]
+
+``--json`` emits the per-group table + anomalies as one JSON object.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+# Healthy update-to-weight band; keep in sync with
+# megatron_llm_tpu/health.py:UPDATE_RATIO_BAND (duplicated so this tool
+# stays importable without jax)
+RATIO_LO, RATIO_HI = 1e-4, 1e-2
+
+
+def load_health_records(path: str) -> List[Dict]:
+    """Accept a telemetry.jsonl file or the --structured_log_dir holding
+    one; keep only log records that carry layer_stats.  Unparseable
+    lines are skipped (a crash can truncate the final line)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.jsonl")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no telemetry stream at {path}")
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind", "log") == "log" and rec.get("layer_stats"):
+                out.append(rec)
+    return out
+
+
+def _val(v) -> float:
+    """Record values may encode non-finites as strings ("nan"/"inf")."""
+    if isinstance(v, str):
+        return {"nan": math.nan, "inf": math.inf,
+                "-inf": -math.inf}.get(v, math.nan)
+    return float(v) if v is not None else math.nan
+
+
+def _median(values: List[float]) -> Optional[float]:
+    vals = sorted(v for v in values if math.isfinite(v))
+    if not vals:
+        return None
+    mid = len(vals) // 2
+    return vals[mid] if len(vals) % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def analyze(records: List[Dict],
+            outlier_factor: float = 4.0) -> Dict[str, Any]:
+    """Fold the stream's layer_stats into per-group trajectories +
+    anomaly flags + a NaN-event timeline."""
+    groups: List[str] = []
+    per: Dict[str, Dict[str, List]] = {}
+    nan_events: List[Dict[str, Any]] = []
+    for rec in records:
+        ls = rec["layer_stats"]
+        it = rec.get("iteration")
+        names = ls.get("groups") or []
+        gn = [_val(v) for v in ls.get("grad_norm") or []]
+        med = _median(gn)
+        bad_groups = []
+        for i, g in enumerate(names):
+            if g not in per:
+                groups.append(g)
+                per[g] = {"iter": [], "grad_norm": [], "param_norm": [],
+                          "update_ratio": [], "nonfinite": [],
+                          "outlier": []}
+            row = per[g]
+            row["iter"].append(it)
+            row["grad_norm"].append(gn[i] if i < len(gn) else math.nan)
+            pn = ls.get("param_norm") or []
+            row["param_norm"].append(_val(pn[i]) if i < len(pn)
+                                     else math.nan)
+            ur = ls.get("update_ratio") or []
+            row["update_ratio"].append(
+                ur[i] if i < len(ur) and
+                isinstance(ur[i], (int, float)) else None)
+            nf = ls.get("nonfinite_grads") or []
+            n_bad = int(nf[i]) if i < len(nf) else 0
+            row["nonfinite"].append(n_bad)
+            if n_bad > 0:
+                bad_groups.append(g)
+            row["outlier"].append(
+                bool(med and math.isfinite(gn[i] if i < len(gn)
+                                           else math.nan)
+                     and gn[i] > outlier_factor * med))
+        if bad_groups:
+            nan_events.append({"iteration": it, "groups": bad_groups})
+
+    table = []
+    anomalies = []
+    for g in groups:
+        row = per[g]
+        ratios = [r for r in row["update_ratio"] if r is not None]
+        med_ratio = _median(ratios) if ratios else None
+        finite_gn = [v for v in row["grad_norm"] if math.isfinite(v)]
+        entry = {
+            "group": g,
+            "boundaries": len(row["iter"]),
+            "grad_norm_first": row["grad_norm"][0] if row["grad_norm"]
+            else None,
+            "grad_norm_last": row["grad_norm"][-1] if row["grad_norm"]
+            else None,
+            "grad_norm_max": max(finite_gn) if finite_gn else None,
+            "param_norm_last": row["param_norm"][-1] if row["param_norm"]
+            else None,
+            "update_ratio_median": med_ratio,
+            "update_ratio_last": ratios[-1] if ratios else None,
+            "flags": [],
+        }
+        if any(n > 0 for n in row["nonfinite"]):
+            entry["flags"].append("NONFINITE")
+        if any(row["outlier"]):
+            entry["flags"].append(f"GRAD>{outlier_factor:g}xMED")
+        if med_ratio is not None and not (RATIO_LO <= med_ratio
+                                          <= RATIO_HI):
+            entry["flags"].append("UPD-RATIO")
+        table.append(entry)
+        for fl in entry["flags"]:
+            anomalies.append({"group": g, "flag": fl})
+    return {"groups": groups, "table": table, "anomalies": anomalies,
+            "nan_events": nan_events,
+            "boundaries": len(records),
+            "outlier_factor": outlier_factor}
+
+
+def _fmt(v, spec: str = ".3g", none: str = "-") -> str:
+    if v is None:
+        return none
+    if isinstance(v, float) and not math.isfinite(v):
+        return "nan" if math.isnan(v) else ("inf" if v > 0 else "-inf")
+    return format(v, spec)
+
+
+def render(analysis: Dict[str, Any]) -> str:
+    out = [f"layer-stats boundaries: {analysis['boundaries']}"]
+    header = (f"{'group':<14} {'grad first':>11} {'grad last':>11} "
+              f"{'grad max':>11} {'param last':>11} {'upd ratio':>10} "
+              f"flags")
+    out += ["", header, "-" * len(header)]
+    for e in analysis["table"]:
+        out.append(
+            f"{e['group']:<14} "
+            f"{_fmt(e['grad_norm_first']):>11} "
+            f"{_fmt(e['grad_norm_last']):>11} "
+            f"{_fmt(e['grad_norm_max']):>11} "
+            f"{_fmt(e['param_norm_last']):>11} "
+            f"{_fmt(e['update_ratio_median']):>10} "
+            f"{' '.join(e['flags'])}")
+    if analysis["nan_events"]:
+        out.append("\nnon-finite gradient events:")
+        for ev in analysis["nan_events"]:
+            out.append(f"  iteration {ev['iteration']}: "
+                       f"{', '.join(ev['groups'])} "
+                       f"(first: {ev['groups'][0]})")
+    else:
+        out.append("\nno non-finite gradient events")
+    if analysis["anomalies"]:
+        out.append("anomalies: "
+                   + "; ".join(f"{a['group']} [{a['flag']}]"
+                               for a in analysis["anomalies"]))
+    else:
+        out.append(f"no anomalies (healthy update-ratio band "
+                   f"[{RATIO_LO:g}, {RATIO_HI:g}], grad outlier factor "
+                   f"{analysis['outlier_factor']:g})")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-layer model-health report from telemetry.jsonl")
+    ap.add_argument("path",
+                    help="telemetry.jsonl or the --structured_log_dir")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the analysis as JSON")
+    ap.add_argument("--outlier_factor", type=float, default=4.0,
+                    help="flag groups whose grad norm exceeds this "
+                         "multiple of the cross-group median (default 4)")
+    ap.add_argument("--last", type=int, default=0,
+                    help="only analyze the last N stats boundaries")
+    args = ap.parse_args(argv)
+
+    try:
+        records = load_health_records(args.path)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if not records:
+        print("no layer_stats records in stream (run with "
+              "--log_layer_stats_interval N)", file=sys.stderr)
+        return 2
+    if args.last > 0:
+        records = records[-args.last:]
+
+    analysis = analyze(records, outlier_factor=args.outlier_factor)
+    if args.json:
+        print(json.dumps(analysis, indent=1))
+    else:
+        print(render(analysis))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `| head` closed the pipe — normal CLI usage, not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -10,6 +10,11 @@ boundary, megatron_llm_tpu/telemetry.py) and prints:
 * a recovery-event timeline — the log boundaries where any recovery
   counter (rewinds, save_retries, watchdog_fires, signal_saves)
   advanced, and by how much
+* model-health aggregates when the run carried ``layer_stats`` records
+  (schema 3, --log_layer_stats_interval): the worst per-group
+  update-to-weight ratio seen and the boundaries where any group had
+  non-finite gradients (per-layer breakdown: tools/health_report.py).
+  Schema-2 streams simply have no such records; both parse.
 
 Pure stdlib + JSONL parsing — no jax import, so it runs anywhere the log
 file does (laptop, login node) and costs nothing to start.
@@ -101,6 +106,20 @@ def aggregates(records: List[Dict]) -> Dict:
     # recompiles/straggler_events are monotone counters
     goodputs = [r["goodput_pct"] for r in records
                 if r.get("goodput_pct") is not None]
+    # model-health fields (schema 3, --log_layer_stats_interval): absent
+    # on schema <=2 records -> None / 0, never a parse error
+    worst_ratio = None
+    nan_layer_events = 0
+    for r in records:
+        ls = r.get("layer_stats")
+        if not ls:
+            continue
+        ratios = [v for v in (ls.get("update_ratio") or [])
+                  if isinstance(v, (int, float))]
+        if ratios and (worst_ratio is None or max(ratios) > worst_ratio):
+            worst_ratio = max(ratios)
+        if any(n > 0 for n in (ls.get("nonfinite_grads") or [])):
+            nan_layer_events += 1
     return {
         "log_boundaries": len(records),
         "p50_step_time_secs": percentile(step_times, 50),
@@ -115,6 +134,8 @@ def aggregates(records: List[Dict]) -> Dict:
         "straggler_events": next(
             (r["straggler_events"] for r in reversed(records)
              if r.get("straggler_events") is not None), None),
+        "worst_update_ratio": worst_ratio,
+        "nan_layer_events": nan_layer_events,
     }
 
 
@@ -173,6 +194,11 @@ def main(argv=None) -> int:
               f" | recompiles: {_fmt(agg['recompiles'], 'd')}"
               f" | straggler events: {_fmt(agg['straggler_events'], 'd')}"
               f"  (full breakdown: tools/trace_report.py)")
+    if agg["worst_update_ratio"] is not None or agg["nan_layer_events"]:
+        print(f"layer stats: worst update ratio "
+              f"{_fmt(agg['worst_update_ratio'], '.3g')}"
+              f" | NaN-layer events: {agg['nan_layer_events']}"
+              f"  (per-layer breakdown: tools/health_report.py)")
     if timeline:
         print("\nrecovery events:")
         for ev in timeline:
