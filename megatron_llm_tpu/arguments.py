@@ -441,6 +441,10 @@ def _add_inference_args(parser):
     g.add_argument("--serve_max_model_len", type=int, default=0,
                    help="max prompt+generated tokens per request; 0 = "
                         "model max_position_embeddings")
+    g.add_argument("--serve_prefix_cache", type=int, default=1,
+                   help="share KV pages across requests with equal "
+                        "prompt prefixes (refcounted copy-on-write "
+                        "pages, LRU reuse); 0 disables")
 
 
 def _add_resilience_args(parser):

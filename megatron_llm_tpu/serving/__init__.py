@@ -1,16 +1,18 @@
 """Continuous-batching serving engine (slot-based paged KV cache).
 
-Layering: ``kv_blocks`` (host-side pool bookkeeping) -> ``request``
-(lifecycle + admission queue) -> ``scheduler`` (slot admission,
-prefill/decode interleaving) -> ``engine`` (the background thread and
-the jitted fixed-shape device programs).  The HTTP front-end lives in
-``megatron_llm_tpu.text_generation_server``.
+Layering: ``kv_blocks`` (host-side pool bookkeeping + refcounted prefix
+cache) -> ``request`` (lifecycle + admission queue) -> ``scheduler``
+(slot admission, prefill/decode interleaving) -> ``engine`` (the
+background thread and the jitted fixed-shape device programs).  The HTTP
+front-end lives in ``megatron_llm_tpu.text_generation_server``; the
+multi-replica fleet front-end is ``router`` (``tools/serve_router.py``).
 """
 
 from megatron_llm_tpu.serving.engine import EngineConfig, InferenceEngine
 from megatron_llm_tpu.serving.kv_blocks import (
     BlockManager,
     NoCapacity,
+    chain_block_digests,
     derive_num_blocks,
 )
 from megatron_llm_tpu.serving.request import (
@@ -20,18 +22,31 @@ from megatron_llm_tpu.serving.request import (
     RequestQueue,
     SamplingParams,
 )
+from megatron_llm_tpu.serving.router import (
+    AllBackendsThrottled,
+    Backend,
+    NoBackendAvailable,
+    ReplicaRouter,
+    RouterServer,
+)
 from megatron_llm_tpu.serving.scheduler import Scheduler
 
 __all__ = [
+    "AllBackendsThrottled",
+    "Backend",
     "BlockManager",
     "EngineConfig",
     "EngineError",
     "InferenceEngine",
+    "NoBackendAvailable",
     "NoCapacity",
     "QueueFull",
+    "ReplicaRouter",
     "Request",
     "RequestQueue",
+    "RouterServer",
     "SamplingParams",
     "Scheduler",
+    "chain_block_digests",
     "derive_num_blocks",
 ]

@@ -72,6 +72,7 @@ class EngineConfig:
     max_queue_depth: int = 64       # admission control (HTTP 429 beyond)
     default_deadline_secs: float = 120.0  # 0 = no deadline
     int8_kv_cache: bool = False
+    prefix_cache: bool = True       # share KV pages across equal prefixes
 
 
 def _key_from_seed(seed: int) -> np.ndarray:
@@ -106,7 +107,8 @@ class InferenceEngine:
             cfg.num_slots, cfg.block_size, cfg.max_model_len,
             cfg.num_blocks or None)
         self.blocks = BlockManager(num_blocks, cfg.block_size,
-                                   cfg.num_slots, max_blocks_per_slot)
+                                   cfg.num_slots, max_blocks_per_slot,
+                                   prefix_cache=cfg.prefix_cache)
         self.queue = RequestQueue(cfg.max_queue_depth)
         self.scheduler = Scheduler(self.queue, self.blocks,
                                    cfg.max_model_len)
@@ -129,11 +131,15 @@ class InferenceEngine:
         self._decode_step = jax.jit(self._decode_impl)
         self._prefill_step = jax.jit(self._prefill_impl)
         self._sample_first = jax.jit(self._sample_first_impl)
+        self._cow_copy = jax.jit(self._cow_copy_impl)
 
         # counters (read by stats()/the HTTP /metrics endpoint)
         self.decode_steps = 0
         self.prefill_chunks = 0
         self.tokens_generated = 0
+        self.prefill_tokens_submitted = 0   # prompt tokens admitted
+        self.prefill_tokens_computed = 0    # actually ran through prefill
+        self.prefill_tokens_cached = 0      # adopted from the prefix cache
         self.occupancy_sum = 0          # sum of active slots over decode steps
         self.prefill_secs = 0.0
         self.decode_secs = 0.0
@@ -196,6 +202,21 @@ class InferenceEngine:
         last = jax.lax.dynamic_index_in_dim(
             logits[0], valid_len - 1, axis=0, keepdims=False)
         return last.astype(jnp.float32), self._strip_pages(new_caches)
+
+    def _cow_copy_impl(self, pages, src, dst):
+        # duplicate physical page src into dst across every layer's pool
+        # arrays (k/v, or the int8 quant+scale pairs).  src/dst are traced
+        # int32 scalars so one compile covers all copy-on-write events.
+        out = []
+        for p in pages:
+            q = {}
+            for k, v in p.items():
+                page = jax.lax.dynamic_index_in_dim(v, src, axis=0,
+                                                    keepdims=False)
+                q[k] = jax.lax.dynamic_update_index_in_dim(v, page, dst,
+                                                           axis=0)
+            out.append(q)
+        return out
 
     def _sample_first_impl(self, logits, key, top_k, top_p, temp,
                            ban_a, ban_b, last_prompt_tok):
@@ -321,10 +342,23 @@ class InferenceEngine:
         self._keys[s] = _key_from_seed(sp.seed)
         self._active[s] = 0             # stays masked until prefill done
         self._context_lens[s] = 0
+        self.prefill_tokens_submitted += len(req.prompt_tokens)
+        self.prefill_tokens_cached += req.cached_prompt_tokens
         tracing.instant("admit", "serve", request=req.id, slot=s,
-                        prompt_tokens=len(req.prompt_tokens))
+                        prompt_tokens=len(req.prompt_tokens),
+                        cached_prompt_tokens=req.cached_prompt_tokens)
 
     # -- prefill --------------------------------------------------------
+
+    def _writable(self, slot: int, block_idx: int) -> None:
+        """Copy-on-write barrier before a device write into a slot's
+        logical page: if the block manager swaps in a private copy,
+        mirror the page contents on device."""
+        res = self.blocks.ensure_writable(slot, block_idx)
+        if res is not None:
+            new_b, src_b = res
+            self._pages = self._cow_copy(self._pages, np.int32(src_b),
+                                         np.int32(new_b))
 
     def _run_prefill_chunk(self, req: Request) -> None:
         C = self.config.prefill_chunk
@@ -333,6 +367,9 @@ class InferenceEngine:
         valid = len(chunk)
         toks = np.zeros((1, C), np.int32)
         toks[0, :valid] = chunk
+        bs = self.config.block_size
+        for bi in range(start // bs, (start + valid - 1) // bs + 1):
+            self._writable(req.slot, bi)
         table = self.blocks.tables[req.slot:req.slot + 1].copy()
         t0 = time.perf_counter()
         with tracing.span("prefill_chunk", "serve", request=req.id,
@@ -354,7 +391,12 @@ class InferenceEngine:
                 jax.block_until_ready(self._pages[0])
         self.prefill_secs += time.perf_counter() - t0
         self.prefill_chunks += 1
+        self.prefill_tokens_computed += valid
         req.prefill_pos = start + valid
+        # freshly filled full blocks become shareable right away, so a
+        # burst of same-prefix requests hits even mid-prefill
+        self.blocks.commit_prefix(req.slot, req.prompt_tokens,
+                                  req.prefill_pos)
         if not done:
             return
         # prompt fully cached: request enters the decode batch
@@ -368,6 +410,9 @@ class InferenceEngine:
     # -- decode ---------------------------------------------------------
 
     def _run_decode(self, slots: List[int]) -> None:
+        bs = self.config.block_size
+        for s in slots:
+            self._writable(s, int(self._context_lens[s]) // bs)
         t0 = time.perf_counter()
         with tracing.span("decode_step", "serve", batch=len(slots)):
             next_tokens, self._pages, new_keys = self._decode_step(
@@ -420,9 +465,18 @@ class InferenceEngine:
 
     def _retire(self, req: Request) -> None:
         s = req.slot
+        n_written = 0
         if s is not None:
+            # tokens with KV actually on device: context_lens[s] once the
+            # request reached decode (= prompt + generated - 1;
+            # context_lens stays 0 through prefill), else the prefill
+            # progress.  Blocks beyond that were reserved but never
+            # written and go straight back to the free list.
+            n_written = (int(self._context_lens[s])
+                         if self._context_lens[s] > 0
+                         else req.prefill_pos)
             self._active[s] = 0
-        self.scheduler.evict(req)
+        self.scheduler.evict(req, token_ids=req.tokens, n_written=n_written)
         self._count_finish(req.finish_reason)
         tracer = tracing.get_tracer()
         pc0 = getattr(req, "_pc_submit", None)
@@ -434,15 +488,20 @@ class InferenceEngine:
                 finish_reason=req.finish_reason)
         stream = telemetry.get_stream()
         if stream is not None:
+            bstats = self.blocks.stats()
             stream.emit({
                 "kind": "serve", "event": "request_done",
                 "request": req.id,
                 "prompt_tokens": len(req.prompt_tokens),
+                "cached_prompt_tokens": req.cached_prompt_tokens,
                 "new_tokens": len(req.out_tokens),
                 "finish_reason": req.finish_reason,
                 "ttft_secs": req.ttft_secs(),
                 "latency_secs": req.latency_secs(),
                 "queue_depth": self.queue.depth(),
+                "blocks_free": bstats["blocks_free"],
+                "blocks_in_use": bstats["blocks_in_use"],
+                "blocks_cached_reusable": bstats["blocks_cached_reusable"],
             })
 
     def _count_finish(self, reason: Optional[str]) -> None:
@@ -471,8 +530,23 @@ class InferenceEngine:
                 break
             if time.monotonic() > deadline:
                 raise TimeoutError("engine warmup did not converge")
+        # compile the copy-on-write page copy (garbage -> garbage is a
+        # no-op) so a later COW event can't trip the recompile detector
+        self._pages = self._cow_copy(self._pages, np.int32(0), np.int32(0))
+        jax.block_until_ready(self._pages[0])
         self.warmed_up = True
         tracing.instant("engine_warm", "serve")
+
+    def estimate_wait_secs(self) -> float:
+        """Rough queue wait for a newly rejected request: queued depth
+        times mean per-request engine time, divided across slots.  Cheap
+        and monotone in load — meant for 429 bodies, not SLOs."""
+        done = sum(self.finished.values())
+        if done <= 0:
+            return 1.0
+        per_req = (self.prefill_secs + self.decode_secs) / done
+        return round(self.queue.depth() * per_req
+                     / max(self.config.num_slots, 1), 3)
 
     def stats(self) -> Dict[str, Any]:
         s: Dict[str, Any] = dict(self.scheduler.stats())
@@ -481,6 +555,9 @@ class InferenceEngine:
             "decode_steps": self.decode_steps,
             "prefill_chunks": self.prefill_chunks,
             "tokens_generated": self.tokens_generated,
+            "prefill_tokens_submitted": self.prefill_tokens_submitted,
+            "prefill_tokens_computed": self.prefill_tokens_computed,
+            "prefill_tokens_cached": self.prefill_tokens_cached,
             "mean_batch_occupancy": self.occupancy_sum / dec,
             "prefill_secs": round(self.prefill_secs, 6),
             "decode_secs": round(self.decode_secs, 6),

@@ -23,12 +23,38 @@ manager):
   engine uploads ``tables`` (whole array, a few KB) whenever an
   allocation changes it; shapes never change, so the jitted step never
   recompiles.
+
+Prefix caching (``prefix_cache=True``):
+
+* Every **full block of prompt tokens** is keyed by a rolling
+  blake2b digest chained over all preceding blocks, so a block's key
+  commits to the entire prefix up to and including it.  Identical
+  prefixes across requests map to identical digests and **share the same
+  physical pages** — admission bumps a per-block refcount instead of
+  re-running prefill.
+* A request never adopts its *entire* prompt from cache: the match is
+  capped at ``len(prompt) - 1`` tokens so at least one prompt token runs
+  prefill and produces the first-token logits.
+* Sharing is full-block granular, so shared pages are read-only in the
+  steady state; ``ensure_writable`` is the copy-on-write barrier the
+  engine calls before any page write — if the target page is shared it
+  is swapped for a private copy (the engine mirrors the page content on
+  device), and a registered sole-owner page is unregistered before being
+  overwritten.
+* Releasing a request decrements refcounts; refcount-zero pages that are
+  registered in the cache park in an **LRU reusable list** instead of
+  the free list.  Allocation prefers the free list and falls back to
+  evicting the least-recently-used reusable page (``prefix_cache_evictions``).
+  Reserved-but-unwritten pages of a slot released mid-prefill go back to
+  the free list immediately — they hold no reusable KV.
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -39,17 +65,36 @@ class NoCapacity(Exception):
     """Not enough free blocks / slots for the requested admission."""
 
 
+def chain_block_digests(token_ids: Sequence[int], block_size: int,
+                        n_blocks: int) -> List[bytes]:
+    """Rolling 128-bit digests for the first ``n_blocks`` full blocks of
+    ``token_ids``: digest i commits to every token in blocks 0..i, so a
+    cache hit on digest i implies the whole prefix matches."""
+    out: List[bytes] = []
+    prev = b""
+    for i in range(n_blocks):
+        chunk = token_ids[i * block_size:(i + 1) * block_size]
+        h = hashlib.blake2b(digest_size=16)
+        h.update(prev)
+        h.update(np.asarray(list(chunk), np.int64).tobytes())
+        prev = h.digest()
+        out.append(prev)
+    return out
+
+
 class BlockManager:
-    """Allocates slots and pool blocks; owns the block-table array."""
+    """Allocates slots and pool blocks; owns the block-table array and
+    (optionally) the refcounted prefix cache over the pool."""
 
     def __init__(self, num_blocks: int, block_size: int, num_slots: int,
-                 max_blocks_per_slot: int):
+                 max_blocks_per_slot: int, prefix_cache: bool = False):
         assert num_blocks >= 2, "need at least one block beyond the garbage"
         assert block_size >= 1 and num_slots >= 1
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.num_slots = int(num_slots)
         self.max_blocks_per_slot = int(max_blocks_per_slot)
+        self.prefix_cache_enabled = bool(prefix_cache)
         # LIFO free lists: hot blocks get reused while still in cache
         self._free_blocks: List[int] = list(range(num_blocks - 1, 0, -1))
         self._free_slots: List[int] = list(range(num_slots - 1, -1, -1))
@@ -57,6 +102,18 @@ class BlockManager:
         self.tables = np.full((num_slots, max_blocks_per_slot),
                               GARBAGE_BLOCK, np.int32)
         self._lock = threading.Lock()
+        # prefix cache state: refcounts for owned blocks, digest <-> block
+        # registry, and the LRU of refcount-zero registered blocks
+        self._refcounts: Dict[int, int] = {}
+        self._cache: Dict[bytes, int] = {}          # digest -> block
+        self._block_hash: Dict[int, bytes] = {}     # block -> digest
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._slot_cached: Dict[int, int] = {}      # slot -> cached tokens
+        self.prefix_cache_hits = 0                  # block-granular
+        self.prefix_cache_misses = 0
+        self.prefix_cache_evictions = 0
+        self.prefix_cache_hit_tokens = 0
+        self.cow_copies = 0
 
     # -- capacity -------------------------------------------------------
 
@@ -66,15 +123,54 @@ class BlockManager:
     def can_admit(self, total_tokens: int) -> bool:
         n = self.blocks_needed(total_tokens)
         with self._lock:
-            return (bool(self._free_slots) and n <= len(self._free_blocks)
+            avail = len(self._free_blocks) + len(self._lru)
+            return (bool(self._free_slots) and n <= avail
                     and n <= self.max_blocks_per_slot)
 
     # -- alloc / free ---------------------------------------------------
 
-    def alloc(self, total_tokens: int) -> int:
+    def _take_block_locked(self) -> int:
+        """One fresh private block: free list first, else evict the
+        least-recently-used refcount-zero cached block."""
+        if self._free_blocks:
+            return self._free_blocks.pop()
+        if self._lru:
+            b, _ = self._lru.popitem(last=False)
+            digest = self._block_hash.pop(b)
+            del self._cache[digest]
+            self.prefix_cache_evictions += 1
+            return b
+        raise NoCapacity("pool exhausted (no free or evictable blocks)")
+
+    def _match_prefix_locked(self, prompt_tokens: Sequence[int]
+                             ) -> List[int]:
+        """Longest run of cached blocks covering the prompt, capped so at
+        least one prompt token stays uncached (the engine needs a real
+        prefill step to produce the first-token logits)."""
+        cap = (len(prompt_tokens) - 1) // self.block_size
+        if cap <= 0:
+            return []
+        digests = chain_block_digests(prompt_tokens, self.block_size, cap)
+        matched: List[int] = []
+        for d in digests:
+            b = self._cache.get(d)
+            if b is None:
+                break
+            matched.append(b)
+        self.prefix_cache_hits += len(matched)
+        self.prefix_cache_misses += len(digests) - len(matched)
+        return matched
+
+    def alloc(self, total_tokens: int,
+              prompt_tokens: Optional[Sequence[int]] = None) -> int:
         """Reserve a slot plus blocks covering ``total_tokens``; returns
         the slot id.  Raises ``NoCapacity`` when slots or blocks run
-        out (the scheduler leaves the request queued and retries)."""
+        out (the scheduler leaves the request queued and retries).
+
+        With ``prompt_tokens`` and prefix caching enabled, the longest
+        cached prefix is adopted by reference (refcount++) and only the
+        remainder is allocated fresh; ``slot_cached_tokens(slot)``
+        reports how many prompt tokens the slot got for free."""
         n = self.blocks_needed(total_tokens)
         if n > self.max_blocks_per_slot:
             raise ValueError(
@@ -82,37 +178,189 @@ class BlockManager:
                 f"({total_tokens} tokens / block_size {self.block_size}) "
                 f"> max_blocks_per_slot {self.max_blocks_per_slot}")
         with self._lock:
-            if not self._free_slots or n > len(self._free_blocks):
+            matched: List[int] = []
+            if self.prefix_cache_enabled and prompt_tokens is not None:
+                matched = self._match_prefix_locked(prompt_tokens)
+            n_fresh = n - len(matched)
+            # matched blocks parked in the LRU are consumed by the match
+            # itself — they are NOT available to _take_block_locked, so
+            # the capacity check must exclude them (raising NoCapacity
+            # after bumping matched refcounts would leak those blocks)
+            avail = (len(self._free_blocks) + len(self._lru)
+                     - sum(1 for b in matched if b in self._lru))
+            if not self._free_slots or n_fresh > avail:
                 raise NoCapacity(
                     f"no capacity: {len(self._free_slots)} free slots, "
-                    f"{len(self._free_blocks)} free blocks, need {n}")
+                    f"{avail} free/evictable blocks, need {n_fresh}")
             slot = self._free_slots.pop()
-            blocks = [self._free_blocks.pop() for _ in range(n)]
+            for b in matched:
+                rc = self._refcounts.get(b, 0)
+                if rc == 0:
+                    self._lru.pop(b, None)      # leave the reusable list
+                self._refcounts[b] = rc + 1
+            blocks = matched + [self._take_block_locked()
+                                for _ in range(n_fresh)]
+            for b in blocks[len(matched):]:
+                self._refcounts[b] = 1
             self._slot_blocks[slot] = blocks
+            self._slot_cached[slot] = len(matched) * self.block_size
+            self.prefix_cache_hit_tokens += len(matched) * self.block_size
             self.tables[slot, :] = GARBAGE_BLOCK
             self.tables[slot, :n] = blocks
             return slot
 
-    def free(self, slot: int) -> None:
+    def slot_cached_tokens(self, slot: int) -> int:
+        with self._lock:
+            return self._slot_cached.get(slot, 0)
+
+    def _commit_locked(self, blocks: List[int],
+                       token_ids: Sequence[int], n_written: int) -> None:
+        """Register every fully written, not-yet-registered block under
+        its chain digest so later admissions can share it.  A digest that
+        already maps to another block keeps its canonical entry (the
+        duplicate stays private)."""
+        full = min(max(int(n_written), 0) // self.block_size, len(blocks))
+        if full <= 0:
+            return
+        digests = chain_block_digests(token_ids, self.block_size, full)
+        for i in range(full):
+            b = blocks[i]
+            if b in self._block_hash:
+                continue
+            d = digests[i]
+            if d in self._cache:
+                continue
+            self._cache[d] = b
+            self._block_hash[b] = d
+
+    def commit_prefix(self, slot: int, token_ids: Sequence[int],
+                      n_written: int) -> None:
+        """Called by the engine after prefill progress: blocks whose
+        tokens are fully written become shareable."""
+        if not self.prefix_cache_enabled:
+            return
+        with self._lock:
+            blocks = self._slot_blocks.get(slot)
+            if blocks is not None:
+                self._commit_locked(blocks, token_ids, n_written)
+
+    def ensure_writable(self, slot: int, block_idx: int
+                        ) -> Optional[Tuple[int, Optional[int]]]:
+        """Copy-on-write barrier: call before writing KV into logical
+        block ``block_idx`` of ``slot``.
+
+        Returns ``None`` when the page is already privately writable
+        (the common case — full-block sharing means writes land past any
+        shared prefix).  If the page is registered but solely owned it is
+        unregistered (its cached content is about to be overwritten) and
+        ``None`` is returned.  If the page is *shared*, a private block
+        is allocated, the slot's table is repointed, and ``(new, old)``
+        is returned — the caller must mirror the page copy on device."""
+        if not self.prefix_cache_enabled:
+            return None
+        with self._lock:
+            blocks = self._slot_blocks.get(slot)
+            if blocks is None or block_idx >= len(blocks):
+                return None
+            b = blocks[block_idx]
+            if self._refcounts.get(b, 1) <= 1:
+                d = self._block_hash.pop(b, None)
+                if d is not None:
+                    del self._cache[d]
+                return None
+            nb = self._take_block_locked()
+            self._refcounts[b] -= 1
+            self._refcounts[nb] = 1
+            blocks[block_idx] = nb
+            self.tables[slot, block_idx] = nb
+            self.cow_copies += 1
+            return nb, b
+
+    def free(self, slot: int, token_ids: Optional[Sequence[int]] = None,
+             n_written: int = 0) -> None:
+        """Release a slot.  With prefix caching, blocks covered by
+        ``n_written`` tokens of ``token_ids`` are registered first (so a
+        finished request's prompt *and* generated history become
+        shareable — multi-turn chat hits on its own past turns); then
+        refcounts drop.  Refcount-zero registered blocks park in the LRU
+        reusable list; everything else — including reserved-but-unwritten
+        pages of a slot released mid-prefill — returns to the free list
+        immediately."""
         with self._lock:
             blocks = self._slot_blocks.pop(slot, None)
             if blocks is None:
                 return
-            self._free_blocks.extend(blocks)
+            if (self.prefix_cache_enabled and token_ids is not None
+                    and n_written > 0):
+                self._commit_locked(blocks, token_ids, n_written)
+            for b in blocks:
+                rc = self._refcounts.get(b, 1) - 1
+                if rc > 0:
+                    self._refcounts[b] = rc
+                    continue
+                self._refcounts.pop(b, None)
+                if b in self._block_hash:
+                    self._lru[b] = None
+                    self._lru.move_to_end(b)
+                else:
+                    self._free_blocks.append(b)
             self._free_slots.append(slot)
+            self._slot_cached.pop(slot, None)
             self.tables[slot, :] = GARBAGE_BLOCK
 
     # -- observability --------------------------------------------------
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
-            used = self.num_blocks - 1 - len(self._free_blocks)
+            used = (self.num_blocks - 1 - len(self._free_blocks)
+                    - len(self._lru))
             return {
                 "blocks_total": self.num_blocks - 1,   # garbage excluded
                 "blocks_in_use": used,
+                "blocks_free": len(self._free_blocks),
+                "blocks_cached_reusable": len(self._lru),
                 "slots_total": self.num_slots,
                 "slots_in_use": self.num_slots - len(self._free_slots),
+                "prefix_cache_enabled": int(self.prefix_cache_enabled),
+                "prefix_cache_blocks": len(self._cache),
+                "prefix_cache_hits": self.prefix_cache_hits,
+                "prefix_cache_misses": self.prefix_cache_misses,
+                "prefix_cache_evictions": self.prefix_cache_evictions,
+                "prefix_cache_hit_tokens": self.prefix_cache_hit_tokens,
+                "cow_copies": self.cow_copies,
             }
+
+    def check_invariants(self) -> None:
+        """Debug/test hook: every usable block is in exactly one of
+        {free list, LRU reusable, owned-by-some-slot}; refcounts equal
+        the number of owning slots; the digest registry is bijective and
+        only covers live (owned or reusable) blocks."""
+        with self._lock:
+            free = set(self._free_blocks)
+            lru = set(self._lru)
+            owned: Dict[int, int] = {}
+            for blocks in self._slot_blocks.values():
+                for b in blocks:
+                    owned[b] = owned.get(b, 0) + 1
+            assert not free & lru, "block both free and reusable"
+            assert not free & set(owned), "block both free and owned"
+            assert not lru & set(owned), "block both reusable and owned"
+            universe = free | lru | set(owned)
+            assert universe == set(range(1, self.num_blocks)), \
+                f"leaked/duplicated blocks: {universe ^ set(range(1, self.num_blocks))}"
+            for b, rc in self._refcounts.items():
+                assert rc == owned.get(b, 0), \
+                    f"block {b}: refcount {rc} != owners {owned.get(b, 0)}"
+            assert set(self._refcounts) == set(owned)
+            assert len(self._cache) == len(self._block_hash)
+            for d, b in self._cache.items():
+                assert self._block_hash.get(b) == d
+                assert b in owned or b in lru, \
+                    f"registered block {b} neither owned nor reusable"
+            for slot, blocks in self._slot_blocks.items():
+                n = len(blocks)
+                assert list(self.tables[slot, :n]) == blocks
+                assert (self.tables[slot, n:] == GARBAGE_BLOCK).all()
 
 
 def derive_num_blocks(num_slots: int, block_size: int,

@@ -100,6 +100,7 @@ class Request:
         self.error: Optional[str] = None
         self.slot: Optional[int] = None
         self.prefill_pos = 0            # prompt tokens already in cache
+        self.cached_prompt_tokens = 0   # adopted from the prefix cache
         self.t_submit = time.monotonic()
         self.deadline = (self.t_submit + deadline_secs
                          if deadline_secs else None)

@@ -1,0 +1,594 @@
+"""Multi-replica serving router.
+
+A stdlib HTTP front-end over N backend engine processes (each a
+``MegatronServer`` started by ``tools/run_text_generation_server.py``),
+turning single-replica serving into a fleet:
+
+* **Least-loaded dispatch** — requests go to the live backend with the
+  fewest in-flight requests (ties broken by lifetime request count).
+* **Sticky session affinity** — the leading characters of the first
+  prompt key an affinity map, so repeated prefixes (system prompts, chat
+  sessions) return to the replica whose BlockManager already holds their
+  KV pages in its prefix cache (kv_blocks.py).  Affinity is a routing
+  *preference*, not a pin: a dead or throttled sticky backend falls back
+  to least-loaded.
+* **Circuit breaking** — K consecutive transport failures mark a replica
+  dead for an exponentially growing cooldown (capped); the background
+  health thread probes ``/health`` and revives it on first success.
+* **Requeue on failure** — a request whose backend dies mid-flight is
+  replayed on the next live replica (streams fail over only before the
+  first byte reaches the client, so clients never see a spliced stream).
+* **429 aggregation** — when every live replica is throttled, the router
+  answers 429 with the *most optimistic* backend values (min queue
+  depth / retry-after / estimated wait), so well-behaved clients back
+  off no longer than necessary.
+* **Aggregated `/metrics`** — router counters, per-backend liveness, and
+  a numeric sum over the live backends' own metrics snapshots; both JSON
+  and Prometheus exposition (reusing the PR 5 renderer).
+
+Everything is stdlib (http.client / http.server / threading): the router
+deploys anywhere the backends do, with no extra dependencies.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from urllib.parse import urlparse
+
+
+class Backend:
+    """One replica and its breaker/affinity bookkeeping."""
+
+    def __init__(self, url: str):
+        if "//" not in url:
+            url = "http://" + url
+        p = urlparse(url)
+        if not p.hostname or not p.port:
+            raise ValueError(f"backend needs host:port, got {url!r}")
+        self.url = f"http://{p.hostname}:{p.port}"
+        self.host = p.hostname
+        self.port = p.port
+        self.in_flight = 0
+        self.requests = 0           # completed dispatch attempts
+        self.failures = 0           # transport failures, lifetime
+        self.throttled = 0          # 429s seen, lifetime
+        self.consecutive_failures = 0
+        self.dead_until = 0.0       # monotonic; breaker cooldown end
+        self.dead_marks = 0         # times the breaker tripped
+        self.last_health_ok: Optional[float] = None
+
+    def available(self, fail_threshold: int,
+                  now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        if self.consecutive_failures >= fail_threshold \
+                and now < self.dead_until:
+            return False
+        return True
+
+    def snapshot(self, fail_threshold: int) -> Dict[str, object]:
+        now = time.monotonic()
+        return {
+            "url": self.url,
+            "alive": int(self.available(fail_threshold, now)),
+            "in_flight": self.in_flight,
+            "requests": self.requests,
+            "failures": self.failures,
+            "throttled": self.throttled,
+            "consecutive_failures": self.consecutive_failures,
+            "cooldown_remaining_secs": round(
+                max(self.dead_until - now, 0.0), 3),
+            "dead_marks": self.dead_marks,
+        }
+
+
+class NoBackendAvailable(Exception):
+    """Every replica is dead/unreachable (HTTP maps this to 503)."""
+
+
+class AllBackendsThrottled(Exception):
+    """Every live replica answered 429; carries the merged body."""
+
+    def __init__(self, body: Dict[str, object]):
+        super().__init__(body.get("message", "all replicas throttled"))
+        self.body = body
+
+
+def _affinity_key(body: bytes, max_chars: int) -> Optional[str]:
+    """Sticky key: leading characters of the first prompt.  Shared
+    prefixes map to the same key -> same replica -> its prefix cache."""
+    try:
+        prompts = json.loads(body or b"{}").get("prompts")
+        if isinstance(prompts, list) and prompts \
+                and isinstance(prompts[0], str):
+            return prompts[0][:max_chars]
+    except (ValueError, AttributeError):
+        pass
+    return None
+
+
+def _sum_numeric(dst: Dict[str, object], src: Dict[str, object]) -> None:
+    """Recursively sum numeric leaves of src into dst (metric dicts from
+    different replicas share a schema)."""
+    for k, v in src.items():
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            cur = dst.get(k, 0)
+            if isinstance(cur, (int, float)) and not isinstance(cur, bool):
+                dst[k] = cur + v
+        elif isinstance(v, dict):
+            sub = dst.setdefault(k, {})
+            if isinstance(sub, dict):
+                _sum_numeric(sub, v)
+
+
+def _numeric_only(d: Dict[str, object]) -> Dict[str, object]:
+    """Drop non-numeric leaves (URLs etc.) so the dict is safe for the
+    Prometheus text renderer."""
+    out: Dict[str, object] = {}
+    for k, v in d.items():
+        if isinstance(v, bool):
+            out[k] = int(v)
+        elif isinstance(v, (int, float)):
+            out[k] = v
+        elif isinstance(v, dict):
+            out[k] = _numeric_only(v)
+    return out
+
+
+class ReplicaRouter:
+    """Routing core, independent of the HTTP front-end (unit-testable
+    against stub backends)."""
+
+    def __init__(self, backend_urls: Sequence[str],
+                 fail_threshold: int = 3,
+                 cooldown_secs: float = 1.0,
+                 max_cooldown_secs: float = 30.0,
+                 affinity_chars: int = 256,
+                 affinity_max: int = 4096,
+                 health_interval_secs: float = 2.0,
+                 request_timeout_secs: float = 600.0):
+        if not backend_urls:
+            raise ValueError("router needs at least one backend")
+        self.backends = [Backend(u) for u in backend_urls]
+        self.fail_threshold = int(fail_threshold)
+        self.cooldown_secs = float(cooldown_secs)
+        self.max_cooldown_secs = float(max_cooldown_secs)
+        self.affinity_chars = int(affinity_chars)
+        self.affinity_max = int(affinity_max)
+        self.health_interval_secs = float(health_interval_secs)
+        self.request_timeout_secs = float(request_timeout_secs)
+        self._affinity: "OrderedDict[str, Backend]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.failovers_total = 0
+        self.throttled_total = 0
+        self.no_backend_total = 0
+        self.affinity_hits = 0
+        self._health_thread: Optional[threading.Thread] = None
+        self._health_stop = threading.Event()
+
+    # -- candidate selection --------------------------------------------
+
+    def _candidates(self, affinity_key: Optional[str]) -> List[Backend]:
+        """Live backends, sticky replica first, rest least-loaded."""
+        now = time.monotonic()
+        with self._lock:
+            live = [b for b in self.backends
+                    if b.available(self.fail_threshold, now)]
+            live.sort(key=lambda b: (b.in_flight, b.requests))
+            sticky = (self._affinity.get(affinity_key)
+                      if affinity_key else None)
+            if sticky is not None and sticky in live:
+                live.remove(sticky)
+                live.insert(0, sticky)
+                self.affinity_hits += 1
+                self._affinity.move_to_end(affinity_key)
+        return live
+
+    def _remember_affinity(self, key: Optional[str], backend: Backend
+                           ) -> None:
+        if key is None:
+            return
+        with self._lock:
+            self._affinity[key] = backend
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > self.affinity_max:
+                self._affinity.popitem(last=False)
+
+    # -- breaker --------------------------------------------------------
+
+    def _record_failure(self, b: Backend) -> None:
+        with self._lock:
+            b.failures += 1
+            b.consecutive_failures += 1
+            if b.consecutive_failures >= self.fail_threshold:
+                cooldown = min(
+                    self.cooldown_secs * (2 ** b.dead_marks),
+                    self.max_cooldown_secs)
+                b.dead_until = time.monotonic() + cooldown
+                b.dead_marks += 1
+
+    def _record_success(self, b: Backend) -> None:
+        with self._lock:
+            b.consecutive_failures = 0
+            b.dead_until = 0.0
+            b.dead_marks = 0
+
+    # -- backend IO -----------------------------------------------------
+
+    def _open(self, b: Backend, method: str, path: str,
+              body: Optional[bytes],
+              timeout: Optional[float] = None) -> http.client.HTTPConnection:
+        conn = http.client.HTTPConnection(
+            b.host, b.port,
+            timeout=self.request_timeout_secs if timeout is None
+            else timeout)
+        headers = {"Content-Type": "application/json"} if body else {}
+        conn.request(method, path, body=body, headers=headers)
+        return conn
+
+    # -- dispatch -------------------------------------------------------
+
+    def dispatch(self, method: str, path: str, body: Optional[bytes]
+                 ) -> Tuple[int, Dict[str, str], bytes]:
+        """Route one buffered (non-streaming) request.  Transport
+        failures fail over to the next live replica; 429s collect and
+        merge.  Raises ``NoBackendAvailable`` / ``AllBackendsThrottled``."""
+        key = _affinity_key(body or b"", self.affinity_chars) \
+            if method in ("PUT", "POST") else None
+        cands = self._candidates(key)
+        throttle_bodies: List[dict] = []
+        for b in cands:
+            with self._lock:
+                b.in_flight += 1
+            conn = None
+            try:
+                conn = self._open(b, method, path, body)
+                resp = conn.getresponse()
+                data = resp.read()
+                headers = dict(resp.getheaders())
+                status = resp.status
+            except (OSError, http.client.HTTPException):
+                # replica unreachable or died mid-flight: requeue the
+                # request on the next live replica
+                self._record_failure(b)
+                if conn is not None:
+                    conn.close()
+                with self._lock:
+                    b.in_flight -= 1
+                    self.failovers_total += 1
+                continue
+            conn.close()
+            with self._lock:
+                b.in_flight -= 1
+                b.requests += 1
+                self.requests_total += 1
+            self._record_success(b)     # transport worked -> replica alive
+            if status == 429:
+                with self._lock:
+                    b.throttled += 1
+                try:
+                    throttle_bodies.append(json.loads(data or b"{}"))
+                except ValueError:
+                    throttle_bodies.append({})
+                continue
+            self._remember_affinity(key, b)
+            return status, headers, data
+        if throttle_bodies:
+            self.throttled_total += 1
+            raise AllBackendsThrottled(
+                self._merge_throttle(throttle_bodies))
+        self.no_backend_total += 1
+        raise NoBackendAvailable(
+            f"no live backend ({len(self.backends)} configured)")
+
+    @staticmethod
+    def _merge_throttle(bodies: List[dict]) -> Dict[str, object]:
+        """Most-optimistic merge across throttled replicas: the client
+        should wait only as long as the *least* loaded one asks."""
+        def best(field, default):
+            vals = [b.get(field) for b in bodies
+                    if isinstance(b.get(field), (int, float))]
+            return min(vals) if vals else default
+        return {
+            "message": "all replicas throttled",
+            "backends_throttled": len(bodies),
+            "retry_after_secs": best("retry_after_secs", 1.0),
+            "queue_depth": best("queue_depth", None),
+            "estimated_wait_secs": best("estimated_wait_secs", None),
+        }
+
+    def dispatch_stream(self, method: str, path: str, body: Optional[bytes]
+                        ) -> Tuple[int, Dict[str, str], Iterator[bytes]]:
+        """Route a streaming (SSE) request.  Fails over while no byte has
+        been forwarded; once the response starts, a mid-stream death
+        surfaces to the client (the engine has already consumed the
+        request's sampling state, so a silent replay could diverge)."""
+        key = _affinity_key(body or b"", self.affinity_chars)
+        cands = self._candidates(key)
+        throttle_bodies: List[dict] = []
+        for b in cands:
+            with self._lock:
+                b.in_flight += 1
+            try:
+                conn = self._open(b, method, path, body)
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException):
+                self._record_failure(b)
+                with self._lock:
+                    b.in_flight -= 1
+                    self.failovers_total += 1
+                continue
+            self._record_success(b)
+            if resp.status == 429:
+                data = resp.read()
+                conn.close()
+                with self._lock:
+                    b.in_flight -= 1
+                    b.requests += 1
+                    b.throttled += 1
+                    self.requests_total += 1
+                try:
+                    throttle_bodies.append(json.loads(data or b"{}"))
+                except ValueError:
+                    throttle_bodies.append({})
+                continue
+            headers = dict(resp.getheaders())
+            self._remember_affinity(key, b)
+
+            def relay(resp=resp, conn=conn, b=b) -> Iterator[bytes]:
+                try:
+                    while True:
+                        chunk = resp.read(1024)
+                        if not chunk:
+                            break
+                        yield chunk
+                finally:
+                    conn.close()
+                    with self._lock:
+                        b.in_flight -= 1
+                        b.requests += 1
+                        self.requests_total += 1
+
+            return resp.status, headers, relay()
+        if throttle_bodies:
+            self.throttled_total += 1
+            raise AllBackendsThrottled(
+                self._merge_throttle(throttle_bodies))
+        self.no_backend_total += 1
+        raise NoBackendAvailable(
+            f"no live backend ({len(self.backends)} configured)")
+
+    # -- health ---------------------------------------------------------
+
+    def probe_once(self) -> int:
+        """Probe every backend's /health; returns the live count.  A
+        success closes the breaker immediately, a failure counts toward
+        it — so replicas revive without waiting for client traffic."""
+        alive = 0
+        for b in self.backends:
+            try:
+                conn = self._open(b, "GET", "/health", None,
+                                  timeout=min(self.request_timeout_secs,
+                                              5.0))
+                resp = conn.getresponse()
+                resp.read()
+                ok = resp.status == 200
+                conn.close()
+            except (OSError, http.client.HTTPException):
+                ok = False
+            if ok:
+                b.last_health_ok = time.monotonic()
+                self._record_success(b)
+                alive += 1
+            else:
+                self._record_failure(b)
+        return alive
+
+    def start_health_thread(self) -> None:
+        if self._health_thread is not None:
+            return
+
+        def loop():
+            while not self._health_stop.wait(self.health_interval_secs):
+                try:
+                    self.probe_once()
+                except Exception:   # noqa: BLE001 - probe must survive
+                    pass
+
+        self._health_thread = threading.Thread(
+            target=loop, name="router-health", daemon=True)
+        self._health_thread.start()
+
+    def stop(self) -> None:
+        self._health_stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5.0)
+            self._health_thread = None
+
+    # -- observability --------------------------------------------------
+
+    def alive_count(self) -> int:
+        now = time.monotonic()
+        with self._lock:
+            return sum(b.available(self.fail_threshold, now)
+                       for b in self.backends)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            affinity_entries = len(self._affinity)
+        return {
+            "backends_total": len(self.backends),
+            "backends_alive": self.alive_count(),
+            "requests_total": self.requests_total,
+            "failovers_total": self.failovers_total,
+            "throttled_total": self.throttled_total,
+            "no_backend_total": self.no_backend_total,
+            "affinity_hits": self.affinity_hits,
+            "affinity_entries": affinity_entries,
+            "backends": {
+                f"backend_{i}": b.snapshot(self.fail_threshold)
+                for i, b in enumerate(self.backends)},
+        }
+
+    def aggregated_metrics(self) -> Dict[str, object]:
+        """Router snapshot + per-backend /metrics + a numeric sum over
+        the replicas that answered (fleet totals: tokens/sec columns add,
+        cache hit counters add, ...)."""
+        per_backend: Dict[str, object] = {}
+        aggregate: Dict[str, object] = {}
+        for i, b in enumerate(self.backends):
+            snap = None
+            try:
+                conn = self._open(b, "GET", "/metrics", None,
+                                  timeout=min(self.request_timeout_secs,
+                                              5.0))
+                resp = conn.getresponse()
+                if resp.status == 200:
+                    snap = json.loads(resp.read() or b"{}")
+                else:
+                    resp.read()
+                conn.close()
+            except (OSError, http.client.HTTPException, ValueError):
+                snap = None
+            per_backend[f"backend_{i}"] = snap
+            if isinstance(snap, dict):
+                _sum_numeric(aggregate, snap)
+        return {"router": self.snapshot(), "aggregate": aggregate,
+                "backends": per_backend}
+
+
+class RouterServer:
+    """HTTP front-end mirroring ``MegatronServer``'s surface (PUT/POST
+    /api + /api/stream, GET /health + /metrics) so clients and
+    ``tools/serve_bench.py`` point at the router unchanged."""
+
+    def __init__(self, router: ReplicaRouter):
+        self.router = router
+        self.httpd = None
+
+    def run(self, host: str = "0.0.0.0", port: int = 8000) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        # PR 5's renderer; imported lazily so the router stays importable
+        # without the model-serving stack
+        from megatron_llm_tpu.text_generation_server import (
+            _wants_prometheus,
+            prometheus_exposition,
+        )
+
+        router = self.router
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send_json(self, code: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if code == 429:
+                    self.send_header("Retry-After", str(max(int(
+                        body.get("retry_after_secs") or 1), 1)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n) if n else b""
+
+            def do_PUT(self):
+                if self.path in ("/api/stream", "/generate/stream"):
+                    self._do_stream()
+                    return
+                if self.path not in ("/api", "/generate"):
+                    self.send_error(404)
+                    return
+                try:
+                    status, headers, data = router.dispatch(
+                        "PUT", self.path, self._body())
+                except AllBackendsThrottled as exc:
+                    self._send_json(429, exc.body)
+                    return
+                except NoBackendAvailable as exc:
+                    self._send_json(503, {"message": str(exc)})
+                    return
+                self.send_response(status)
+                self.send_header("Content-Type", headers.get(
+                    "Content-Type", "application/json"))
+                self.send_header("Content-Length", str(len(data)))
+                ra = headers.get("Retry-After")
+                if ra:
+                    self.send_header("Retry-After", ra)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _do_stream(self):
+                try:
+                    status, headers, chunks = router.dispatch_stream(
+                        "PUT", self.path, self._body())
+                except AllBackendsThrottled as exc:
+                    self._send_json(429, exc.body)
+                    return
+                except NoBackendAvailable as exc:
+                    self._send_json(503, {"message": str(exc)})
+                    return
+                self.send_response(status)
+                self.send_header("Content-Type", headers.get(
+                    "Content-Type", "text/event-stream"))
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    for chunk in chunks:
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    for _ in chunks:    # drain so counters settle
+                        pass
+
+            do_POST = do_PUT
+
+            def do_GET(self):
+                if self.path == "/health":
+                    alive = router.alive_count()
+                    code = 200 if alive > 0 else 503
+                    self._send_json(code, {
+                        "status": "ok" if alive > 0 else "no_backends",
+                        "backends_alive": alive,
+                        "backends_total": len(router.backends)})
+                elif self.path == "/metrics" \
+                        or self.path.startswith("/metrics?"):
+                    snap = router.aggregated_metrics()
+                    if _wants_prometheus(self.path,
+                                         self.headers.get("Accept", "")):
+                        flat = {"router": _numeric_only(snap["router"]),
+                                "aggregate": _numeric_only(
+                                    snap["aggregate"])}
+                        data = prometheus_exposition(
+                            flat, prefix="megatron_router_").encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                    else:
+                        self._send_json(200, snap)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        self.httpd = server     # exposed for tests (port may be 0)
+        router.start_health_thread()
+        print(f" * routing {len(router.backends)} backends on "
+              f"http://{host}:{server.server_address[1]}/api", flush=True)
+        server.serve_forever()

@@ -83,12 +83,17 @@ class Scheduler:
                 head._finish(FINISH_DEADLINE)
                 continue
             try:
-                slot = self.blocks.alloc(self.total_tokens(head))
+                slot = self.blocks.alloc(self.total_tokens(head),
+                                         prompt_tokens=head.prompt_tokens)
             except (NoCapacity, ValueError):
                 break
             self.queue.pop()
             head.slot = slot
             head.state = RequestState.PREFILL
+            # prefix-cache hit: skip prefill over the cached prompt blocks
+            cached = self.blocks.slot_cached_tokens(slot)
+            head.prefill_pos = cached
+            head.cached_prompt_tokens = cached
             self.active[slot] = head
             self.admitted += 1
             admitted.append(head)
@@ -130,12 +135,16 @@ class Scheduler:
 
     # -- lifecycle ------------------------------------------------------
 
-    def evict(self, req: Request) -> None:
+    def evict(self, req: Request, token_ids=None, n_written: int = 0
+              ) -> None:
         """Release a finished request's slot and blocks (the caller has
-        already ``_finish``-ed it)."""
+        already ``_finish``-ed it).  ``token_ids``/``n_written`` let the
+        block manager register the written history for prefix reuse and
+        return unwritten reserved pages straight to the free list."""
         if req.slot is not None:
             self.active.pop(req.slot, None)
-            self.blocks.free(req.slot)
+            self.blocks.free(req.slot, token_ids=token_ids,
+                             n_written=n_written)
             req.slot = None
 
     def sweep_deadlines(self, now: Optional[float] = None) -> List[Request]:

@@ -368,8 +368,14 @@ class MegatronGenerate:
                                            stream=stream)
             return None, reqs
         except QueueFull as exc:
-            return (429, {"message": str(exc),
-                          "retry_after_secs": exc.retry_after_secs}), None
+            # tell clients how backed up we are, not just "go away":
+            # depth + estimated wait let a router/load-balancer pick the
+            # least-bad replica and clients back off proportionally
+            body = {"message": str(exc),
+                    "retry_after_secs": exc.retry_after_secs,
+                    "queue_depth": self.engine.queue.depth(),
+                    "estimated_wait_secs": self.engine.estimate_wait_secs()}
+            return (429, body), None
         except ValueError as exc:
             return (400, {"message": str(exc)}), None
 

@@ -1,0 +1,70 @@
+"""Standalone tiny-model serving replica for router tests.
+
+Spawned as a subprocess (one real engine process per replica, like a
+production fleet):
+
+    python tests/_serve_replica.py
+
+Prints ``PORT <n>`` on stdout once the HTTP server is accepting, then
+serves until killed.  Uses the same tiny llama + numeric fake tokenizer
+as tests/test_serving_http.py, so prompts are space-separated ints and
+greedy outputs are deterministic across replicas.
+"""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config  # noqa: E402
+from megatron_llm_tpu.serving import EngineConfig, InferenceEngine  # noqa: E402
+from megatron_llm_tpu.text_generation_server import MegatronServer  # noqa: E402
+
+
+class _FakeTokenizer:
+    vocab_size = 64
+    eod = 63
+    pad = 0
+
+    def tokenize(self, text):
+        return [int(t) % 64 for t in text.split()]
+
+    def detokenize(self, ids):
+        return " ".join(str(i) for i in ids)
+
+
+def main():
+    cfg = llama_config("tiny", num_layers=2, seq_length=64,
+                       max_position_embeddings=64, padded_vocab_size=64,
+                       use_flash_attn=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    engine = InferenceEngine(model, params, EngineConfig(
+        num_slots=4, block_size=8, prefill_chunk=16, max_model_len=64,
+        max_queue_depth=32, default_deadline_secs=60.0))
+    engine.warmup()
+    engine.start()
+    server = MegatronServer(model, params, _FakeTokenizer(),
+                            engine=engine, max_prompts=4, max_tokens=32)
+    t = threading.Thread(target=server.run,
+                         kwargs={"host": "127.0.0.1", "port": 0},
+                         daemon=True)
+    t.start()
+    for _ in range(200):
+        if getattr(server, "httpd", None) is not None:
+            break
+        time.sleep(0.05)
+    assert server.httpd is not None
+    print(f"PORT {server.httpd.server_address[1]}", flush=True)
+    t.join()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

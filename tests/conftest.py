@@ -58,6 +58,13 @@ import pytest  # noqa: E402
 from megatron_llm_tpu import topology  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 CI runs `-m 'not slow'` (ROADMAP.md); slow = multi-process /
+    # subprocess-spawning suites (router failover, replica fleets)
+    config.addinivalue_line(
+        "markers", "slow: long multi-process tests excluded from tier-1")
+
+
 class Utils:
     """Analogue of the reference's tests/test_utilities.py Utils."""
 

@@ -1,0 +1,360 @@
+"""Prefix cache (serving/kv_blocks.py) + engine integration.
+
+Covers the PR-6 acceptance criteria: refcounted page sharing, LRU
+eviction, copy-on-write isolation, mid-prefill release accounting,
+invariants under random churn, greedy parity with caching on vs off,
+and near-zero prefill on repeated prompts.
+"""
+
+import json
+import random
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from megatron_llm_tpu import telemetry
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+from megatron_llm_tpu.serving import (
+    BlockManager,
+    EngineConfig,
+    InferenceEngine,
+    NoCapacity,
+    SamplingParams,
+    chain_block_digests,
+)
+from megatron_llm_tpu.serving.kv_blocks import GARBAGE_BLOCK
+
+
+# ---------------------------------------------------------------------------
+# block manager (pure host-side)
+# ---------------------------------------------------------------------------
+
+BS = 4
+
+
+def _bm(num_blocks=17, num_slots=4, **kw):
+    kw.setdefault("prefix_cache", True)
+    return BlockManager(num_blocks=num_blocks, block_size=BS,
+                        num_slots=num_slots, max_blocks_per_slot=8, **kw)
+
+
+def test_chain_digests_commit_to_whole_prefix():
+    a = chain_block_digests(list(range(12)), BS, 3)
+    b = chain_block_digests(list(range(12)), BS, 3)
+    assert a == b and len(a) == 3
+    # changing an EARLY token changes every later digest (chained)
+    c = chain_block_digests([99] + list(range(1, 12)), BS, 3)
+    assert all(x != y for x, y in zip(a, c))
+    # same block content after a different prefix != same digest
+    d = chain_block_digests(list(range(4, 12)), BS, 2)
+    assert d[1] != a[2]
+
+
+def test_prefix_sharing_refcounts_and_hit_tokens():
+    bm = _bm()
+    prompt = list(range(1, 11))             # 10 toks: 2 full blocks + tail
+    s0 = bm.alloc(16, prompt_tokens=prompt)
+    assert bm.slot_cached_tokens(s0) == 0   # cold cache
+    bm.commit_prefix(s0, prompt, n_written=10)
+    s1 = bm.alloc(16, prompt_tokens=prompt)
+    assert bm.slot_cached_tokens(s1) == 8   # 2 shared blocks
+    # physical sharing: first two table entries identical, tails private
+    assert bm.tables[s0][:2].tolist() == bm.tables[s1][:2].tolist()
+    assert bm.tables[s0][2] != bm.tables[s1][2]
+    st = bm.stats()
+    assert st["prefix_cache_hits"] == 2
+    assert st["prefix_cache_hit_tokens"] == 8
+    bm.check_invariants()
+    # releasing one owner keeps the pages for the other
+    bm.free(s0, token_ids=prompt, n_written=10)
+    bm.check_invariants()
+    s2 = bm.alloc(16, prompt_tokens=prompt)
+    assert bm.slot_cached_tokens(s2) == 8
+    bm.free(s1)
+    bm.free(s2)
+    bm.check_invariants()
+
+
+def test_full_prompt_match_capped_one_token_short():
+    """A prompt that is entirely cached still prefills >= 1 token (the
+    engine needs real logits for the first sampled token)."""
+    bm = _bm()
+    prompt = list(range(1, 9))              # exactly 2 blocks
+    s0 = bm.alloc(12, prompt_tokens=prompt)
+    bm.commit_prefix(s0, prompt, n_written=8)
+    s1 = bm.alloc(12, prompt_tokens=prompt)
+    assert bm.slot_cached_tokens(s1) == 4   # capped at (8-1)//4 = 1 block
+    bm.free(s0)
+    bm.free(s1)
+
+
+def test_disabled_mode_never_shares():
+    bm = _bm(prefix_cache=False)
+    prompt = list(range(1, 11))
+    s0 = bm.alloc(16, prompt_tokens=prompt)
+    bm.commit_prefix(s0, prompt, n_written=10)
+    bm.free(s0, token_ids=prompt, n_written=10)
+    s1 = bm.alloc(16, prompt_tokens=prompt)
+    assert bm.slot_cached_tokens(s1) == 0
+    st = bm.stats()
+    assert st["prefix_cache_hits"] == 0
+    assert st["blocks_cached_reusable"] == 0
+
+
+def test_released_pages_park_in_lru_and_evict_in_order():
+    bm = _bm(num_blocks=9)                  # 8 usable blocks
+    pa = list(range(1, 9))                  # 2 full blocks
+    pb = list(range(11, 19))
+    sa = bm.alloc(8, prompt_tokens=pa)
+    bm.free(sa, token_ids=pa, n_written=8)  # a's 2 pages -> LRU (older)
+    sb = bm.alloc(8, prompt_tokens=pb)
+    bm.free(sb, token_ids=pb, n_written=8)  # b's 2 pages -> LRU (newer)
+    st = bm.stats()
+    assert st["blocks_cached_reusable"] == 4
+    assert st["blocks_free"] == 4
+    # demand 6 fresh blocks: 4 free + 2 evicted, LRU (a's) evicted first
+    s = bm.alloc(24, prompt_tokens=list(range(90, 96)))
+    assert bm.stats()["prefix_cache_evictions"] == 2
+    # a's chain is gone, b's survives
+    s2 = bm.alloc(8, prompt_tokens=pb)
+    assert bm.slot_cached_tokens(s2) == 4
+    bm.free(s)
+    bm.free(s2)
+    bm.check_invariants()
+    bm2 = _bm(num_blocks=9)
+    s = bm2.alloc(32)                       # all 8 blocks, no cache help
+    with pytest.raises(NoCapacity):
+        bm2.alloc(4)
+    bm2.free(s)
+
+
+def test_cow_ensure_writable_isolates_shared_pages():
+    bm = _bm()
+    prompt = list(range(1, 11))
+    s0 = bm.alloc(16, prompt_tokens=prompt)
+    bm.commit_prefix(s0, prompt, n_written=10)
+    s1 = bm.alloc(16, prompt_tokens=prompt)
+    shared = bm.tables[s1][0]
+    res = bm.ensure_writable(s1, 0)         # refcount 2 -> private copy
+    assert res is not None
+    new_b, src_b = res
+    assert src_b == shared and new_b != shared
+    assert bm.tables[s1][0] == new_b
+    assert bm.tables[s0][0] == shared       # owner untouched
+    assert bm.stats()["cow_copies"] == 1
+    bm.check_invariants()
+    # sole-owner registered page: unregistered in place, no copy
+    assert bm.ensure_writable(s0, 0) is None
+    # the digest chain for block 0 is gone -> future allocs miss it
+    s2 = bm.alloc(16, prompt_tokens=prompt)
+    assert bm.slot_cached_tokens(s2) == 0
+    bm.free(s0)
+    bm.free(s1)
+    bm.free(s2)
+    bm.check_invariants()
+
+
+def test_mid_prefill_release_returns_unwritten_pages_immediately():
+    bm = _bm(num_blocks=9)
+    prompt = list(range(1, 17))
+    s = bm.alloc(32, prompt_tokens=prompt)  # reserves all 8 blocks
+    assert bm.stats()["blocks_free"] == 0
+    # released after writing only 1 full block of prefill
+    bm.free(s, token_ids=prompt, n_written=4)
+    st = bm.stats()
+    assert st["blocks_cached_reusable"] == 1    # the written page
+    assert st["blocks_free"] == 7               # the rest, immediately
+    bm.check_invariants()
+
+
+def test_refcount_eviction_invariants_under_random_churn():
+    rng = random.Random(0)
+    bm = _bm(num_blocks=13, num_slots=3)
+    # a small prompt universe so prefixes genuinely collide
+    prompts = [[rng.randrange(1, 6) for _ in range(rng.randrange(3, 17))]
+               for _ in range(6)]
+    live = {}
+    for step in range(400):
+        op = rng.random()
+        if op < 0.45 and len(live) < 3:
+            p = rng.choice(prompts)
+            total = len(p) + rng.randrange(1, 8)
+            try:
+                s = bm.alloc(total, prompt_tokens=p)
+            except NoCapacity:
+                continue
+            live[s] = (p, bm.slot_cached_tokens(s))
+        elif op < 0.65 and live:
+            s = rng.choice(list(live))
+            p, cached = live[s]
+            n_written = rng.randrange(cached, len(p) + 1)
+            bm.commit_prefix(s, p, n_written)
+        elif op < 0.8 and live:
+            s = rng.choice(list(live))
+            p, _ = live[s]
+            bm.ensure_writable(s, rng.randrange(0, bm.blocks_needed(len(p))))
+        elif live:
+            s = rng.choice(list(live))
+            p, cached = live[s]
+            bm.free(s, token_ids=p,
+                    n_written=rng.randrange(0, len(p) + 1))
+            del live[s]
+        bm.check_invariants()
+    for s, (p, _) in list(live.items()):
+        bm.free(s, token_ids=p, n_written=len(p))
+    bm.check_invariants()
+    st = bm.stats()
+    assert st["blocks_in_use"] == 0
+    assert st["blocks_free"] + st["blocks_cached_reusable"] == 12
+
+
+# ---------------------------------------------------------------------------
+# engine integration (tiny model)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = llama_config("tiny", num_layers=2, seq_length=64,
+                       max_position_embeddings=64, padded_vocab_size=64,
+                       use_flash_attn=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _engine(model_and_params, prefix_cache):
+    model, params = model_and_params
+    eng = InferenceEngine(model, params, EngineConfig(
+        num_slots=4, block_size=8, prefill_chunk=16, max_model_len=64,
+        max_queue_depth=32, default_deadline_secs=0.0,
+        prefix_cache=prefix_cache))
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def eng_on(model_and_params):
+    eng = _engine(model_and_params, True).start()
+    yield eng
+    eng.stop()
+
+
+@pytest.fixture(scope="module")
+def eng_off(model_and_params):
+    eng = _engine(model_and_params, False).start()
+    yield eng
+    eng.stop()
+
+
+GREEDY = dict(temperature=0.0, eod_id=63)
+PROMPT = [(3 * i + 1) % 60 + 1 for i in range(24)]       # 3 full blocks
+
+
+def _greedy(eng, prompt, n=8):
+    r = eng.submit(prompt, SamplingParams(max_new_tokens=n, **GREEDY))
+    return r.result(timeout=180)
+
+
+def test_greedy_parity_cache_on_off(eng_on, eng_off):
+    """Acceptance: token-identical outputs with caching on vs off, on
+    both the cold (miss) and warm (hit) paths."""
+    cold_on = _greedy(eng_on, PROMPT).tokens
+    cold_off = _greedy(eng_off, PROMPT).tokens
+    assert cold_on == cold_off
+    warm_on = _greedy(eng_on, PROMPT)
+    assert warm_on.cached_prompt_tokens > 0          # really the hit path
+    assert warm_on.tokens == cold_off
+    assert _greedy(eng_off, PROMPT).tokens == cold_off
+
+
+def test_repeat_prompt_near_zero_prefill(eng_on):
+    """Acceptance: computed prefill tokens on a repeated prompt ≪
+    submitted (only the uncached tail runs)."""
+    prompt = [(5 * i + 2) % 60 + 1 for i in range(33)]   # 4 blocks + 1
+    first = _greedy(eng_on, prompt)
+    c0 = eng_on.prefill_tokens_computed
+    second = _greedy(eng_on, prompt)
+    assert second.cached_prompt_tokens == 32
+    assert eng_on.prefill_tokens_computed - c0 == 1      # tail only
+    assert second.tokens == first.tokens
+    st = eng_on.stats()
+    assert st["prefill_tokens_cached"] >= 32
+    assert st["prefix_cache_hit_tokens"] >= 32
+
+
+def test_mid_block_divergence_cow_isolation(eng_on, eng_off):
+    """Acceptance: requests sharing 20 tokens then diverging mid-block
+    don't corrupt each other — each matches its cache-off baseline."""
+    common = [(7 * i + 3) % 60 + 1 for i in range(20)]
+    a = common + [11, 12, 13, 14]
+    b = common + [21, 22, 23, 24]
+    base_a = _greedy(eng_off, a).tokens
+    base_b = _greedy(eng_off, b).tokens
+    assert _greedy(eng_on, a).tokens == base_a
+    got_b = _greedy(eng_on, b)
+    assert got_b.cached_prompt_tokens == 16      # 2 shared full blocks
+    assert got_b.tokens == base_b
+    assert _greedy(eng_on, a).tokens == base_a   # a unharmed by b
+    # concurrent divergent-pair storm: outputs stay isolated
+    outs = [None] * 6
+
+    def client(i):
+        p = a if i % 2 == 0 else b
+        outs[i] = _greedy(eng_on, p).tokens
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, o in enumerate(outs):
+        assert o == (base_a if i % 2 == 0 else base_b)
+
+
+def test_zero_recompiles_on_warm_hit_path(model_and_params):
+    """The cache-hit prefill (nonzero start over adopted pages) and the
+    COW copy program run inside the steady-state compile set."""
+    from megatron_llm_tpu import tracing
+
+    eng = _engine(model_and_params, True)
+    prompt = [(9 * i + 4) % 60 + 1 for i in range(24)]
+    tracer = tracing.SpanTracer()
+    det = tracing.RecompileDetector(tracer)
+    tr = tracing.Tracing(tracer=tracer, recompile=det)
+    tracing.install_tracing(tr)
+    eng.start()
+    try:
+        _greedy(eng, prompt)
+        det.mark_steady()
+        _greedy(eng, prompt)                 # warm: cached-prefix prefill
+        _greedy(eng, prompt[:20] + [31, 32, 33, 34])
+        assert det.recompiles == 0, \
+            f"cache-hit path recompiled: {list(det.events)}"
+    finally:
+        eng.stop()
+        tracing.install_tracing(None)
+
+
+def test_request_done_jsonl_carries_cache_and_pool_fields(
+        eng_on, tmp_path):
+    stream = telemetry.TelemetryStream(str(tmp_path))
+    old = telemetry.get_stream()
+    telemetry.install_stream(stream)
+    try:
+        _greedy(eng_on, PROMPT)
+    finally:
+        telemetry.install_stream(old)
+    stream.close()
+    records = []
+    for f in tmp_path.glob("*.jsonl"):
+        with open(f) as fh:
+            records += [json.loads(line) for line in fh if line.strip()]
+    done = [r for r in records if r.get("event") == "request_done"]
+    assert done, f"no request_done in {records}"
+    rec = done[-1]
+    for key in ("cached_prompt_tokens", "blocks_free", "blocks_in_use",
+                "blocks_cached_reusable", "queue_depth", "ttft_secs"):
+        assert key in rec, key
+    assert rec["cached_prompt_tokens"] > 0       # PROMPT is warm by now

@@ -53,7 +53,19 @@ def stub_server():
 
         def do_GET(self):
             if self.path == "/metrics":
-                self._json(200, dict(metrics))
+                body = dict(metrics)
+                # engine counters scale with request count so the bench's
+                # prefill/prefix-cache deltas are non-trivial
+                n = metrics["requests"]
+                body["engine"] = {
+                    "prefill_tokens_submitted": 10 * n,
+                    "prefill_tokens_computed": 4 * n,
+                    "prefill_tokens_cached": 6 * n,
+                    "prefix_cache_hits": 2 * n,
+                    "prefix_cache_misses": n,
+                    "prefix_cache_evictions": 0,
+                }
+                self._json(200, body)
             else:
                 self._json(404, {"message": "nope"})
 
@@ -104,6 +116,56 @@ def test_cli_json_and_table(stub_server, capsys):
     assert rc == 0
     table = capsys.readouterr().out
     assert "latency p95" in table and "throughput" in table
+
+
+def test_json_schema_keys_always_present(stub_server):
+    """Every key in JSON_SCHEMA_KEYS is present in every run_bench
+    result (values may be None), so downstream dashboards can rely on
+    the shape — this is the documented --json contract."""
+    r = serve_bench.run_bench(stub_server, clients=2, requests=3, tokens=3)
+    for key in serve_bench.JSON_SCHEMA_KEYS:
+        assert key in r, f"missing --json schema key: {key}"
+    # and the schema tuple itself has no duplicates
+    assert len(set(serve_bench.JSON_SCHEMA_KEYS)) == \
+        len(serve_bench.JSON_SCHEMA_KEYS)
+
+
+def test_build_prompt_shared_prefix():
+    # shared-fraction tickets agree on the header, differ in the tail
+    a = serve_bench.build_prompt(0, "x", prefix_tokens=16,
+                                 shared_prefix_frac=1.0, seed=7)
+    b = serve_bench.build_prompt(1, "x", prefix_tokens=16,
+                                 shared_prefix_frac=1.0, seed=7)
+    assert a != b
+    assert a.split()[:16] == b.split()[:16]
+    # deterministic per (seed, ticket)
+    assert a == serve_bench.build_prompt(0, "x", prefix_tokens=16,
+                                         shared_prefix_frac=1.0, seed=7)
+    # frac=0: unique same-length header, no sharing
+    c = serve_bench.build_prompt(0, "x", prefix_tokens=16,
+                                 shared_prefix_frac=0.0, seed=7)
+    d = serve_bench.build_prompt(1, "x", prefix_tokens=16,
+                                 shared_prefix_frac=0.0, seed=7)
+    assert c.split()[:16] != d.split()[:16]
+    assert len(c.split()) == len(a.split())
+    # prefix_tokens=0 leaves the base prompt untouched
+    assert serve_bench.build_prompt(0, "x", prefix_tokens=0,
+                                    shared_prefix_frac=1.0, seed=7) == "x"
+
+
+def test_prefix_workload_reports_engine_deltas(stub_server):
+    r = serve_bench.run_bench(stub_server, clients=2, requests=4, tokens=3,
+                              prefix_tokens=8, shared_prefix_frac=0.5)
+    assert r["prefix_tokens"] == 8
+    assert r["shared_prefix_frac"] == 0.5
+    # the stub's engine counters advance 10/4/6 per request
+    assert r["prefill_tokens_submitted"] == 40
+    assert r["prefill_tokens_computed"] == 16
+    assert r["prefill_tokens_cached"] == 24
+    assert r["prefill_computed_frac"] == pytest.approx(0.4)
+    assert r["prefix_cache_hits"] == 8
+    assert r["prefix_cache_misses"] == 4
+    assert r["prefix_cache_evictions"] == 0
 
 
 def test_percentile_helper():

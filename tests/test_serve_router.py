@@ -1,0 +1,467 @@
+"""Multi-replica router (serving/router.py).
+
+Fast tier: stub HTTP backends (no model) cover dispatch policy, sticky
+affinity, circuit breaking, 429 aggregation, metrics aggregation, the
+RouterServer HTTP surface, and ~linear scaling over serial stubs.
+
+Slow tier (``-m slow``; excluded from tier-1): two REAL tiny-model
+engine subprocesses behind the router — aggregate throughput vs one
+replica, and SIGKILL failover with zero dropped in-flight requests.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from megatron_llm_tpu.serving.router import (
+    AllBackendsThrottled,
+    Backend,
+    NoBackendAvailable,
+    ReplicaRouter,
+    RouterServer,
+    _sum_numeric,
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Stub:
+    """Minimal engine-replica lookalike: /api (+stream), /health,
+    /metrics — enough surface for the router."""
+
+    def __init__(self, name: str, sleep: float = 0.0,
+                 throttle_body=None, serial: bool = False):
+        self.name = name
+        self.sleep = sleep
+        self.throttle_body = throttle_body
+        self.hits = []
+        self.healthy = True
+        lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _json(self, code, body):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if code == 429:
+                    self.send_header("Retry-After", "1")
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                stub.hits.append(payload)
+                if stub.throttle_body is not None:
+                    self._json(429, stub.throttle_body)
+                    return
+                if self.path == "/api/stream":
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.end_headers()
+                    for ev in ({"token": 1, "segment": "1"},
+                               {"done": True, "backend": stub.name}):
+                        self.wfile.write(b"data: " + json.dumps(ev).encode()
+                                         + b"\n\n")
+                        self.wfile.flush()
+                    return
+                if serial:
+                    with lock:
+                        time.sleep(stub.sleep)
+                elif stub.sleep:
+                    time.sleep(stub.sleep)
+                self._json(200, {"backend": stub.name,
+                                 "text": ["ok"], "tokens": [[1, 2, 3]]})
+
+            do_POST = do_PUT
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._json(200 if stub.healthy else 503,
+                               {"status": "ok"})
+                elif self.path.startswith("/metrics"):
+                    self._json(200, {
+                        "requests": len(stub.hits),
+                        "engine": {"tokens_generated": 10,
+                                   "queue_depth": 1}})
+                else:
+                    self.send_error(404)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"127.0.0.1:{self.port}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture
+def stubs():
+    made = []
+
+    def make(*a, **kw):
+        s = _Stub(*a, **kw)
+        made.append(s)
+        return s
+
+    yield make
+    for s in made:
+        s.close()
+
+
+def _payload(prompt: str) -> bytes:
+    return json.dumps({"prompts": [prompt],
+                       "tokens_to_generate": 4}).encode()
+
+
+def test_backend_url_parsing():
+    b = Backend("localhost:5000")
+    assert b.host == "localhost" and b.port == 5000
+    assert Backend("http://10.0.0.1:81").url == "http://10.0.0.1:81"
+    with pytest.raises(ValueError):
+        Backend("nonsense")
+
+
+def test_least_loaded_spread_across_backends(stubs):
+    a, b = stubs("a", sleep=0.05), stubs("b", sleep=0.05)
+    router = ReplicaRouter([a.url, b.url], health_interval_secs=999)
+    errs = []
+
+    def client(i):
+        try:
+            # distinct prompts: no affinity funneling
+            router.dispatch("PUT", "/api", _payload(f"{i} 2 3"))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(a.hits) > 0 and len(b.hits) > 0, \
+        f"no spread: a={len(a.hits)} b={len(b.hits)}"
+    assert len(a.hits) + len(b.hits) == 8
+    assert router.requests_total == 8
+
+
+def test_sticky_affinity_routes_repeats_to_same_backend(stubs):
+    a, b = stubs("a"), stubs("b")
+    router = ReplicaRouter([a.url, b.url], health_interval_secs=999)
+    for _ in range(4):
+        status, _, data = router.dispatch("PUT", "/api",
+                                          _payload("7 7 7 session-x"))
+        assert status == 200
+    owner = json.loads(data)["backend"]
+    hits = a.hits if owner == "a" else b.hits
+    assert len(hits) == 4, "affinity did not stick"
+    assert router.affinity_hits >= 3
+
+
+def test_failover_and_circuit_breaker(stubs):
+    live = stubs("live")
+    dead_url = f"127.0.0.1:{_free_port()}"
+    router = ReplicaRouter([dead_url, live.url], fail_threshold=2,
+                           cooldown_secs=30.0, health_interval_secs=999)
+    # dead backend sorts first (0 requests) until the breaker opens
+    for i in range(4):
+        status, _, data = router.dispatch("PUT", "/api",
+                                          _payload(f"{i} 1"))
+        assert status == 200
+        assert json.loads(data)["backend"] == "live"
+    dead = router.backends[0]
+    assert dead.consecutive_failures >= 2
+    assert not dead.available(router.fail_threshold)
+    assert router.failovers_total == 2        # breaker stops the retries
+    snap = router.snapshot()
+    assert snap["backends_alive"] == 1
+    assert snap["backends"]["backend_0"]["alive"] == 0
+
+
+def test_no_live_backend_raises_503_path(stubs):
+    router = ReplicaRouter([f"127.0.0.1:{_free_port()}"],
+                           fail_threshold=1, cooldown_secs=60.0,
+                           health_interval_secs=999)
+    with pytest.raises(NoBackendAvailable):
+        router.dispatch("PUT", "/api", _payload("1"))
+    assert router.no_backend_total == 1
+
+
+def test_429_most_optimistic_aggregation(stubs):
+    a = stubs("a", throttle_body={"retry_after_secs": 4.0,
+                                  "queue_depth": 40,
+                                  "estimated_wait_secs": 9.0})
+    b = stubs("b", throttle_body={"retry_after_secs": 2.0,
+                                  "queue_depth": 10,
+                                  "estimated_wait_secs": 3.0})
+    router = ReplicaRouter([a.url, b.url], health_interval_secs=999)
+    with pytest.raises(AllBackendsThrottled) as ei:
+        router.dispatch("PUT", "/api", _payload("1 2"))
+    body = ei.value.body
+    assert body["backends_throttled"] == 2
+    assert body["retry_after_secs"] == 2.0        # min across replicas
+    assert body["queue_depth"] == 10
+    assert body["estimated_wait_secs"] == 3.0
+    assert router.throttled_total == 1
+
+
+def test_health_probe_trips_and_revives_breaker(stubs):
+    a = stubs("a")
+    router = ReplicaRouter([a.url], fail_threshold=2,
+                           health_interval_secs=999)
+    backend = router.backends[0]
+    # trip the breaker artificially (as consecutive failures would)
+    backend.consecutive_failures = 5
+    backend.dead_until = time.monotonic() + 300
+    assert router.alive_count() == 0
+    assert router.probe_once() == 1               # /health 200 -> revived
+    assert router.alive_count() == 1
+    assert backend.consecutive_failures == 0
+
+
+def test_sum_numeric_and_aggregated_metrics(stubs):
+    agg = {}
+    _sum_numeric(agg, {"a": 1, "nested": {"x": 2.5}, "s": "skip"})
+    _sum_numeric(agg, {"a": 2, "nested": {"x": 1.5, "y": 1}})
+    assert agg == {"a": 3, "nested": {"x": 4.0, "y": 1}}
+
+    a, b = stubs("a"), stubs("b")
+    router = ReplicaRouter([a.url, b.url], health_interval_secs=999)
+    router.dispatch("PUT", "/api", _payload("1 2"))
+    m = router.aggregated_metrics()
+    assert m["aggregate"]["engine"]["tokens_generated"] == 20
+    assert m["router"]["backends_total"] == 2
+    assert set(m["backends"]) == {"backend_0", "backend_1"}
+
+
+@pytest.fixture
+def router_server(stubs):
+    a, b = stubs("a"), stubs("b")
+    router = ReplicaRouter([a.url, b.url], health_interval_secs=999)
+    srv = RouterServer(router)
+    t = threading.Thread(target=srv.run,
+                         kwargs={"host": "127.0.0.1", "port": 0},
+                         daemon=True)
+    t.start()
+    for _ in range(100):
+        if srv.httpd is not None:
+            break
+        time.sleep(0.05)
+    assert srv.httpd is not None
+    url = f"http://127.0.0.1:{srv.httpd.server_address[1]}"
+    yield url, router, (a, b)
+    router.stop()
+    srv.httpd.shutdown()
+
+
+def test_router_server_http_surface(router_server):
+    url, router, (a, b) = router_server
+    req = urllib.request.Request(url + "/api", data=_payload("1 2 3"),
+                                 method="PUT")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+        assert json.loads(resp.read())["backend"] in ("a", "b")
+    with urllib.request.urlopen(url + "/health", timeout=30) as resp:
+        health = json.loads(resp.read())
+        assert resp.status == 200 and health["backends_alive"] == 2
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+        m = json.loads(resp.read())
+        assert m["router"]["requests_total"] == 1
+    with urllib.request.urlopen(url + "/metrics?format=prometheus",
+                                timeout=30) as resp:
+        text = resp.read().decode()
+        assert "megatron_router_router_requests_total 1" in text
+        assert "megatron_router_aggregate_" in text
+
+
+def test_router_server_stream_passthrough(router_server):
+    url, _, _ = router_server
+    req = urllib.request.Request(url + "/api/stream",
+                                 data=_payload("5 6"), method="PUT")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers.get("Content-Type", "").startswith(
+            "text/event-stream")
+        events = [json.loads(line[len(b"data: "):])
+                  for line in resp if line.startswith(b"data: ")]
+    assert {"token": 1, "segment": "1"} in events
+    assert events[-1]["done"] is True
+
+
+def test_linear_scaling_over_serial_stubs(stubs):
+    """Each stub serializes its requests (a lock + sleep models one
+    engine's capacity); two replicas should cut wall time ~in half."""
+    def run_fleet(urls, n=8):
+        router = ReplicaRouter(urls, health_interval_secs=999)
+        t0 = time.perf_counter()
+        threads = [threading.Thread(
+            target=router.dispatch,
+            args=("PUT", "/api", _payload(f"{i} 9"))) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    single = stubs("s0", sleep=0.06, serial=True)
+    t_one = run_fleet([single.url])
+    pair = [stubs(f"p{i}", sleep=0.06, serial=True) for i in range(2)]
+    t_two = run_fleet([p.url for p in pair])
+    assert t_one / t_two >= 1.3, \
+        f"no scaling: 1 replica {t_one:.3f}s vs 2 replicas {t_two:.3f}s"
+
+
+# ---------------------------------------------------------------------------
+# slow tier: real engine subprocesses
+# ---------------------------------------------------------------------------
+
+def _spawn_replica(timeout=180.0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)      # single-device child, no 8-dev mesh
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "_serve_replica.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True, cwd=os.path.dirname(os.path.dirname(__file__)))
+    deadline = time.monotonic() + timeout
+    port = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("PORT "):
+            port = int(line.split()[1])
+            break
+        if proc.poll() is not None:
+            raise RuntimeError("replica died during startup")
+    assert port, "replica did not report a port in time"
+    return proc, port
+
+
+def _bench(url, n=48, clients=12, tokens=32):
+    results = []
+    lock = threading.Lock()
+    # long prompt (31 tok) + 32 generated: enough engine work per request
+    # that replica capacity, not HTTP overhead, bounds throughput.  Prompts
+    # are distinct per request so sticky affinity can't funnel the fleet
+    # onto one backend.
+    tail = " ".join(["2"] * 29) + " 3"
+
+    def client(i):
+        req = urllib.request.Request(
+            url + "/api",
+            data=json.dumps({"prompts": [f"{i} {tail}"],
+                             "tokens_to_generate": tokens,
+                             "temperature": 0.0,
+                             "no_log": True}).encode(),
+            method="PUT")
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                r = (resp.status, json.loads(resp.read()))
+        except urllib.error.HTTPError as e:
+            e.read()
+            r = (e.code, None)
+        with lock:
+            results.append(r)
+
+    t0 = time.perf_counter()
+    threads = []
+    for i in range(n):
+        t = threading.Thread(target=client, args=(i,))
+        t.start()
+        threads.append(t)
+        if len(threads) >= clients:
+            threads.pop(0).join()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, results
+
+
+@pytest.mark.slow
+def test_two_replica_fleet_throughput_and_sigkill_failover():
+    """Acceptance: ~linear aggregate throughput across 2 real engine
+    replicas, and zero dropped in-flight requests when one replica is
+    SIGKILLed mid-run."""
+    p0, port0 = _spawn_replica()
+    p1, port1 = _spawn_replica()
+    servers = []
+    try:
+        def start_router(urls):
+            router = ReplicaRouter(urls, fail_threshold=2,
+                                   cooldown_secs=5.0,
+                                   health_interval_secs=0.5,
+                                   request_timeout_secs=120.0)
+            srv = RouterServer(router)
+            threading.Thread(target=srv.run,
+                             kwargs={"host": "127.0.0.1", "port": 0},
+                             daemon=True).start()
+            for _ in range(100):
+                if srv.httpd is not None:
+                    break
+                time.sleep(0.05)
+            servers.append(srv)
+            return (router,
+                    f"http://127.0.0.1:{srv.httpd.server_address[1]}")
+
+        # warm both replicas through a 2-backend router first
+        router2, url2 = start_router(
+            [f"127.0.0.1:{port0}", f"127.0.0.1:{port1}"])
+        _bench(url2, n=4, clients=2)
+
+        router1, url1 = start_router([f"127.0.0.1:{port0}"])
+        t_one, res_one = _bench(url1)
+        t_two, res_two = _bench(url2)
+        assert all(s == 200 for s, _ in res_one + res_two)
+        speedup = t_one / t_two
+        assert speedup >= 1.2, \
+            f"fleet not scaling: 1 replica {t_one:.2f}s, " \
+            f"2 replicas {t_two:.2f}s ({speedup:.2f}x)"
+
+        # SIGKILL one replica while requests are in flight: the router
+        # must requeue onto the survivor — zero dropped requests
+        killed = {"done": False}
+
+        def killer():
+            time.sleep(0.3)
+            p1.send_signal(signal.SIGKILL)
+            killed["done"] = True
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        _, res_kill = _bench(url2, n=32, clients=8)
+        kt.join()
+        assert killed["done"]
+        bad = [s for s, _ in res_kill if s != 200]
+        assert not bad, f"dropped requests during failover: {bad}"
+        assert router2.failovers_total >= 1
+        assert router2.alive_count() == 1
+    finally:
+        for srv in servers:
+            if srv.httpd is not None:
+                srv.httpd.shutdown()
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=30)

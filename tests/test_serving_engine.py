@@ -57,8 +57,12 @@ def test_block_manager_alloc_free_roundtrip():
     assert bm.stats()["slots_in_use"] == 2
     bm.free(s1)
     bm.free(s2)
-    assert bm.stats() == {"blocks_total": 8, "blocks_in_use": 0,
-                          "slots_total": 2, "slots_in_use": 0}
+    end = bm.stats()
+    assert end["blocks_total"] == 8
+    assert end["blocks_in_use"] == 0
+    assert end["blocks_free"] == 8
+    assert end["slots_total"] == 2
+    assert end["slots_in_use"] == 0
 
 
 def test_block_manager_block_exhaustion():

@@ -75,6 +75,7 @@ def main():
             max_queue_depth=args.serve_max_queue_depth,
             default_deadline_secs=args.serve_deadline_secs,
             int8_kv_cache=args.int8_kv_cache,
+            prefix_cache=bool(args.serve_prefix_cache),
         ))
         print(" * warming up serving engine (compiling prefill/decode "
               "programs)...", flush=True)

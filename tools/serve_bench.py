@@ -10,12 +10,21 @@ that exposes queueing).
 
 Reports a latency table (mean/p50/p95/p99), TTFT, token throughput, and
 the server's own /metrics delta; ``--json`` emits one machine-readable
-object instead.
+object instead (every key in ``JSON_SCHEMA_KEYS`` is always present —
+asserted by tests/test_serve_bench_tool.py).
+
+Repeated-prefix workloads (``--prefix_tokens N``) measure the engine's
+prefix cache: a fraction of requests (``--shared_prefix_frac``) share an
+N-word prompt header and differ only in a short unique tail, so cache
+hits show up as ``prefill_tokens_computed`` ≪ ``prefill_tokens_
+submitted`` (the ``prefill computed/submitted`` bench column).
 
 Examples::
 
     python tools/serve_bench.py --port 5000 --clients 16 --requests 64
     python tools/serve_bench.py --clients 8 --rate 4 --stream --json
+    python tools/serve_bench.py --clients 8 --requests 32 \\
+        --prefix_tokens 256 --shared_prefix_frac 0.75 --json
 """
 
 from __future__ import annotations
@@ -28,6 +37,21 @@ import threading
 import time
 import urllib.error
 import urllib.request
+
+
+# keys guaranteed in the --json output (value may be None when a
+# measurement is unavailable, e.g. no engine /metrics to delta)
+JSON_SCHEMA_KEYS = (
+    "url", "clients", "requests", "ok", "errors", "status_counts",
+    "wall_secs", "requests_per_sec", "tokens_total", "tokens_per_sec",
+    "latency_mean_secs", "latency_p50_secs", "latency_p95_secs",
+    "latency_p99_secs", "ttft_mean_secs", "ttft_p50_secs",
+    "ttft_p95_secs", "stream", "rate", "prefix_tokens",
+    "shared_prefix_frac", "prefill_tokens_submitted",
+    "prefill_tokens_computed", "prefill_tokens_cached",
+    "prefill_computed_frac", "prefix_cache_hits", "prefix_cache_misses",
+    "prefix_cache_evictions",
+)
 
 
 def _percentile(values, q: float):
@@ -91,36 +115,65 @@ def _one_request(base_url: str, payload: dict, stream: bool,
                 "tokens": 0, "error": f"{type(e).__name__}: {e}"}
 
 
+def build_prompt(ticket: int, prompt: str, prefix_tokens: int,
+                 shared_prefix_frac: float, seed: int) -> str:
+    """Per-ticket prompt for the repeated-prefix workload.  A
+    ``shared_prefix_frac`` fraction of tickets open with the same
+    ``prefix_tokens``-word header (one small-number word ≈ one token for
+    numeric tokenizers) and differ only in a short unique tail; the rest
+    get fully unique prompts.  Deterministic in (ticket, seed)."""
+    if prefix_tokens <= 0:
+        return prompt
+    rng = random.Random(seed * 100003 + ticket)
+    tail = " ".join(str(rng.randrange(10, 50)) for _ in range(4))
+    if rng.random() < shared_prefix_frac:
+        header = " ".join(["7"] * prefix_tokens)
+        return f"{header} {tail}"
+    # unique header of the same length: submits the same prefill volume
+    # but can never hit the shared-prefix cache entries
+    header = " ".join(str(rng.randrange(10, 50))
+                      for _ in range(prefix_tokens))
+    return f"{header} {tail}"
+
+
 def run_bench(base_url: str, clients: int = 4, requests: int = 16,
               tokens: int = 32, prompt: str = "1 2 3 4",
               rate: float = 0.0, stream: bool = False,
-              timeout: float = 300.0, seed: int = 0) -> dict:
+              timeout: float = 300.0, seed: int = 0,
+              prefix_tokens: int = 0,
+              shared_prefix_frac: float = 1.0) -> dict:
     """Drive the load and aggregate results (importable — the tier-1
     smoke test calls this directly against an in-process server)."""
     results = []
     results_lock = threading.Lock()
-    payload = {"prompts": [prompt], "tokens_to_generate": int(tokens),
-               "no_log": True}
     n_total = max(int(requests), 1)
     issued = {"n": 0}
     issue_lock = threading.Lock()
     rng = random.Random(seed)
     start_gate = threading.Event()
 
-    def take_ticket() -> bool:
+    def take_ticket():
         with issue_lock:
             if issued["n"] >= n_total:
-                return False
+                return None
             issued["n"] += 1
-            return True
+            return issued["n"] - 1
 
     def client_loop():
         start_gate.wait()
-        while take_ticket():
+        while True:
+            ticket = take_ticket()
+            if ticket is None:
+                return
             if rate > 0:
                 # open-loop Poisson arrivals across the fleet: each
                 # client sleeps an exponential gap scaled by fleet size
                 time.sleep(rng.expovariate(rate / max(clients, 1)))
+            payload = {"prompts": [build_prompt(
+                           ticket, prompt, prefix_tokens,
+                           shared_prefix_frac, seed)],
+                       "tokens_to_generate": int(tokens),
+                       "no_log": True}
             r = _one_request(base_url, payload, stream, timeout)
             with results_lock:
                 results.append(r)
@@ -164,15 +217,49 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         "ttft_p95_secs": _percentile(ttft, 0.95),
         "stream": stream,
         "rate": rate,
+        "prefix_tokens": prefix_tokens,
+        "shared_prefix_frac": shared_prefix_frac,
+        # prefix-cache effectiveness (engine /metrics deltas; None when
+        # the server has no engine metrics to delta)
+        "prefill_tokens_submitted": None,
+        "prefill_tokens_computed": None,
+        "prefill_tokens_cached": None,
+        "prefill_computed_frac": None,
+        "prefix_cache_hits": None,
+        "prefix_cache_misses": None,
+        "prefix_cache_evictions": None,
     }
     if m0 is not None and m1 is not None:
+        # a router /metrics nests the fleet-summed engine counters (and
+        # request counts) under "aggregate" — delta those transparently
+        if "aggregate" in m1 and "engine" not in m1:
+            m0 = m0.get("aggregate") or {}
+            m1 = m1.get("aggregate") or {}
         out["server_metrics_delta"] = {
             "requests": m1.get("requests", 0) - m0.get("requests", 0),
             "errors": m1.get("errors", 0) - m0.get("errors", 0),
             "throttled": m1.get("throttled", 0) - m0.get("throttled", 0),
         }
-        if isinstance(m1.get("engine"), dict):
-            out["server_engine"] = m1["engine"]
+        e0, e1 = m0.get("engine"), m1.get("engine")
+        if isinstance(e1, dict):
+            out["server_engine"] = e1
+            if isinstance(e0, dict):
+                def delta(key):
+                    a, b = e0.get(key), e1.get(key)
+                    if isinstance(a, (int, float)) \
+                            and isinstance(b, (int, float)):
+                        return b - a
+                    return None
+                for key in ("prefill_tokens_submitted",
+                            "prefill_tokens_computed",
+                            "prefill_tokens_cached",
+                            "prefix_cache_hits", "prefix_cache_misses",
+                            "prefix_cache_evictions"):
+                    out[key] = delta(key)
+                sub, comp = (out["prefill_tokens_submitted"],
+                             out["prefill_tokens_computed"])
+                if sub and comp is not None:
+                    out["prefill_computed_frac"] = round(comp / sub, 4)
     return out
 
 
@@ -206,6 +293,18 @@ def print_table(r: dict) -> None:
             ("engine decode steps", _fmt(eng.get("decode_steps"))),
             ("engine prefill chunks", _fmt(eng.get("prefill_chunks"))),
         ]
+    if r.get("prefill_tokens_submitted") is not None:
+        rows += [
+            ("prefill computed/submitted",
+             f"{_fmt(r['prefill_tokens_computed'])}/"
+             f"{_fmt(r['prefill_tokens_submitted'])}"
+             + (f" ({_fmt(r['prefill_computed_frac'])})"
+                if r.get("prefill_computed_frac") is not None else "")),
+            ("prefix cache hit/miss/evict",
+             f"{_fmt(r['prefix_cache_hits'])}/"
+             f"{_fmt(r['prefix_cache_misses'])}/"
+             f"{_fmt(r['prefix_cache_evictions'])}"),
+        ]
     w = max(len(k) for k, _ in rows)
     print(f"serve_bench: {r['clients']} clients -> {r['url']}"
           + (" (stream)" if r["stream"] else ""))
@@ -232,13 +331,22 @@ def main(argv=None):
                    help="use /api/stream (measures true TTFT)")
     p.add_argument("--timeout", type=float, default=300.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prefix_tokens", type=int, default=0,
+                   help="repeated-prefix workload: shared prompt header "
+                        "length in words (0 = off, all prompts identical "
+                        "to --prompt)")
+    p.add_argument("--shared_prefix_frac", type=float, default=1.0,
+                   help="fraction of requests sharing the header; the "
+                        "rest get unique same-length headers")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit one JSON object instead of the table")
     args = p.parse_args(argv)
     base_url = args.url or f"http://{args.host}:{args.port}"
     r = run_bench(base_url, clients=args.clients, requests=args.requests,
                   tokens=args.tokens, prompt=args.prompt, rate=args.rate,
-                  stream=args.stream, timeout=args.timeout, seed=args.seed)
+                  stream=args.stream, timeout=args.timeout, seed=args.seed,
+                  prefix_tokens=args.prefix_tokens,
+                  shared_prefix_frac=args.shared_prefix_frac)
     if args.as_json:
         print(json.dumps(r, indent=2))
     else:

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Run the multi-replica serving router.
+
+Start N engine replicas (one per chip/host) with
+``tools/run_text_generation_server.py``, then put this front-end over
+them:
+
+    python tools/run_text_generation_server.py ... --port 5000 &
+    python tools/run_text_generation_server.py ... --port 5001 &
+    python tools/serve_router.py --backends localhost:5000,localhost:5001
+
+Clients (and ``tools/serve_bench.py``) point at the router exactly as
+they would a single server: PUT /api, PUT /api/stream, GET /health,
+GET /metrics (JSON or Prometheus).  See docs/guide/serving.md,
+"Running a replica fleet".
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--backends", required=True,
+                   help="comma-separated replica addresses "
+                        "(host:port[,host:port...])")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--fail_threshold", type=int, default=3,
+                   help="consecutive transport failures before a replica "
+                        "is circuit-broken")
+    p.add_argument("--cooldown_secs", type=float, default=1.0,
+                   help="initial breaker cooldown (doubles per trip)")
+    p.add_argument("--max_cooldown_secs", type=float, default=30.0)
+    p.add_argument("--health_interval_secs", type=float, default=2.0,
+                   help="background /health probe period")
+    p.add_argument("--affinity_chars", type=int, default=256,
+                   help="prompt prefix length keying session affinity")
+    p.add_argument("--affinity_max", type=int, default=4096,
+                   help="max tracked affinity entries (LRU beyond)")
+    p.add_argument("--request_timeout_secs", type=float, default=600.0)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    from megatron_llm_tpu.serving.router import ReplicaRouter, RouterServer
+
+    router = ReplicaRouter(
+        [u for u in args.backends.split(",") if u.strip()],
+        fail_threshold=args.fail_threshold,
+        cooldown_secs=args.cooldown_secs,
+        max_cooldown_secs=args.max_cooldown_secs,
+        affinity_chars=args.affinity_chars,
+        affinity_max=args.affinity_max,
+        health_interval_secs=args.health_interval_secs,
+        request_timeout_secs=args.request_timeout_secs,
+    )
+    RouterServer(router).run(host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
