@@ -441,6 +441,13 @@ def _add_inference_args(parser):
     g.add_argument("--serve_max_model_len", type=int, default=0,
                    help="max prompt+generated tokens per request; 0 = "
                         "model max_position_embeddings")
+    g.add_argument("--serve_paged_kernel", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="Pallas ragged paged-attention decode kernel "
+                        "(ops/pallas/paged_attention.py): 'auto' uses it "
+                        "for decode steps when the Pallas backend is "
+                        "available (prefill chunks and CPU keep the XLA "
+                        "gather branch), 'on' forces it, 'off' disables")
     g.add_argument("--serve_prefix_cache", type=int, default=1,
                    help="share KV pages across requests with equal "
                         "prompt prefixes (refcounted copy-on-write "

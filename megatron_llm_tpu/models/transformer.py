@@ -249,6 +249,78 @@ def core_attention(
     return ctx.reshape(b, sq, nh, d)
 
 
+# ---------------------------------------------------------------------------
+# paged KV cache (serving engine)
+# ---------------------------------------------------------------------------
+
+def _paged_scatter(kv_cache: dict, k: jax.Array, v: jax.Array,
+                   dest: jax.Array) -> dict:
+    """Write the chunk's K/V rows into the page pool at flat positions
+    ``dest`` ([b, n] indices into the [P*bs] position axis) — one body
+    for the int8 and full-precision pools.  int8 pools quantize on write
+    with per-(position, group) absmax scales.  Returns the pages-only
+    cache dict (the caller re-attaches tables/lengths)."""
+    quantized = "k_pages_q" in kv_cache
+    if quantized:
+        from megatron_llm_tpu.quantization import absmax_quantize_int8
+
+        kq, ks = absmax_quantize_int8(k, axis=-1)
+        vq, vs = absmax_quantize_int8(v, axis=-1)
+        writes = {"k_pages_q": kq, "k_pages_scale": ks,
+                  "v_pages_q": vq, "v_pages_scale": vs}
+    else:
+        writes = {"k_pages": k, "v_pages": v}
+    out = {}
+    for name, val in writes.items():
+        pool = kv_cache[name]
+        P, bs = pool.shape[:2]
+        flat = pool.reshape((P * bs,) + pool.shape[2:])
+        out[name] = flat.at[dest].set(val).reshape(pool.shape)
+    return out
+
+
+def _paged_gather(pages: dict, bt: jax.Array, cdt) -> tuple:
+    """Dense read view: gather every slot's full block table into
+    ``[b, M*bs, g, d]`` K/V (dequantizing int8 pages) — the XLA
+    fallback; the Pallas decode kernel reads pages ragged instead."""
+    b, M = bt.shape
+    if "k_pages_q" in pages:
+        bs, g, d = pages["k_pages_q"].shape[1:]
+
+        def gather(qname, sname):
+            vals = pages[qname][bt]              # [b, M, bs, g, d]
+            scales = pages[sname][bt]            # [b, M, bs, g]
+            return (vals.astype(cdt)
+                    * scales[..., None].astype(cdt)).reshape(
+                        b, M * bs, g, d)
+
+        return (gather("k_pages_q", "k_pages_scale"),
+                gather("v_pages_q", "v_pages_scale"))
+    bs, g, d = pages["k_pages"].shape[1:]
+    return (pages["k_pages"][bt].reshape(b, M * bs, g, d),
+            pages["v_pages"][bt].reshape(b, M * bs, g, d))
+
+
+def _paged_kernel_enabled(cfg: TransformerConfig, n: int) -> bool:
+    """``--serve_paged_kernel`` dispatch: 'off' never; 'on' for any
+    decode-shaped (one query token) call; 'auto' additionally requires
+    the Pallas backend and a single device — so prefill chunks, CPU,
+    and meshed runs keep the XLA gather branch."""
+    mode = getattr(cfg, "paged_attention_kernel", "auto")
+    if mode == "off" or n != 1:
+        return False
+    if mode == "on":
+        return True
+    from megatron_llm_tpu.ops.pallas.paged_attention import (
+        decode_kernel_available,
+    )
+
+    # under a multi-device mesh the Mosaic call would need an explicit
+    # shard_map (GSPMD cannot auto-partition it); serving decode is
+    # single-device today, so 'auto' simply bails
+    return decode_kernel_available() and jax.device_count() == 1
+
+
 def attention(
     x: jax.Array,
     params,
@@ -280,6 +352,7 @@ def attention(
         k = apply_rotary_emb(k, cos, sin, position_ids)
 
     new_cache = None
+    paged_ctx = None
     if kv_cache is not None and ("k_pages" in kv_cache
                                  or "k_pages_q" in kv_cache):
         # PAGED cache (serving engine, serving/kv_blocks.py): one shared
@@ -287,11 +360,15 @@ def attention(
         # (a serving *slot*) owns a block table mapping its logical
         # positions to pool blocks.  All slots share the pool, so HBM is
         # sized for aggregate traffic, not num_slots x max_len — the
-        # ragged-paged-attention memory model (arXiv:2604.15464) without
-        # a custom kernel: scatter-on-write, gather-on-read, plain masked
-        # attention over the gathered view.  Shapes are fixed by the pool
-        # and table geometry, so a jitted step never recompiles as
-        # requests come and go.
+        # ragged-paged-attention memory model (arXiv:2604.15464).
+        # Scatter-on-write always; the read side is the single dispatch
+        # seam: decode-shaped calls go to the Pallas ragged kernel
+        # (ops/pallas/paged_attention.py, walks each slot's block table
+        # reading only its live pages) when --serve_paged_kernel allows,
+        # everything else gathers the dense [b, M*bs] view and runs
+        # plain masked attention.  Shapes are fixed by the pool and
+        # table geometry, so a jitted step never recompiles as requests
+        # come and go.
         #
         # Keys: (k_pages|k_pages_q[, k_pages_scale]) [P, bs, g, d],
         # same for v; block_tables [b, M] int32 (entries beyond a slot's
@@ -299,60 +376,48 @@ def attention(
         # tokens already in cache; valid_lens [b] real tokens in this
         # chunk (padded/inactive rows write to the garbage block).
         bt = kv_cache["block_tables"]
-        ctx = kv_cache["context_lens"]
+        ctx_lens = kv_cache["context_lens"]
         vlen = kv_cache["valid_lens"]
         quantized = "k_pages_q" in kv_cache
         pages_k = kv_cache["k_pages_q"] if quantized else kv_cache["k_pages"]
         P, bs = pages_k.shape[:2]
         M = bt.shape[1]
         n = k.shape[1]
-        g, d = k.shape[2], k.shape[3]
+        d = k.shape[3]
         j = jnp.arange(n)[None, :]
-        pos = ctx[:, None] + j                               # [b, n] abs pos
+        pos = ctx_lens[:, None] + j                          # [b, n] abs pos
         blk = jnp.take_along_axis(bt, jnp.clip(pos // bs, 0, M - 1), axis=1)
         real = j < vlen[:, None]
         # padded / inactive tokens land in garbage block 0 (duplicate
         # scatter indices there are fine — nobody reads it unmasked)
         dest = jnp.where(real, blk * bs + pos % bs, pos % bs)
         dest = jnp.clip(dest, 0, P * bs - 1)
-        cdt = k.dtype
-        if quantized:
-            from megatron_llm_tpu.quantization import absmax_quantize_int8
+        new_cache = _paged_scatter(kv_cache, k, v, dest)
+        if _paged_kernel_enabled(cfg, n):
+            from megatron_llm_tpu.ops.pallas.paged_attention import (
+                paged_attention_decode,
+            )
 
-            kq, ks = absmax_quantize_int8(k, axis=-1)
-            vq, vs = absmax_quantize_int8(v, axis=-1)
-            ckq = kv_cache["k_pages_q"].reshape(P * bs, g, d).at[dest].set(kq)
-            cks = kv_cache["k_pages_scale"].reshape(P * bs, g).at[dest].set(ks)
-            cvq = kv_cache["v_pages_q"].reshape(P * bs, g, d).at[dest].set(vq)
-            cvs = kv_cache["v_pages_scale"].reshape(P * bs, g).at[dest].set(vs)
-            gk = ckq.reshape(P, bs, g, d)[bt]        # [b, M, bs, g, d]
-            gks = cks.reshape(P, bs, g)[bt]
-            gv = cvq.reshape(P, bs, g, d)[bt]
-            gvs = cvs.reshape(P, bs, g)[bt]
-            k = (gk.astype(cdt) * gks[..., None].astype(cdt)).reshape(
-                x.shape[0], M * bs, g, d)
-            v = (gv.astype(cdt) * gvs[..., None].astype(cdt)).reshape(
-                x.shape[0], M * bs, g, d)
-            new_cache = {
-                "k_pages_q": ckq.reshape(P, bs, g, d),
-                "k_pages_scale": cks.reshape(P, bs, g),
-                "v_pages_q": cvq.reshape(P, bs, g, d),
-                "v_pages_scale": cvs.reshape(P, bs, g),
-            }
+            paged_ctx = paged_attention_decode(
+                q[:, 0],                                     # [b, nh, d]
+                new_cache["k_pages_q" if quantized else "k_pages"],
+                new_cache["v_pages_q" if quantized else "v_pages"],
+                bt, ctx_lens,
+                k_scales=new_cache.get("k_pages_scale"),
+                v_scales=new_cache.get("v_pages_scale"),
+                softmax_scale=1.0 / math.sqrt(d),
+                sliding_window=cfg.sliding_window_size,
+            )[:, None]                                       # [b, 1, nh, d]
         else:
-            ck = kv_cache["k_pages"].reshape(P * bs, g, d).at[dest].set(k)
-            cv = kv_cache["v_pages"].reshape(P * bs, g, d).at[dest].set(v)
-            k = ck.reshape(P, bs, g, d)[bt].reshape(x.shape[0], M * bs, g, d)
-            v = cv.reshape(P, bs, g, d)[bt].reshape(x.shape[0], M * bs, g, d)
-            new_cache = {"k_pages": ck.reshape(P, bs, g, d),
-                         "v_pages": cv.reshape(P, bs, g, d)}
-        key_pos = jnp.arange(M * bs)
-        valid = key_pos[None, None, :] <= pos[:, :, None]    # [b, sq, sk]
-        if cfg.sliding_window_size is not None:
-            valid &= key_pos[None, None, :] > (pos[:, :, None]
-                                               - cfg.sliding_window_size)
-        attention_mask = ~valid[:, None]                     # [b, 1, sq, sk]
-        new_cache.update({"block_tables": bt, "context_lens": ctx + vlen,
+            k, v = _paged_gather(new_cache, bt, k.dtype)
+            key_pos = jnp.arange(M * bs)
+            valid = key_pos[None, None, :] <= pos[:, :, None]  # [b, sq, sk]
+            if cfg.sliding_window_size is not None:
+                valid &= key_pos[None, None, :] > (pos[:, :, None]
+                                                   - cfg.sliding_window_size)
+            attention_mask = ~valid[:, None]                 # [b, 1, sq, sk]
+        new_cache.update({"block_tables": bt,
+                          "context_lens": ctx_lens + vlen,
                           "valid_lens": vlen})
     elif kv_cache is not None and "rolling" in kv_cache:
         # ROLLING cache (sliding-window models): a ring buffer of exactly
@@ -463,7 +528,11 @@ def attention(
     )
     use_ring = cp_size > 1 and flash_eligible
     use_flash = cfg.use_flash_attn and flash_eligible
-    if use_ring:
+    if paged_ctx is not None:
+        # the ragged paged-attention kernel already produced the
+        # attention context for this decode step
+        ctx = paged_ctx
+    elif use_ring:
         from megatron_llm_tpu.parallel.ring_attention import (
             context_parallel_attention,
         )

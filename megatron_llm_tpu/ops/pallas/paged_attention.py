@@ -1,0 +1,269 @@
+"""Ragged paged-attention decode kernel for the serving engine (Pallas
+Mosaic TPU).
+
+The XLA paged branch in ``models/transformer.py`` gathers every slot's
+FULL block table into a dense ``[b, M*bs, g, d]`` view (dequantizing
+every int8 page) before masked attention — each decode step moves the
+worst-case context for every slot.  This kernel walks each slot's block
+table directly in the grid instead, reading only the
+``ceil((context_len+1)/block_size)`` pages the slot actually owns
+(arXiv:2604.15464 is the blueprint; decode HBM traffic is the serving
+throughput ceiling, arXiv:2605.25645).
+
+Shape contract (the serving engine's decode step):
+
+* ``q`` — ``[S, nh, d]``: ONE query token per slot (the decode-shaped
+  ``n == 1`` call; prefill chunks keep the XLA branch).
+* ``k_pages``/``v_pages`` — ``[P, bs, g, d]`` shared page pool, already
+  containing this step's scatter-on-write (the query token's K/V sit at
+  position ``context_lens[s]``).  int8 pools ship per-(page, position,
+  group) fp32 absmax scales ``[P, bs, g]`` and are dequantized
+  in-kernel, so int8 is what crosses HBM.
+* ``block_tables`` — ``[S, M]`` int32, entries beyond a slot's
+  allocation = 0 (the reserved garbage block).
+* ``context_lens`` — ``[S]`` int32: the query token's position; keys at
+  positions ``0..context_lens[s]`` inclusive are attended (causal), and
+  a sliding window drops ``key_pos <= context_lens[s] - window``.
+
+Kernel structure: grid ``(slot, page)`` with the page dimension
+innermost — sequential on TPU, so fp32 scratch (m, l, acc) carries the
+online-softmax state across a slot's pages.  The page index map clamps
+out-of-range grid steps to the nearest real page: Mosaic skips the DMA
+when consecutive grid steps map a block to the same index, so a slot
+with 3 live pages out of M=128 moves exactly 3 pages of KV.  All query
+heads of a slot ride in one block per grid step (GQA groups are a
+static in-kernel loop), so each page is fetched once, not once per
+head.
+
+Dispatch mirrors ``flash_attention.py``: TPU backend -> kernel;
+otherwise -> jnp reference math (the same dense-gather computation as
+the transformer's XLA branch).  Interpret-mode tests run the kernel on
+CPU via the module-level ``_INTERPRET`` flag.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_INTERPRET = False
+NEG_INF = -1e30
+
+
+def _use_pallas() -> bool:
+    from megatron_llm_tpu.ops.pallas import pallas_backend_available
+
+    return _INTERPRET or pallas_backend_available()
+
+
+def decode_kernel_available() -> bool:
+    """True when ``paged_attention_decode`` would run the Pallas kernel
+    (TPU backend, or interpret mode in tests) — the transformer's
+    ``--serve_paged_kernel auto`` predicate and the engine's
+    ``paged_kernel: pallas|xla`` attribution both key off this."""
+    return _use_pallas()
+
+
+# ---------------------------------------------------------------------------
+# reference math (non-TPU fallback; identical to the XLA paged branch)
+# ---------------------------------------------------------------------------
+
+def _reference_paged_attention(q, k_pages, v_pages, block_tables,
+                               context_lens, k_scales, v_scales,
+                               scale, window):
+    S, nh, d = q.shape
+    bs, g = k_pages.shape[1], k_pages.shape[2]
+    M = block_tables.shape[1]
+    qpg = nh // g
+    k = k_pages[block_tables].reshape(S, M * bs, g, d).astype(jnp.float32)
+    v = v_pages[block_tables].reshape(S, M * bs, g, d).astype(jnp.float32)
+    if k_scales is not None:
+        k = k * k_scales[block_tables].reshape(S, M * bs, g, 1)
+        v = v * v_scales[block_tables].reshape(S, M * bs, g, 1)
+    qg = q.reshape(S, 1, g, qpg, d).astype(jnp.float32)
+    scores = jnp.einsum("bsgpd,btgd->bgpst", qg, k) * scale
+    key_pos = jnp.arange(M * bs)
+    valid = key_pos[None, :] <= context_lens[:, None]
+    if window is not None:
+        valid &= key_pos[None, :] > (context_lens[:, None] - window)
+    scores = jnp.where(valid[:, None, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgpst,btgd->bsgpd", probs, v)
+    return out.reshape(S, nh, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# decode kernel
+# ---------------------------------------------------------------------------
+
+def _decode_body(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                 m_scr, l_scr, acc_scr,
+                 *, ks_ref, vs_ref, scale, block_size, window, qpg):
+    s = pl.program_id(0)
+    pi = pl.program_id(1)
+    npi = pl.num_programs(1)
+    bs = block_size
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = cl_ref[s]                       # query position = keys cached
+    last = pos // bs                      # last live page of this slot
+    if window is None:
+        first = 0
+    else:
+        first = jnp.maximum(pos - window + 1, 0) // bs
+
+    @pl.when((pi >= first) & (pi <= last))
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)              # [bs, g, d]
+        v = v_ref[0].astype(jnp.float32)
+        if ks_ref is not None:
+            k = k * ks_ref[0][:, :, None]             # [bs, g] scales
+            v = v * vs_ref[0][:, :, None]
+        qh = q_ref[0].astype(jnp.float32)             # [nh, d]
+        key_pos = pi * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (1, bs), 1)
+        valid = key_pos <= pos
+        if window is not None:
+            valid &= key_pos > pos - window
+        # one page DMA serves every query head: GQA groups are a static
+        # unrolled loop over the head block's row slices
+        for grp in range(k.shape[1]):
+            rows = slice(grp * qpg, (grp + 1) * qpg)
+            sq = jax.lax.dot_general(
+                qh[rows], k[:, grp, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                 # [qpg, bs]
+            sq = jnp.where(valid, sq, NEG_INF)
+            m_prev = m_scr[rows]                      # [qpg, 1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(sq, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.where(valid, jnp.exp(sq - m_new), 0.0)
+            l_scr[rows] = l_scr[rows] * alpha + jnp.sum(p, axis=-1,
+                                                        keepdims=True)
+            acc_scr[rows] = acc_scr[rows] * alpha + jax.lax.dot(
+                p, v[:, grp, :], preferred_element_type=jnp.float32)
+            m_scr[rows] = m_new
+
+    @pl.when(pi == npi - 1)
+    def _finish():
+        l = l_scr[:]                                  # [nh, 1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _decode_kernel_plain(bt, cl, q, k, v, o, m, l, acc, **kw):
+    _decode_body(bt, cl, q, k, v, o, m, l, acc,
+                 ks_ref=None, vs_ref=None, **kw)
+
+
+def _decode_kernel_quant(bt, cl, q, k, ks, v, vs, o, m, l, acc, **kw):
+    _decode_body(bt, cl, q, k, v, o, m, l, acc,
+                 ks_ref=ks, vs_ref=vs, **kw)
+
+
+def _decode_call(q, k_pages, v_pages, block_tables, context_lens,
+                 k_scales, v_scales, *, scale, window):
+    S, nh, d = q.shape
+    bs, g = k_pages.shape[1], k_pages.shape[2]
+    M = block_tables.shape[1]
+    qpg = nh // g
+    quantized = k_scales is not None
+
+    def page_map(s, pi, bt_ref, cl_ref):
+        # clamp out-of-range grid steps to the nearest live page: Mosaic
+        # skips the block copy when consecutive steps map to the same
+        # index, so only the slot's ceil((pos+1)/bs) real pages (minus
+        # any fully outside the sliding window) are fetched
+        pos = cl_ref[s]
+        hi = pos // bs
+        lo = (jnp.maximum(pos - window + 1, 0) // bs
+              if window is not None else 0)
+        return (bt_ref[s, jnp.clip(pi, lo, hi)], 0, 0, 0)
+
+    def scale_map(s, pi, bt_ref, cl_ref):
+        return page_map(s, pi, bt_ref, cl_ref)[:3]
+
+    q_spec = pl.BlockSpec((1, nh, d), lambda s, pi, bt, cl: (s, 0, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, bs, g, d), page_map,
+                           memory_space=pltpu.VMEM)
+    sc_spec = pl.BlockSpec((1, bs, g), scale_map,
+                           memory_space=pltpu.VMEM)
+    if quantized:
+        kernel = _decode_kernel_quant
+        in_specs = [q_spec, kv_spec, sc_spec, kv_spec, sc_spec]
+        operands = (q, k_pages, k_scales.astype(jnp.float32),
+                    v_pages, v_scales.astype(jnp.float32))
+    else:
+        kernel = _decode_kernel_plain
+        in_specs = [q_spec, kv_spec, kv_spec]
+        operands = (q, k_pages, v_pages)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, M),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, nh, d), lambda s, pi, bt, cl: (s, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 1), jnp.float32),
+            pltpu.VMEM((nh, 1), jnp.float32),
+            pltpu.VMEM((nh, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(kernel, scale=scale, block_size=bs,
+                          window=window, qpg=qpg),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, nh, d), q.dtype),
+        interpret=_INTERPRET,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      *operands)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+def paged_attention_decode(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    *,
+    k_scales: Optional[jax.Array] = None,
+    v_scales: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Ragged paged attention for one decode token per slot.
+
+    ``q``: [S, nh, d]; pools: [P, bs, g, d] (GQA when g < nh; pass the
+    int8 pools plus ``k_scales``/``v_scales`` [P, bs, g] for in-kernel
+    dequant); ``block_tables``: [S, M]; ``context_lens``: [S] query
+    positions.  Returns [S, nh, d] in ``q.dtype``."""
+    assert q.ndim == 3 and k_pages.ndim == 4, (q.shape, k_pages.shape)
+    assert q.shape[0] == block_tables.shape[0] == context_lens.shape[0]
+    assert (k_scales is None) == (v_scales is None)
+    if softmax_scale is None:
+        softmax_scale = 1.0 / math.sqrt(q.shape[-1])
+    if not _use_pallas():
+        return _reference_paged_attention(
+            q, k_pages, v_pages, block_tables, context_lens,
+            k_scales, v_scales, softmax_scale, sliding_window)
+    return _decode_call(
+        q, k_pages, v_pages, block_tables, context_lens,
+        k_scales, v_scales, scale=softmax_scale, window=sliding_window)

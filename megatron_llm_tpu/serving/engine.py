@@ -73,6 +73,11 @@ class EngineConfig:
     default_deadline_secs: float = 120.0  # 0 = no deadline
     int8_kv_cache: bool = False
     prefix_cache: bool = True       # share KV pages across equal prefixes
+    # Pallas ragged paged-attention decode kernel (--serve_paged_kernel):
+    # 'auto' = on when the Pallas backend is available (TPU, or interpret
+    # mode in tests), 'on' forces it, 'off' keeps the XLA gather branch.
+    # The resolved path is reported as stats()['paged_kernel'].
+    paged_kernel: str = "auto"
 
 
 def _key_from_seed(seed: int) -> np.ndarray:
@@ -115,6 +120,26 @@ class InferenceEngine:
         self._pages = init_paged_kv_caches(
             mcfg, num_blocks, cfg.block_size,
             quantized=cfg.int8_kv_cache)
+
+        # resolve the decode attention path ONCE (it is a static config
+        # field of the jitted decode step, so flipping it later would
+        # recompile): 'pallas' when the kernel can actually run here,
+        # else the XLA gather branch.  The resolved value — not the
+        # requested mode — is what /metrics and request_done report.
+        if cfg.paged_kernel not in ("auto", "on", "off"):
+            raise ValueError(f"paged_kernel must be auto|on|off, got "
+                             f"{cfg.paged_kernel!r}")
+        from megatron_llm_tpu.ops.pallas.paged_attention import (
+            decode_kernel_available,
+        )
+        self.paged_kernel = (
+            "pallas" if cfg.paged_kernel != "off"
+            and decode_kernel_available()
+            and (cfg.paged_kernel == "on" or jax.device_count() == 1)
+            else "xla")
+        self._decode_cfg = mcfg.replace(
+            paged_attention_kernel=(
+                "on" if self.paged_kernel == "pallas" else "off"))
 
         S = cfg.num_slots
         # host-side per-slot state; uploaded whole each step
@@ -168,7 +193,10 @@ class InferenceEngine:
     def _decode_impl(self, params, pages, last_tokens, context_lens,
                      block_tables, active, temps, top_ks, top_ps,
                      ban_a, ban_b, keys):
-        cfg = self.model.cfg
+        # decode-only config override routes the paged branch to the
+        # resolved attention path (prefill chunks keep model.cfg and
+        # always take the XLA branch)
+        cfg = self._decode_cfg
         tokens = last_tokens[:, None]                       # [S, 1]
         positions = context_lens[:, None]                   # [S, 1]
         caches = self._layer_caches(pages, block_tables, context_lens,
@@ -498,6 +526,7 @@ class InferenceEngine:
                 "finish_reason": req.finish_reason,
                 "ttft_secs": req.ttft_secs(),
                 "latency_secs": req.latency_secs(),
+                "paged_kernel": self.paged_kernel,
                 "queue_depth": self.queue.depth(),
                 "blocks_free": bstats["blocks_free"],
                 "blocks_in_use": bstats["blocks_in_use"],
@@ -514,7 +543,10 @@ class InferenceEngine:
 
     def warmup(self) -> None:
         """Compile the steady-state programs (prefill chunk, first-token
-        sampler, decode step) with one dummy greedy request.  Call before
+        sampler, decode step) with one dummy greedy request.  The decode
+        step bakes in the resolved paged-attention path (Pallas ragged
+        kernel or XLA gather — a static config field), so the kernel
+        compiles here exactly once.  Call before
         ``tracing.RecompileDetector.mark_steady()`` — after this, serving
         arbitrary requests triggers zero compiles."""
         assert self._thread is None, "warm up before start()"
@@ -563,5 +595,6 @@ class InferenceEngine:
             "decode_secs": round(self.decode_secs, 6),
             "finished": dict(self.finished),
             "warmed_up": self.warmed_up,
+            "paged_kernel": self.paged_kernel,
         })
         return s

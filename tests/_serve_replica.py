@@ -9,8 +9,13 @@ Prints ``PORT <n>`` on stdout once the HTTP server is accepting, then
 serves until killed.  Uses the same tiny llama + numeric fake tokenizer
 as tests/test_serving_http.py, so prompts are space-separated ints and
 greedy outputs are deterministic across replicas.
+
+``--paged_kernel {auto,on,off}`` selects the paged-attention decode
+path; ``on`` additionally flips the Pallas kernel into interpret mode
+so the kernel-vs-XLA serve_bench A/B runs end-to-end on CPU.
 """
 
+import argparse
 import os
 import sys
 import threading
@@ -41,6 +46,15 @@ class _FakeTokenizer:
 
 
 def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--paged_kernel", choices=["auto", "on", "off"],
+                   default="auto")
+    args = p.parse_args()
+    if args.paged_kernel == "on":
+        # no TPU in the test environment: run the Pallas kernel in
+        # interpret mode so decode_kernel_available() is true on CPU
+        from megatron_llm_tpu.ops.pallas import paged_attention
+        paged_attention._INTERPRET = True
     cfg = llama_config("tiny", num_layers=2, seq_length=64,
                        max_position_embeddings=64, padded_vocab_size=64,
                        use_flash_attn=False)
@@ -48,7 +62,8 @@ def main():
     params = model.init(jax.random.PRNGKey(0))
     engine = InferenceEngine(model, params, EngineConfig(
         num_slots=4, block_size=8, prefill_chunk=16, max_model_len=64,
-        max_queue_depth=32, default_deadline_secs=60.0))
+        max_queue_depth=32, default_deadline_secs=60.0,
+        paged_kernel=args.paged_kernel))
     engine.warmup()
     engine.start()
     server = MegatronServer(model, params, _FakeTokenizer(),

@@ -96,3 +96,54 @@ def test_rolling_plus_int8_refused(model_and_params):
     cfg = model.cfg.replace(sliding_window_size=8)
     with pytest.raises(AssertionError):
         init_kv_caches(cfg, 1, 32, rolling=True, quantized=True)
+
+
+def test_paged_int8_sliding_window_drift_bounded(model_and_params):
+    """int8 PAGED pools combined with a sliding window (the serving
+    engine's XLA gather branch) track the float linear cache within the
+    quantization drift bound — the window mask and the in-gather
+    dequant compose."""
+    from megatron_llm_tpu.models.language_model import language_model_forward
+    from megatron_llm_tpu.models.llama import LlamaModel
+    from megatron_llm_tpu.text_generation.generation import (
+        init_paged_kv_caches,
+    )
+
+    model, params = model_and_params
+    wcfg = model.cfg.replace(sliding_window_size=8,
+                             paged_attention_kernel="off")
+    toks = jnp.asarray([[3, 5, 7, 9, 11, 13, 2, 4, 6, 8, 10, 12]],
+                       jnp.int32)                  # 12 tokens > window 8
+    nxt = jnp.asarray([[2]], jnp.int32)
+    # baseline: float LINEAR cache through the same windowed config
+    wmodel = LlamaModel(wcfg)
+    caches = init_kv_caches(wcfg, 1, 16)
+    _, caches = _forward_with_cache(wmodel, params, toks, caches, 0)
+    logits_fp, _ = _forward_with_cache(wmodel, params, nxt, caches,
+                                       toks.shape[1])
+    fp = np.asarray(logits_fp[0, -1], np.float32)
+    # int8 paged pools: prefill then one decode step through the paged
+    # branch (block table covers 13 tokens at block_size 8 -> 2 pages)
+    bs, M = 8, 2
+    pages = init_paged_kv_caches(wcfg, 1 + M, bs, quantized=True)
+    bt = jnp.asarray(np.arange(1, M + 1)[None, :], jnp.int32)
+    caches = [dict(p, block_tables=bt,
+                   context_lens=jnp.zeros((1,), jnp.int32),
+                   valid_lens=jnp.asarray([toks.shape[1]], jnp.int32))
+              for p in pages]
+    positions = jnp.arange(toks.shape[1])[None, :]
+    _, caches = language_model_forward(params, toks, positions, None,
+                                       wcfg, rng_key=None, train=False,
+                                       kv_caches=caches)
+    pages2 = [{k: v for k, v in c.items() if "pages" in k}
+              for c in caches]
+    caches = [dict(p, block_tables=bt,
+                   context_lens=jnp.asarray([toks.shape[1]], jnp.int32),
+                   valid_lens=jnp.ones((1,), jnp.int32))
+              for p in pages2]
+    logits_q, _ = language_model_forward(
+        params, nxt, jnp.asarray([[toks.shape[1]]], jnp.int32), None,
+        wcfg, rng_key=None, train=False, kv_caches=caches)
+    q8 = np.asarray(logits_q[0, -1], np.float32)
+    scale = float(np.std(fp)) + 1e-6
+    assert float(np.max(np.abs(q8 - fp))) / scale < 0.2

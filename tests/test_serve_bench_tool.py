@@ -3,8 +3,11 @@ no model, no jax: the bench must measure and aggregate correctly, and
 its CLI must emit the table and --json forms."""
 
 import json
+import os
+import subprocess
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
@@ -14,8 +17,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
 import serve_bench  # noqa: E402
 
 
-@pytest.fixture()
-def stub_server():
+def _start_stub(paged_kernel="xla"):
     """Mimics the /api, /api/stream and /metrics contract with canned
     responses (every request generates 3 tokens on a 2-token prompt)."""
     metrics = {"requests": 0, "errors": 0, "throttled": 0}
@@ -64,6 +66,7 @@ def stub_server():
                     "prefix_cache_hits": 2 * n,
                     "prefix_cache_misses": n,
                     "prefix_cache_evictions": 0,
+                    "paged_kernel": paged_kernel,
                 }
                 self._json(200, body)
             else:
@@ -75,7 +78,13 @@ def stub_server():
     httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
-    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+@pytest.fixture()
+def stub_server():
+    httpd, url = _start_stub()
+    yield url
     httpd.shutdown()
 
 
@@ -174,3 +183,114 @@ def test_percentile_helper():
     vals = [float(i) for i in range(1, 101)]
     assert serve_bench._percentile(vals, 0.50) == pytest.approx(50.0, abs=1)
     assert serve_bench._percentile(vals, 0.95) == pytest.approx(95.0, abs=1)
+
+
+# ---------------------------------------------------------------------------
+# kernel A/B (--ab serve_paged_kernel)
+# ---------------------------------------------------------------------------
+
+def test_bench_reports_paged_kernel(stub_server):
+    r = serve_bench.run_bench(stub_server, clients=2, requests=3, tokens=3)
+    assert r["paged_kernel"] == "xla"     # the stub's engine attribution
+
+
+def test_run_ab_tags_arms():
+    """run_ab runs the identical workload once per arm and tags every
+    row with its arm label plus the server's self-reported attention
+    path — the full --json schema holds per row."""
+    on_httpd, on_url = _start_stub("pallas")
+    off_httpd, off_url = _start_stub("xla")
+    try:
+        rows = serve_bench.run_ab([on_url, off_url], ["on", "off"],
+                                  clients=2, requests=3, tokens=3)
+        assert [r["ab_arm"] for r in rows] == ["on", "off"]
+        assert [r["paged_kernel"] for r in rows] == ["pallas", "xla"]
+        for r in rows:
+            assert r["ok"] == 3 and r["errors"] == 0
+            for key in serve_bench.JSON_SCHEMA_KEYS:
+                assert key in r, f"missing --json schema key: {key}"
+    finally:
+        on_httpd.shutdown()
+        off_httpd.shutdown()
+
+
+def test_cli_ab_json_and_table(capsys):
+    on_httpd, on_url = _start_stub("pallas")
+    off_httpd, off_url = _start_stub("xla")
+    try:
+        rc = serve_bench.main(["--url", on_url, "--ab",
+                               "serve_paged_kernel", "--ab_url", off_url,
+                               "--clients", "2", "--requests", "3",
+                               "--tokens", "3", "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ab"] == "serve_paged_kernel"
+        assert [r["ab_arm"] for r in out["rows"]] == ["on", "off"]
+        rc = serve_bench.main(["--url", on_url, "--ab",
+                               "serve_paged_kernel", "--ab_url", off_url,
+                               "--clients", "2", "--requests", "3",
+                               "--tokens", "3"])
+        assert rc == 0
+        table = capsys.readouterr().out
+        assert "serve_paged_kernel=on" in table
+        assert "serve_paged_kernel=off" in table
+        assert "A/B token throughput" in table
+    finally:
+        on_httpd.shutdown()
+        off_httpd.shutdown()
+
+
+def test_cli_ab_requires_ab_url():
+    with pytest.raises(SystemExit):
+        serve_bench.main(["--url", "http://127.0.0.1:1", "--ab",
+                          "serve_paged_kernel", "--requests", "1"])
+
+
+def _spawn_replica(paged_kernel, timeout=240.0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)      # single-device child, no 8-dev mesh
+    here = os.path.dirname(__file__)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(here, "_serve_replica.py"),
+         "--paged_kernel", paged_kernel],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True, cwd=os.path.dirname(here))
+    deadline = time.monotonic() + timeout
+    port = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("PORT "):
+            port = int(line.split()[1])
+            break
+        if proc.poll() is not None:
+            raise RuntimeError("replica died during startup")
+    assert port, "replica did not report a port in time"
+    return proc, port
+
+
+@pytest.mark.slow
+def test_ab_end_to_end_two_engines(capsys):
+    """Acceptance: the one-flag kernel A/B runs end-to-end on CPU — two
+    real engine subprocesses (Pallas interpret-mode kernel vs XLA
+    gather), one serve_bench invocation, one throughput row per path."""
+    p_on, port_on = _spawn_replica("on")
+    p_off, port_off = _spawn_replica("off")
+    try:
+        rc = serve_bench.main([
+            "--url", f"http://127.0.0.1:{port_on}",
+            "--ab", "serve_paged_kernel",
+            "--ab_url", f"http://127.0.0.1:{port_off}",
+            "--clients", "2", "--requests", "4", "--tokens", "8",
+            "--timeout", "180", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        rows = out["rows"]
+        assert [r["ab_arm"] for r in rows] == ["on", "off"]
+        assert rows[0]["paged_kernel"] == "pallas"
+        assert rows[1]["paged_kernel"] == "xla"
+        for r in rows:
+            assert r["errors"] == 0 and r["tokens_per_sec"] > 0
+    finally:
+        for p in (p_on, p_off):
+            p.kill()
+            p.wait()

@@ -312,6 +312,53 @@ def test_engine_stats_shape(engine):
     s = engine.stats()
     for key in ("queue_depth", "mean_batch_occupancy", "decode_steps",
                 "prefill_chunks", "tokens_generated", "prefill_secs",
-                "decode_secs", "blocks_in_use", "finished", "warmed_up"):
+                "decode_secs", "blocks_in_use", "finished", "warmed_up",
+                "paged_kernel"):
         assert key in s
     assert s["warmed_up"] is True
+    # resolved attention path, not the requested mode
+    assert s["paged_kernel"] in ("pallas", "xla")
+
+
+def test_engine_paged_kernel_token_identity(model_and_params):
+    """Acceptance: greedy decode through the Pallas ragged kernel
+    (interpret mode on CPU) is token-identical to the XLA gather
+    branch, the engine reports the resolved path, and the kernel-on
+    engine stays zero-recompile after warmup."""
+    from megatron_llm_tpu.ops.pallas import paged_attention as pa
+    model, params = model_and_params
+    prompts = [[5, 6, 7, 8, 9], [1, 2, 3]]
+    outs = []
+    old = pa._INTERPRET
+    try:
+        for mode in ("off", "on"):
+            pa._INTERPRET = mode == "on"
+            eng = InferenceEngine(model, params, EngineConfig(
+                num_slots=2, block_size=8, prefill_chunk=16,
+                max_model_len=64, default_deadline_secs=0.0,
+                paged_kernel=mode))
+            assert eng.paged_kernel == ("pallas" if mode == "on" else "xla")
+            eng.warmup()
+            eng.start()
+            det = None
+            if mode == "on":
+                tracer = tracing.SpanTracer()
+                det = tracing.RecompileDetector(tracer)
+                tracing.install_tracing(
+                    tracing.Tracing(tracer=tracer, recompile=det))
+                det.mark_steady()
+            try:
+                rs = [eng.submit(p, SamplingParams(max_new_tokens=8,
+                                                   **GREEDY))
+                      for p in prompts]
+                outs.append([r.result(timeout=180).tokens for r in rs])
+            finally:
+                eng.stop()
+                if det is not None:
+                    tracing.install_tracing(None)
+            if det is not None:
+                assert det.recompiles == 0, \
+                    f"{det.recompiles} recompiles: {list(det.events)}"
+    finally:
+        pa._INTERPRET = old
+    assert outs[0] == outs[1]

@@ -76,9 +76,12 @@ def main():
             default_deadline_secs=args.serve_deadline_secs,
             int8_kv_cache=args.int8_kv_cache,
             prefix_cache=bool(args.serve_prefix_cache),
+            paged_kernel=args.serve_paged_kernel,
         ))
         print(" * warming up serving engine (compiling prefill/decode "
               "programs)...", flush=True)
+        print(f" * paged-attention decode path: {engine.paged_kernel}",
+              flush=True)
         engine.warmup()
         from megatron_llm_tpu import tracing
         tr = tracing.get_tracing()

@@ -19,12 +19,21 @@ N-word prompt header and differ only in a short unique tail, so cache
 hits show up as ``prefill_tokens_computed`` ≪ ``prefill_tokens_
 submitted`` (the ``prefill computed/submitted`` bench column).
 
+Kernel A/B (``--ab serve_paged_kernel``) runs the identical workload
+against two servers — one started with ``--serve_paged_kernel on``
+(``--url``) and one with ``off`` (``--ab_url``) — and emits one result
+row per arm, each tagged with ``ab_arm`` and the server's self-reported
+``paged_kernel`` path, so the Pallas-vs-XLA decode throughput delta
+falls out of a single invocation.
+
 Examples::
 
     python tools/serve_bench.py --port 5000 --clients 16 --requests 64
     python tools/serve_bench.py --clients 8 --rate 4 --stream --json
     python tools/serve_bench.py --clients 8 --requests 32 \\
         --prefix_tokens 256 --shared_prefix_frac 0.75 --json
+    python tools/serve_bench.py --url http://host:5000 \\
+        --ab serve_paged_kernel --ab_url http://host:5001 --json
 """
 
 from __future__ import annotations
@@ -50,7 +59,7 @@ JSON_SCHEMA_KEYS = (
     "shared_prefix_frac", "prefill_tokens_submitted",
     "prefill_tokens_computed", "prefill_tokens_cached",
     "prefill_computed_frac", "prefix_cache_hits", "prefix_cache_misses",
-    "prefix_cache_evictions",
+    "prefix_cache_evictions", "paged_kernel",
 )
 
 
@@ -228,6 +237,9 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         "prefix_cache_hits": None,
         "prefix_cache_misses": None,
         "prefix_cache_evictions": None,
+        # which attention path served the run ('pallas'|'xla', from the
+        # engine /metrics block) — makes bench rows attributable
+        "paged_kernel": None,
     }
     if m0 is not None and m1 is not None:
         # a router /metrics nests the fleet-summed engine counters (and
@@ -243,6 +255,7 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         e0, e1 = m0.get("engine"), m1.get("engine")
         if isinstance(e1, dict):
             out["server_engine"] = e1
+            out["paged_kernel"] = e1.get("paged_kernel")
             if isinstance(e0, dict):
                 def delta(key):
                     a, b = e0.get(key), e1.get(key)
@@ -261,6 +274,19 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                 if sub and comp is not None:
                     out["prefill_computed_frac"] = round(comp / sub, 4)
     return out
+
+
+def run_ab(urls, labels, **kw) -> list:
+    """Kernel A/B: run the identical workload once per arm (a server
+    started with ``--serve_paged_kernel on`` and one with ``off``) and
+    tag each row with its arm label plus the attention path the server
+    actually reports — both rows land in the ``--json`` output."""
+    rows = []
+    for label, url in zip(labels, urls):
+        r = run_bench(url, **kw)
+        r["ab_arm"] = label
+        rows.append(r)
+    return rows
 
 
 def _fmt(v, unit=""):
@@ -292,6 +318,7 @@ def print_table(r: dict) -> None:
             ("engine occupancy", _fmt(eng.get("mean_batch_occupancy"))),
             ("engine decode steps", _fmt(eng.get("decode_steps"))),
             ("engine prefill chunks", _fmt(eng.get("prefill_chunks"))),
+            ("engine paged kernel", _fmt(r.get("paged_kernel"))),
         ]
     if r.get("prefill_tokens_submitted") is not None:
         rows += [
@@ -340,13 +367,39 @@ def main(argv=None):
                         "rest get unique same-length headers")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit one JSON object instead of the table")
+    p.add_argument("--ab", choices=["serve_paged_kernel"], default=None,
+                   help="A/B comparison: run the workload against --url "
+                        "(the flag-ON server) and --ab_url (the flag-OFF "
+                        "server), emitting one row per arm")
+    p.add_argument("--ab_url", default=None,
+                   help="base URL of the second (flag-OFF) server for "
+                        "--ab")
     args = p.parse_args(argv)
     base_url = args.url or f"http://{args.host}:{args.port}"
-    r = run_bench(base_url, clients=args.clients, requests=args.requests,
-                  tokens=args.tokens, prompt=args.prompt, rate=args.rate,
-                  stream=args.stream, timeout=args.timeout, seed=args.seed,
-                  prefix_tokens=args.prefix_tokens,
-                  shared_prefix_frac=args.shared_prefix_frac)
+    kw = dict(clients=args.clients, requests=args.requests,
+              tokens=args.tokens, prompt=args.prompt, rate=args.rate,
+              stream=args.stream, timeout=args.timeout, seed=args.seed,
+              prefix_tokens=args.prefix_tokens,
+              shared_prefix_frac=args.shared_prefix_frac)
+    if args.ab:
+        if not args.ab_url:
+            p.error("--ab needs --ab_url (the second arm's server)")
+        rows = run_ab([base_url, args.ab_url], ["on", "off"], **kw)
+        if args.as_json:
+            print(json.dumps({"ab": args.ab, "rows": rows}, indent=2))
+        else:
+            for r in rows:
+                print(f"--- {args.ab}={r['ab_arm']} "
+                      f"(served by: {r.get('paged_kernel') or 'unknown'})")
+                print_table(r)
+            on, off = rows
+            if on["tokens_per_sec"] and off["tokens_per_sec"]:
+                print(f"A/B token throughput on/off: "
+                      f"{on['tokens_per_sec']:.3f} / "
+                      f"{off['tokens_per_sec']:.3f} tok/s "
+                      f"({on['tokens_per_sec'] / off['tokens_per_sec']:.2f}x)")
+        return 0 if all(r["errors"] == 0 for r in rows) else 1
+    r = run_bench(base_url, **kw)
     if args.as_json:
         print(json.dumps(r, indent=2))
     else:
