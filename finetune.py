@@ -123,14 +123,20 @@ def model_provider(args):
     return MODEL_REGISTRY[args.model_name](cfg)
 
 
-def build_data_iterator(args, mesh, num_micro):
+def build_data_iterator(args, mesh, num_micro, consumed_samples=0):
     """Packed GPT or instruction dataset -> global-batch iterator with dp
     sharding applied (reference: build_train_valid_test_data_iterators,
-    training.py:877; data only needs loading once per process)."""
+    training.py:877; data only needs loading once per process).
+
+    ``consumed_samples`` (from the checkpoint meta) drives the sampler's
+    deterministic skip so an elastic resume — possibly at a different
+    dp x slice product — continues the same global sample order."""
+    # total data parallelism: the batch dim spans ('slice', 'dp')
+    total_dp = args.data_parallel_size * getattr(args, "num_slices", 1)
     if args.data_path is None:
         # synthetic data (smoke/bench runs)
         rng = np.random.RandomState(args.seed)
-        mb = args.micro_batch_size * args.data_parallel_size
+        mb = args.micro_batch_size * total_dp
 
         def synth():
             while True:
@@ -165,7 +171,7 @@ def build_data_iterator(args, mesh, num_micro):
             scalar_loss_mask=args.scalar_loss_mask,
         )
         host_iter = iter(build_pretraining_data_loader(
-            ds, 0, args.micro_batch_size, args.data_parallel_size,
+            ds, consumed_samples, args.micro_batch_size, total_dp,
             num_micro, args.dataloader_type, args.seed, collate_fn=collate,
         ))
         eval_iter = None
@@ -185,15 +191,15 @@ def build_data_iterator(args, mesh, num_micro):
             args.seq_length, args.seed, args.data_impl,
         )
         host_iter = iter(build_pretraining_data_loader(
-            train_ds, 0, args.micro_batch_size, args.data_parallel_size,
+            train_ds, consumed_samples, args.micro_batch_size, total_dp,
             num_micro, args.dataloader_type, args.seed,
         ))
         eval_iter = (iter(build_pretraining_data_loader(
-            valid_ds, 0, args.micro_batch_size, args.data_parallel_size,
+            valid_ds, 0, args.micro_batch_size, total_dp,
             num_micro, args.dataloader_type, args.seed,
         )) if valid_ds is not None else None)
 
-    dsh = NamedSharding(mesh, P(None, "dp", None))
+    dsh = NamedSharding(mesh, P(None, topology.data_axes(), None))
 
     def shard(it):
         if it is None:
@@ -320,13 +326,14 @@ def main():
     tc = train_config_from_args(args)
     pc = parallel_config_from_args(args)
     num_micro = args.global_batch_size // (
-        args.micro_batch_size * args.data_parallel_size
+        args.micro_batch_size * args.data_parallel_size * args.num_slices
     )
 
     # params: fresh init or checkpoint
     params = None
     start_iteration = 0
     opt_state = None
+    consumed_samples = 0
     if args.load:
         # abstract template (shapes + current-mesh shardings, no device
         # memory) makes the orbax restore direct-to-device on THIS mesh —
@@ -350,6 +357,18 @@ def main():
         if params is not None:
             start_iteration = meta["iteration"]
             print(f" loaded checkpoint at iteration {start_iteration}")
+            if not args.finetune:
+                # elastic resume: continue the cumulative sample count and
+                # the deterministic data order from where the checkpoint
+                # left off (the resharding restore above already handled a
+                # different dp x slice mesh); announce + JSONL-log a fleet
+                # shape change against the saved run_shape.json
+                consumed_samples = int(meta.get("consumed_samples", 0) or 0)
+                get_counters()["samples"] = consumed_samples
+                from megatron_llm_tpu import multislice
+                multislice.announce_elastic_resume(
+                    args.load, args, start_iteration, consumed_samples,
+                    stream=getattr(telemetry, "stream", None))
     if params is None:
         params = model.init(jax.random.PRNGKey(args.seed))
 
@@ -421,7 +440,8 @@ def main():
         print(f" > LoRA rank {args.lora_rank}: {n_ad/1e6:.2f}M adapter "
               f"params trainable, base frozen", flush=True)
 
-    train_iter, eval_iter = build_data_iterator(args, mesh, num_micro)
+    train_iter, eval_iter = build_data_iterator(
+        args, mesh, num_micro, consumed_samples=consumed_samples)
 
     optimizer = MegatronOptimizer(
         tc, params_dtype=jax.tree_util.tree_leaves(params)[0].dtype
@@ -578,6 +598,7 @@ def main():
             exit_interval=getattr(args, "exit_interval", None),
             exit_duration_in_mins=getattr(args, "exit_duration_in_mins",
                                           None),
+            preempt_exit_code=getattr(args, "preempt_exit_code", 0) or 0,
         )
     finally:
         # stop the watchdog thread + uninstall the fault hook on every

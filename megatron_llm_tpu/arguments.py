@@ -272,6 +272,22 @@ def _add_distributed_args(parser):
                         "seq/cp, falls back to ring otherwise)")
     g.add_argument("--use_distributed_optimizer", action="store_true")
     g.add_argument("--expert_model_parallel_size", type=int, default=1)
+    # multi-slice (MegaScale-tier): DCN data parallelism across pod slices
+    g.add_argument("--num_slices", type=int, default=1,
+                   help="number of TPU pod slices joined over DCN; the mesh "
+                        "gains an outer 'slice' axis and total data "
+                        "parallelism is num_slices x data_parallel_size "
+                        "(see docs/guide/multislice.md)")
+    g.add_argument("--multislice_flat_reduce", action="store_true",
+                   help="disable the explicit hierarchical (ICI-then-DCN) "
+                        "gradient reduction and use one flat all-reduce "
+                        "over ('slice','dp'), deferring DCN staging to the "
+                        "compiler's collective lowering")
+    g.add_argument("--preempt_exit_code", type=int, default=None,
+                   help="process exit code after a consensus preemption "
+                        "rescue save (default: 17 when --num_slices > 1 so "
+                        "the fleet supervisor restarts the job, else 0 for "
+                        "single-job backward compatibility)")
     g.add_argument("--distributed_backend", default="xla",
                    choices=["xla", "nccl", "gloo"])  # nccl/gloo accepted, mapped to xla
     g.add_argument("--device", default="tpu", choices=["tpu", "cpu"])
@@ -630,8 +646,20 @@ def validate_args(args, world_size: Optional[int] = None):
         f"({args.pipeline_model_parallel_size}) x cp "
         f"({args.context_parallel_size})"
     )
+    num_slices = int(getattr(args, "num_slices", 1) or 1)
+    args.num_slices = num_slices
+    assert world_size % num_slices == 0 and world_size % (mp * num_slices) == 0, (
+        f"world size ({world_size}) not divisible by num_slices "
+        f"({num_slices}) x tp x pp x cp ({mp})"
+    )
     args.world_size = world_size
-    args.data_parallel_size = world_size // mp   # reference: arguments.py:76
+    # PER-SLICE dp (the mesh's dp axis); total data parallelism is
+    # num_slices * data_parallel_size.  reference: arguments.py:76
+    args.data_parallel_size = world_size // (mp * num_slices)
+    # preemption policy: exit 17 (shared with the hang watchdog) so a
+    # fleet supervisor restarts the job; single-job runs keep exit 0
+    if getattr(args, "preempt_exit_code", None) is None:
+        args.preempt_exit_code = 17 if num_slices > 1 else 0
 
     if getattr(args, "profile", False):
         assert args.profile_step_end >= args.profile_step_start, (
@@ -674,11 +702,17 @@ def validate_args(args, world_size: Optional[int] = None):
     assert not (args.fp16 and args.bf16)
     args.params_dtype = "fp16" if args.fp16 else "bf16" if args.bf16 else "fp32"
 
+    # batch math runs on TOTAL data parallelism (dp x slices)
+    total_dp = args.data_parallel_size * args.num_slices
     if args.global_batch_size is None:
-        args.global_batch_size = args.micro_batch_size * args.data_parallel_size
+        args.global_batch_size = args.micro_batch_size * total_dp
     assert args.global_batch_size % (
-        args.micro_batch_size * args.data_parallel_size
-    ) == 0
+        args.micro_batch_size * total_dp
+    ) == 0, (
+        f"global batch ({args.global_batch_size}) not divisible by micro "
+        f"batch ({args.micro_batch_size}) x dp ({args.data_parallel_size}) "
+        f"x slices ({args.num_slices})"
+    )
 
     # big-vocab fused CE policy (VERDICT r4 #7) — one idempotent
     # helper, re-fired whenever the known vocab grows (tokenizer
@@ -832,4 +866,19 @@ def parallel_config_from_args(args) -> ParallelConfig:
         use_distributed_optimizer=args.use_distributed_optimizer,
         expert_model_parallel_size=args.expert_model_parallel_size,
         context_parallel_size=args.context_parallel_size,
+        num_slices=getattr(args, "num_slices", 1) or 1,
+        multislice_hierarchical=_resolve_hierarchical(args),
     )
+
+
+def _resolve_hierarchical(args) -> bool:
+    """Explicit ICI-then-DCN staging is on for pure-DP multi-slice runs
+    unless --multislice_flat_reduce opts out; in-slice model parallelism
+    (tp/pp/cp > 1) always takes the flat ('slice','dp') reduction."""
+    if (getattr(args, "num_slices", 1) or 1) <= 1:
+        return False
+    if getattr(args, "multislice_flat_reduce", False):
+        return False
+    return (args.tensor_model_parallel_size == 1
+            and args.pipeline_model_parallel_size == 1
+            and args.context_parallel_size == 1)

@@ -325,9 +325,21 @@ def save_checkpoint(
     for attempt in range(retries + 1):
         try:
             _fault_hook_check()
-            if tmp_dir.exists():
-                shutil.rmtree(tmp_dir)
-            tmp_dir.mkdir(parents=True)
+            if jax.process_count() > 1:
+                # multi-process (fleet rescue) saves: only process 0 preps
+                # the tmp dir, and a barrier keeps the other hosts from
+                # writing into it while the cleanup runs
+                if jax.process_index() == 0:
+                    if tmp_dir.exists():
+                        shutil.rmtree(tmp_dir)
+                    tmp_dir.mkdir(parents=True)
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices(
+                    f"ckpt_tmp_{iteration}_{attempt}")
+            else:
+                if tmp_dir.exists():
+                    shutil.rmtree(tmp_dir)
+                tmp_dir.mkdir(parents=True)
             if async_save:
                 # at most one outstanding save: the previous one becomes
                 # durable (rename + tracker) before this one starts;
@@ -372,6 +384,17 @@ def save_checkpoint(
                           str(tmp_dir), str(final_dir))
     else:
         _commit_checkpoint(save_dir, iteration, release, tmp_dir, final_dir)
+
+    # elastic resume: record the fleet shape that produced this checkpoint
+    # (run_shape.json at the save-dir root; best effort, process 0 only)
+    # so the next run can detect + log a dp x slice change on load
+    try:
+        from megatron_llm_tpu import multislice
+        shape = multislice.run_shape_from_mesh()
+        if shape:
+            multislice.write_run_shape(save_dir, shape)
+    except Exception:
+        pass
     return str(final_dir)
 
 

@@ -64,6 +64,17 @@ class ParallelConfig:
     # Expert parallelism size (MoE). The reference has no MoE; we support it
     # as a TPU-native extension (axis folded into dp during non-MoE ops).
     expert_model_parallel_size: int = 1
+    # Multi-slice (MegaScale-tier): number of TPU pod slices joined over
+    # DCN; the mesh gains an outer 'slice' axis and data parallelism is
+    # num_slices * data_parallel_size (data_parallel_size stays the
+    # *per-slice* dp, matching the mesh's dp axis).
+    num_slices: int = 1
+    # Stage the gradient all-reduce ICI-first/DCN-second via the explicit
+    # slice-vmap forward (multislice.sliced_forward). Resolved at arg
+    # validation: on for pure-DP multi-slice runs, off (flat GSPMD
+    # reduction over ('slice','dp')) when in-slice model parallelism is
+    # active or --multislice_flat_reduce is passed.
+    multislice_hierarchical: bool = False
 
     @property
     def world_size(self) -> int:
@@ -72,6 +83,7 @@ class ParallelConfig:
             * self.pipeline_model_parallel_size
             * self.data_parallel_size
             * self.context_parallel_size
+            * self.num_slices
         )
 
 

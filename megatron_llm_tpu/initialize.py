@@ -61,7 +61,9 @@ def initialize_megatron(
     global_vars.set_num_microbatches_calculator(
         build_num_microbatches_calculator(
             args.global_batch_size, args.micro_batch_size,
-            args.data_parallel_size, args.rampup_batch_size,
+            # total data parallelism: per-slice dp x slices
+            args.data_parallel_size * args.num_slices,
+            args.rampup_batch_size,
         )
     )
 
@@ -70,5 +72,6 @@ def initialize_megatron(
         pipeline_model_parallel_size=args.pipeline_model_parallel_size,
         virtual_pipeline_model_parallel_size=args.virtual_pipeline_model_parallel_size,
         context_parallel_size=args.context_parallel_size,
+        num_slices=args.num_slices,
     )
     return args

@@ -1,0 +1,361 @@
+"""Multi-slice elastic training runtime (MegaScale tier).
+
+The single-job mesh (``topology.py``) scales tp/pp/cp/dp inside one pod
+slice over ICI.  This module is the runtime layer above it, per MegaScale
+(arXiv 2402.15627, PAPERS.md): data parallelism *across* pod slices over
+DCN, restart at a different ``dp x slice`` product from the same
+checkpoint, preemption-aware rescue of the whole fleet, and per-slice
+attribution so a slow slice is named the way a NaN layer is
+(``health.py`` precedent).
+
+Four pieces:
+
+1. **Hierarchical gradient all-reduce** — ICI first, DCN second.  Under
+   GSPMD the dp gradient reduction is implicit (inserted where the loss
+   mean crosses the batch axis), and a batch spanning ``('slice', 'dp')``
+   would fold both hops into one flat collective.  ``sliced_forward``
+   instead gives the computation an *explicit* slice dimension: the batch
+   reshapes to ``[slice, batch/slice, ...]``, the params broadcast to a
+   per-slice leading axis, and the model runs under ``jax.vmap(...,
+   spmd_axis_name='slice')``.  The per-slice parameter-gradient
+   contraction then reduces over in-slice axes only (ICI all-reduce), and
+   the broadcast's transpose sums the per-slice gradients over the
+   ``slice`` axis (a separate DCN all-reduce) — two staged collectives,
+   per-slice math unchanged.  The explicit manual-region primitive
+   (``hierarchical_psum``) backs the CPU integration tests that check the
+   staged reduction is checksum-identical to a flat all-reduce.
+
+2. **Elastic resume** — ``run_shape.json`` written next to checkpoints
+   records the shape that produced them; on load the resume path detects
+   a ``dp x slice`` change, logs it into the JSONL stream
+   (``kind: 'elastic_resume'``), and the consumed-samples counter from
+   the checkpoint meta drives the data sampler's deterministic skip, so
+   the new fleet shape continues the same sample order.  The cross-mesh
+   restore itself is ``checkpointing.py``'s resharding-on-load.
+
+3. **Preemption rescue** — a SIGTERM on any one slice reaches the whole
+   fleet through ``DistributedSignalHandler``'s boundary consensus; the
+   train loop then makes a rescue save and the entire fleet exits with
+   ``PREEMPT_EXIT_CODE`` (17, shared with the hang watchdog) so the
+   scheduler restarts it — possibly at a different shape (see 2).
+
+4. **Per-slice attribution** — ``host_slice_map`` + ``slice_times`` turn
+   the cross-host timer gathers (``timers.report``) into per-slice step
+   times; ``tracing.StragglerDetector`` names the slice on every event
+   and the JSONL stream carries ``slice_times`` / ``worst_slice`` fields
+   (telemetry schema 4), aggregated offline by
+   ``tools/telemetry_report.py`` / ``tools/trace_report.py``.
+
+Env contract (docs/guide/multislice.md): processes are launched with
+contiguous rank blocks per slice (ranks [0, P/S) are slice 0, ...);
+``MEGASCALE_SLICE_ID``, when set by the launcher, is validated against
+the derived id at mesh build.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from megatron_llm_tpu import topology
+
+SLICE_AXIS = topology.SLICE_AXIS
+
+# Whole-fleet exit code after a consensus preemption rescue — shared with
+# resilience.HangWatchdog.EXIT_CODE so "restart me" means one thing to
+# the supervisor regardless of which subsystem asked for it.
+from megatron_llm_tpu.resilience import PREEMPT_EXIT_CODE  # noqa: E402
+
+RUN_SHAPE_FILENAME = "run_shape.json"
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical (ICI-then-DCN) reduction
+# ---------------------------------------------------------------------------
+
+def hierarchical_psum(x, ici_axes: Sequence[str], dcn_axis: str = SLICE_AXIS):
+    """Two-stage all-reduce for use INSIDE a manual (shard_map) region:
+    psum over the in-slice ICI axes first, then a second psum over the
+    DCN ``slice`` axis.  Mathematically identical to one flat psum over
+    all the axes (addition is associative); structurally it keeps the
+    cross-DCN collective a separate, later hop."""
+    if ici_axes:
+        x = jax.lax.psum(x, tuple(ici_axes))
+    return jax.lax.psum(x, dcn_axis)
+
+
+def hierarchical_allreduce(x: jax.Array, mesh=None) -> jax.Array:
+    """Sum per-replica values with the staged ICI-then-DCN reduction.
+
+    ``x`` has leading dim ``slice * dp`` spanning ``('slice', 'dp')`` —
+    one partial value per data-parallel replica (a gradient shard, a
+    checksum).  Returns the total, replicated.  The flat counterpart for
+    parity checks is ``flat_allreduce``."""
+    mesh = mesh or topology.get_mesh()
+    ici = tuple(a for a in (topology.DP_AXIS,) if mesh.shape[a] >= 1)
+    fn = topology.shard_map(
+        lambda xs: hierarchical_psum(xs.sum(axis=0), ici),
+        mesh=mesh,
+        in_specs=P((SLICE_AXIS, topology.DP_AXIS)),
+        out_specs=P(),
+    )
+    return jax.jit(fn)(x)
+
+
+def flat_allreduce(x: jax.Array, mesh=None) -> jax.Array:
+    """Single flat psum over ``('slice', 'dp')`` — the reduction the
+    hierarchical path must be checksum-identical to."""
+    mesh = mesh or topology.get_mesh()
+    fn = topology.shard_map(
+        lambda xs: jax.lax.psum(xs.sum(axis=0),
+                                (SLICE_AXIS, topology.DP_AXIS)),
+        mesh=mesh,
+        in_specs=P((SLICE_AXIS, topology.DP_AXIS)),
+        out_specs=P(),
+    )
+    return jax.jit(fn)(x)
+
+
+# Trace-time flag: truthy while ``sliced_forward`` is tracing the model
+# under its slice-vmap.  ``parallel/sharding.py`` consults it so logical
+# 'batch' constraints inside the model stay plain 'dp' there (the vmap's
+# spmd_axis_name supplies the 'slice' entry); outside the vmap a
+# multi-slice batch constraint spans ('slice', 'dp').
+_HIER_TRACE_DEPTH = 0
+
+
+def hierarchical_forward_active() -> bool:
+    return _HIER_TRACE_DEPTH > 0
+
+
+def supports_hierarchical(parallel_cfg) -> bool:
+    """The explicit slice-vmap forward is used for pure-DP slices: with
+    in-slice model parallelism (tp/pp/cp > 1) the model forward nests its
+    own shard_maps, which do not compose with an outer vmap on this jax —
+    those configs keep the batch spanning ``('slice', 'dp')`` and defer
+    the DCN staging to the compiler's collective lowering."""
+    return (getattr(parallel_cfg, "num_slices", 1) > 1
+            and parallel_cfg.tensor_model_parallel_size == 1
+            and parallel_cfg.pipeline_model_parallel_size == 1
+            and parallel_cfg.context_parallel_size == 1)
+
+
+def sliced_forward(model, params, micro: Dict[str, Any], rng_key,
+                   num_slices: int, *, train: bool,
+                   sequence_parallel: bool, extra: Dict[str, Any]):
+    """Run the model with an explicit slice dimension (see module
+    docstring, piece 1).  Returns what ``model(...)`` returns, with the
+    per-slice leading axis merged back: per-token outputs reshape to the
+    flat global microbatch; per-slice scalars (MoE aux losses) mean over
+    slices (equal-sized slices, so that IS the global mean)."""
+    global _HIER_TRACE_DEPTH
+    S = num_slices
+    mesh = topology.get_mesh()
+
+    def split(x):
+        # [b, ...] -> [S, b/S, ...]; dim0 spans ('slice', 'dp') coming in,
+        # so the split is a relabeling, not a reshard
+        return x.reshape((S, x.shape[0] // S) + x.shape[1:])
+
+    def bcast(p):
+        # per-slice parameter replicas: logically [S, ...], physically one
+        # copy per slice (dim0 pinned to the slice axis; trailing dims
+        # replicated — the gated regime has no in-slice model parallelism).
+        # The broadcast's transpose is the explicit DCN gradient stage.
+        pb = jnp.broadcast_to(p[None], (S,) + p.shape)
+        return jax.lax.with_sharding_constraint(
+            pb, NamedSharding(mesh, P(SLICE_AXIS)))
+
+    p_s = jax.tree_util.tree_map(bcast, params)
+    tokens = split(micro["tokens"])
+    labels = split(micro["labels"])
+    extra_s = {k: split(v) for k, v in extra.items()}
+    sidx = jnp.arange(S)
+
+    def one_slice(p, tok, lab, i, ex):
+        key = None if rng_key is None else jax.random.fold_in(rng_key, i)
+        return model(p, tok, labels=lab, rng_key=key, train=train,
+                     sequence_parallel=sequence_parallel, **ex)
+
+    _HIER_TRACE_DEPTH += 1
+    try:
+        out = jax.vmap(one_slice, in_axes=(0, 0, 0, 0, 0),
+                       spmd_axis_name=SLICE_AXIS)(
+            p_s, tokens, labels, sidx, extra_s)
+    finally:
+        _HIER_TRACE_DEPTH -= 1
+
+    def merge(a):
+        if a.ndim >= 2:
+            return a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:])
+        return a.mean(axis=0)
+
+    return jax.tree_util.tree_map(merge, out)
+
+
+# ---------------------------------------------------------------------------
+# Elastic resume: run-shape persistence + consumed-samples reconciliation
+# ---------------------------------------------------------------------------
+
+def run_shape_from_mesh() -> Dict[str, Any]:
+    """The live mesh's fleet shape (the source of truth at save time);
+    empty when no mesh is initialized (unit tests saving ad hoc)."""
+    m = topology._MESH
+    if m is None:
+        return {}
+    return {
+        "world_size": int(m.size),
+        "processes": int(jax.process_count()),
+        "num_slices": int(m.shape[SLICE_AXIS]),
+        "data_parallel_size": int(m.shape[topology.DP_AXIS]),
+        "tensor_model_parallel_size": int(m.shape[topology.TP_AXIS]),
+        "pipeline_model_parallel_size": int(m.shape[topology.PP_AXIS]),
+        "context_parallel_size": int(m.shape[topology.CP_AXIS]),
+    }
+
+
+def run_shape_from_args(args) -> Dict[str, Any]:
+    return {
+        "world_size": int(getattr(args, "world_size", 0) or 0),
+        "processes": int(jax.process_count()),
+        "num_slices": int(getattr(args, "num_slices", 1) or 1),
+        "data_parallel_size": int(args.data_parallel_size),
+        "tensor_model_parallel_size": int(args.tensor_model_parallel_size),
+        "pipeline_model_parallel_size": int(
+            args.pipeline_model_parallel_size),
+        "context_parallel_size": int(args.context_parallel_size),
+        "global_batch_size": int(args.global_batch_size),
+        "micro_batch_size": int(args.micro_batch_size),
+    }
+
+
+def write_run_shape(save_dir: str, shape: Dict[str, Any]) -> Optional[str]:
+    """Record the fleet shape next to the checkpoints (process 0; best
+    effort — a shape file must never fail a save)."""
+    if not save_dir or jax.process_index() != 0:
+        return None
+    try:
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, RUN_SHAPE_FILENAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(shape, f, indent=1)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def read_run_shape(load_dir: str) -> Optional[Dict[str, Any]]:
+    if not load_dir:
+        return None
+    try:
+        with open(os.path.join(load_dir, RUN_SHAPE_FILENAME)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def detect_elastic_resume(load_dir: str, args) -> Optional[Dict[str, Any]]:
+    """Compare the checkpoint's recorded run shape against the current
+    one.  Returns an ``elastic_resume`` event dict when the ``dp x
+    slice`` product (or any parallel size) changed, else None.  No
+    recorded shape (pre-multislice checkpoints) is not a change."""
+    old = read_run_shape(load_dir)
+    if old is None:
+        return None
+    new = run_shape_from_args(args)
+    keys = ("num_slices", "data_parallel_size",
+            "tensor_model_parallel_size", "pipeline_model_parallel_size",
+            "context_parallel_size", "world_size")
+    changed = {k: (old.get(k), new[k]) for k in keys
+               if old.get(k) is not None and old.get(k) != new[k]}
+    if not changed:
+        return None
+    return {
+        "kind": "elastic_resume",
+        "changed": {k: {"from": o, "to": n} for k, (o, n) in changed.items()},
+        "old_shape": old,
+        "new_shape": new,
+    }
+
+
+def announce_elastic_resume(load_dir: str, args, iteration: int,
+                            consumed_samples: int,
+                            stream=None) -> Optional[Dict[str, Any]]:
+    """Detect + log a shape change on resume.  Prints on process 0 and
+    emits the event into the structured JSONL stream when one is
+    installed.  Returns the event (or None)."""
+    ev = detect_elastic_resume(load_dir, args)
+    if ev is None:
+        return None
+    ev = {**ev, "iteration": int(iteration),
+          "consumed_samples": int(consumed_samples)}
+    if jax.process_index() == 0:
+        deltas = ", ".join(
+            f"{k} {v['from']} -> {v['to']}" for k, v in ev["changed"].items())
+        print(f" > ELASTIC RESUME at iteration {iteration}: {deltas}; "
+              f"data order reconciled by skipping "
+              f"{consumed_samples} consumed samples", flush=True)
+    if stream is None:
+        try:
+            from megatron_llm_tpu import telemetry
+            stream = telemetry.get_stream()
+        except Exception:
+            stream = None
+    if stream is not None:
+        rec = dict(ev)
+        rec_kind = rec.pop("kind")
+        stream.emit({**rec, "kind": rec_kind})
+    return ev
+
+
+# ---------------------------------------------------------------------------
+# Per-slice attribution
+# ---------------------------------------------------------------------------
+
+def host_slice_map(process_count: Optional[int] = None,
+                   num_slices: Optional[int] = None) -> List[int]:
+    """Process index -> slice id, under the contiguous-rank-block launch
+    contract (slice outermost in the device order).  Degenerates to all
+    zeros when one process hosts every slice (virtual-device runs)."""
+    procs = process_count if process_count is not None else jax.process_count()
+    sl = num_slices if num_slices is not None else topology.num_slices_or_default()
+    if sl <= 1 or procs < sl:
+        return [0] * procs
+    return [p * sl // procs for p in range(procs)]
+
+
+def slice_times(per_host_secs: Sequence[float],
+                host_map: Sequence[int]) -> Dict[int, float]:
+    """Per-host section times -> per-slice times.  A slice is as slow as
+    its slowest host (everyone inside the slice waits on the ICI
+    collective; the fleet waits on the DCN one)."""
+    out: Dict[int, float] = {}
+    for host, secs in enumerate(per_host_secs):
+        s = host_map[host] if host < len(host_map) else 0
+        out[s] = max(out.get(s, 0.0), float(secs))
+    return out
+
+
+def worst_slice(times: Dict[int, float]) -> Optional[Dict[str, float]]:
+    """The slice the fleet is waiting on, with its lag over the median
+    of the others.  None when there is nothing to compare."""
+    if len(times) < 2:
+        return None
+    from statistics import median
+    worst = max(times, key=lambda s: times[s])
+    others = [v for s, v in times.items() if s != worst]
+    med = median(others)
+    return {
+        "slice": int(worst),
+        "secs": float(times[worst]),
+        "median_other_secs": float(med),
+        "lag_secs": float(times[worst] - med),
+        "ratio": float(times[worst] / med) if med > 0 else float("inf"),
+    }

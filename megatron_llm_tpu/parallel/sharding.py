@@ -33,6 +33,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from megatron_llm_tpu import topology
 
 DEFAULT_RULES = {
+    # 'batch' under the default rules is resolved dynamically by
+    # _batch_axes() — ('slice', 'dp') in a multi-slice mesh, 'dp'
+    # otherwise; this entry is the custom-rules fallback.
     "batch": topology.DP_AXIS,
     # 'seq' rides the cp axis: a no-op at cp=1, contiguous context-parallel
     # sequence sharding when cp>1 (ring attention handles the cross-chunk
@@ -53,11 +56,30 @@ DEFAULT_RULES = {
 }
 
 
+def _batch_axes():
+    """Mesh axes for the logical 'batch' dim, resolved at trace time.
+
+    Multi-slice runs span the batch over ('slice', 'dp') — except inside
+    the hierarchical slice-vmap forward (multislice.sliced_forward),
+    where the vmap's spmd_axis_name supplies the 'slice' entry and the
+    model-internal constraint must stay plain 'dp'."""
+    from megatron_llm_tpu import multislice
+
+    if multislice.hierarchical_forward_active():
+        return topology.DP_AXIS
+    axes = topology.data_axes()
+    return axes if len(axes) > 1 else axes[0]
+
+
 def logical_to_mesh(
     logical_spec: Sequence[Optional[str]], rules=None
 ) -> P:
     rules = rules or DEFAULT_RULES
-    return P(*(rules.get(a) for a in logical_spec))
+    def resolve(a):
+        if a == "batch" and rules is DEFAULT_RULES:
+            return _batch_axes()
+        return rules.get(a)
+    return P(*(resolve(a) for a in logical_spec))
 
 
 def _mesh() -> Optional[Mesh]:

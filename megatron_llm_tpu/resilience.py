@@ -64,6 +64,16 @@ from megatron_llm_tpu.global_vars import get_counters
 RECOVERY_COUNTER_KEYS = (
     "rewinds", "save_retries", "watchdog_fires", "signal_saves")
 
+# Fleet restart-me exit code, shared between the hang watchdog's hard
+# exit and the multi-slice preemption rescue (multislice.py): a SIGTERM
+# on any one slice reaches every host through the boundary consensus in
+# DistributedSignalHandler.signals_received(consensus=True), the train
+# loop writes a rescue checkpoint, and the whole fleet exits with this
+# code so the supervisor restarts it — possibly at a different
+# dp x slice shape (elastic resume).  Single-job runs keep exit 0
+# (--preempt_exit_code overrides either way).
+PREEMPT_EXIT_CODE = 17
+
 
 def recovery_counters() -> Dict[str, int]:
     """The recovery counters as plain ints (zeros when nothing fired)."""
@@ -257,7 +267,7 @@ class HangWatchdog:
     wedged collective becomes a restartable job instead of a dead one.
     """
 
-    EXIT_CODE = 17
+    EXIT_CODE = PREEMPT_EXIT_CODE
 
     def __init__(self, timeout_secs: float,
                  on_fire: Optional[Callable[[], None]] = None,

@@ -232,7 +232,10 @@ def device_memory_stats(device=None) -> Dict[str, int]:
 # 2: + interval_time_secs / goodput / tracing
 # 3: + layer_stats (per-group grad/param/update norms, non-finite counts —
 #    see health.py) on records at --log_layer_stats_interval boundaries
-TELEMETRY_SCHEMA_VERSION = 3
+# 4: + per-slice attribution on multi-slice runs (slice_times /
+#    worst_slice / goodput.slice_stall_secs) and the elastic_resume /
+#    preempt_rescue event kinds — see multislice.py
+TELEMETRY_SCHEMA_VERSION = 4
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 

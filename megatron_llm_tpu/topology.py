@@ -31,11 +31,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # (SURVEY §5.7: no ring attention / Ulysses); cp is this framework's
 # first-class long-context axis — sequence-sharded activations with ring
 # attention over ICI neighbours (parallel/ring_attention.py).
+# ``slice`` is the outermost, DCN-connected axis: one entry per pod slice
+# in a MegaScale-style multi-slice job (multislice.py).  It is size 1 in
+# ordinary single-slice runs, so every spec/getter below is unchanged
+# semantically unless --num_slices > 1.
+SLICE_AXIS = "slice"
 PP_AXIS = "pp"
 DP_AXIS = "dp"
 CP_AXIS = "cp"
 TP_AXIS = "tp"
-MESH_AXES = (PP_AXIS, DP_AXIS, CP_AXIS, TP_AXIS)
+MESH_AXES = (SLICE_AXIS, PP_AXIS, DP_AXIS, CP_AXIS, TP_AXIS)
+
+# env contract for slice identity (the MEGASCALE_SLICE_ID convention used
+# by multi-slice TPU launchers); validated against the process-derived id
+SLICE_ID_ENV = "MEGASCALE_SLICE_ID"
 
 _MESH: Optional[Mesh] = None
 _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE: Optional[int] = None
@@ -47,32 +56,53 @@ def initialize_model_parallel(
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     context_parallel_size: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    num_slices: int = 1,
 ) -> Mesh:
     """Build the global device mesh.
 
     Mirrors ``initialize_model_parallel`` (parallel_state.py:51-205) but
-    returns a Mesh; dp size is derived as world // (tp*pp*cp) exactly like
-    the reference derives dp in arguments.py:76.
+    returns a Mesh; dp size is derived as world // (slice*tp*pp*cp) exactly
+    like the reference derives dp in arguments.py:76.
+
+    ``num_slices`` partitions the fleet into that many DCN-connected pod
+    slices (outermost mesh axis).  Device order from ``jax.devices()`` is
+    process-major, so slices are contiguous process blocks: process p
+    belongs to slice ``p * num_slices // process_count`` — the contract
+    ``multislice.py`` documents and ``MEGASCALE_SLICE_ID`` is checked
+    against.
     """
     global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE
     if devices is None:
         devices = jax.devices()
     world = len(devices)
     tp, pp = tensor_model_parallel_size, pipeline_model_parallel_size
-    cp = context_parallel_size
-    if world % (tp * pp * cp) != 0:
+    cp, sl = context_parallel_size, num_slices
+    if sl < 1 or world % sl != 0:
         raise RuntimeError(
-            f"world size ({world}) is not divisible by tensor parallel size "
-            f"({tp}) x pipeline parallel size ({pp}) x context parallel "
-            f"size ({cp})"
+            f"world size ({world}) is not divisible by num_slices ({sl})")
+    if world % (sl * tp * pp * cp) != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by num_slices ({sl}) "
+            f"x tensor parallel size ({tp}) x pipeline parallel size "
+            f"({pp}) x context parallel size ({cp})"
         )
-    dp = world // (tp * pp * cp)
-    # Rank order (pp outer, dp, cp, tp inner) — tp innermost keeps TP
+    dp = world // (sl * tp * pp * cp)
+    # Rank order (slice outermost — DCN boundaries between contiguous
+    # device blocks; then pp, dp, cp, tp inner) — tp innermost keeps TP
     # collectives on nearest-neighbour ICI (parallel_state.py:116-171), cp
     # next so the ring permute is also neighbour-local.
-    dev_array = np.asarray(devices).reshape(pp, dp, cp, tp)
+    dev_array = np.asarray(devices).reshape(sl, pp, dp, cp, tp)
     _MESH = Mesh(dev_array, MESH_AXES)
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE = virtual_pipeline_model_parallel_size
+    if sl > 1:
+        declared = os.environ.get(SLICE_ID_ENV)
+        if declared is not None:
+            derived = slice_id()
+            if derived is not None and int(declared) != derived:
+                print(f" > WARNING: {SLICE_ID_ENV}={declared} but process "
+                      f"{jax.process_index()} maps to slice {derived} by "
+                      f"device order; check the launch rank ordering",
+                      flush=True)
     return _MESH
 
 
@@ -122,10 +152,39 @@ def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
     return _VIRTUAL_PIPELINE_MODEL_PARALLEL_SIZE
 
 
+def get_num_slices() -> int:
+    """Size of the outer DCN ``slice`` axis (1 unless --num_slices > 1)."""
+    return get_mesh().shape[SLICE_AXIS]
+
+
+def num_slices_or_default(default: int = 1) -> int:
+    """``get_num_slices()`` that tolerates an uninitialized mesh (pure
+    single-device paths and numpy-golden tests)."""
+    return _MESH.shape[SLICE_AXIS] if _MESH is not None else default
+
+
+def slice_id() -> Optional[int]:
+    """Which slice THIS process's devices belong to (host-side query).
+
+    With ``slice`` outermost and jax's process-major device order, slices
+    are contiguous process blocks.  Returns None when one process hosts
+    more than one slice (single-process virtual-device runs) and the
+    membership is therefore ambiguous — except slice 0 when there is only
+    one slice.
+    """
+    sl = get_num_slices()
+    if sl == 1:
+        return 0
+    procs = jax.process_count()
+    if procs % sl != 0:
+        return None if procs < sl else jax.process_index() * sl // procs
+    return jax.process_index() // (procs // sl)
+
+
 def get_world_size() -> int:
     m = get_mesh()
-    return (m.shape[PP_AXIS] * m.shape[DP_AXIS] * m.shape[CP_AXIS]
-            * m.shape[TP_AXIS])
+    return (m.shape[SLICE_AXIS] * m.shape[PP_AXIS] * m.shape[DP_AXIS]
+            * m.shape[CP_AXIS] * m.shape[TP_AXIS])
 
 
 # ---------------------------------------------------------------------------
@@ -144,6 +203,10 @@ def get_pipeline_model_parallel_rank():
 
 def get_data_parallel_rank():
     return jax.lax.axis_index(DP_AXIS)
+
+
+def get_slice_rank():
+    return jax.lax.axis_index(SLICE_AXIS)
 
 
 def is_pipeline_first_stage():
@@ -185,6 +248,14 @@ def initialize_distributed(
         addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
         port = os.environ.get("MASTER_PORT", "8476")
         coordinator_address = f"{addr}:{port}"
+    # Multi-process *CPU* runs (the 2-process integration tests) need the
+    # gloo cross-host collectives backend selected before the CPU client
+    # is created; without it every cross-process computation fails with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass    # option absent on this jaxlib: TPU-only build
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -296,6 +367,16 @@ def nesting_mesh(required_axis: str):
             or mesh.shape[required_axis] == 1):
         return None, None
     return mesh, manual
+
+
+def data_axes():
+    """The mesh axes the global batch dimension spans: ``('slice', 'dp')``
+    in a multi-slice run (data parallelism crosses the DCN axis too),
+    plain ``('dp',)`` otherwise.  Usable directly as one PartitionSpec
+    entry — ``P(None, data_axes(), None)``."""
+    if _MESH is not None and _MESH.shape[SLICE_AXIS] > 1:
+        return (SLICE_AXIS, DP_AXIS)
+    return (DP_AXIS,)
 
 
 def named_sharding(*spec) -> NamedSharding:

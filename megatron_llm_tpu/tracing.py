@@ -93,6 +93,19 @@ class GoodputAccounter:
         self._t0 = clock()
         self._secs: Dict[str, float] = {c: 0.0 for c in GOODPUT_CATEGORIES}
         self._lock = threading.Lock()
+        # multi-slice: seconds the fleet spent waiting on each slice
+        # (fed from the per-slice step-time lag at log boundaries) — the
+        # slice dimension of goodput, aggregated offline by
+        # tools/telemetry_report.py
+        self._slice_stall: Dict[int, float] = {}
+
+    def add_slice_stall(self, slice_id: int, secs: float) -> None:
+        """Attribute fleet wait time to the slice that caused it (its
+        step-time lag over the median of the others)."""
+        with self._lock:
+            self._slice_stall[int(slice_id)] = \
+                self._slice_stall.get(int(slice_id), 0.0) \
+                + max(float(secs), 0.0)
 
     def add(self, category: str, secs: float) -> None:
         with self._lock:
@@ -123,6 +136,11 @@ class GoodputAccounter:
         out["other_secs"] = max(wall - sum(secs.values()), 0.0)
         out["wall_secs"] = wall
         out["goodput_pct"] = 100.0 * secs.get("step", 0.0) / wall
+        with self._lock:
+            if self._slice_stall:
+                out["slice_stall_secs"] = {
+                    str(s): round(v, 6)
+                    for s, v in sorted(self._slice_stall.items())}
         return out
 
 
@@ -449,13 +467,18 @@ class StragglerDetector:
     def __init__(self, threshold: float = 1.5, min_secs: float = 0.005,
                  tracer: Optional[SpanTracer] = None,
                  max_events: int = 256,
-                 printer=print):
+                 printer=print,
+                 host_slice_map: Optional[List[int]] = None):
         self.threshold = float(threshold)
         self.min_secs = float(min_secs)     # ignore sub-noise spreads
         self.tracer = tracer
         self.printer = printer
         self.events: deque = deque(maxlen=max(int(max_events), 1))
         self.total = 0
+        # host index -> slice id (multislice.host_slice_map); when set,
+        # every event names the slice the straggling host belongs to —
+        # the MegaScale "which slice is the fleet waiting on" dimension
+        self.host_slice_map = host_slice_map
 
     def check(self, per_host: Dict[str, List[float]],
               iteration: int) -> List[Dict[str, Any]]:
@@ -472,25 +495,33 @@ class StragglerDetector:
                 continue
             for host, v in enumerate(values):
                 if v > self.threshold * med and (v - med) >= self.min_secs:
-                    found.append({
+                    ev = {
                         "kind": "straggler", "iteration": int(iteration),
                         "section": section, "host": int(host),
                         "secs": float(v), "median_secs": float(med),
                         "ratio": float(v / med),
                         "time_unix": time.time(),
-                    })
+                    }
+                    hsm = self.host_slice_map
+                    if hsm is not None and host < len(hsm):
+                        ev["slice"] = int(hsm[host])
+                    found.append(ev)
         if found:
             self.total += len(found)
             get_counters()["straggler_events"] += len(found)
             for ev in found:
                 self.events.append(ev)
                 if self.tracer is not None:
+                    keys = ("iteration", "section", "host",
+                            "secs", "median_secs", "ratio")
+                    if "slice" in ev:
+                        keys = keys + ("slice",)
                     self.tracer.instant("straggler", "straggler",
-                                        **{k: ev[k] for k in
-                                           ("iteration", "section", "host",
-                                            "secs", "median_secs", "ratio")})
+                                        **{k: ev[k] for k in keys})
+                who = (f"slice {ev['slice']} host {ev['host']}"
+                       if "slice" in ev else f"host {ev['host']}")
                 self.printer(
-                    f" [tracing] STRAGGLER host {ev['host']} at iteration "
+                    f" [tracing] STRAGGLER {who} at iteration "
                     f"{ev['iteration']}: {ev['section']} "
                     f"{ev['secs'] * 1000:.1f} ms = {ev['ratio']:.2f}x the "
                     f"median ({ev['median_secs'] * 1000:.1f} ms)")

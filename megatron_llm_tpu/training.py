@@ -74,6 +74,15 @@ def build_train_step(
     # MoE models return (per-token loss, [lb, z] routing aux) — static on
     # the model config, so BERT/T5's own tuple returns are unaffected
     moe_on = getattr(getattr(model, "cfg", None), "num_experts", 0) > 1
+    # multi-slice hierarchical (ICI-then-DCN) gradient staging: run the
+    # forward under multislice.sliced_forward's explicit slice-vmap so the
+    # dp gradient all-reduce stays in-slice and the cross-slice sum is a
+    # separate DCN collective.  Per-slice math is unchanged — loss_func
+    # still sees the merged global-microbatch per-token losses.
+    num_slices = getattr(parallel_cfg, "num_slices", 1) or 1
+    hierarchical = (num_slices > 1
+                    and getattr(parallel_cfg, "multislice_hierarchical",
+                                False))
 
     def microbatch_loss(params, micro, rng_key, scale):
         # every batch key beyond the canonical trio is forwarded as a model
@@ -83,15 +92,22 @@ def build_train_step(
             k: v for k, v in micro.items()
             if k not in ("tokens", "labels", "loss_mask")
         }
-        loss_tok = model(
-            params,
-            micro["tokens"],
-            labels=micro["labels"],
-            rng_key=rng_key,
-            train=not forward_only,
-            sequence_parallel=sp,
-            **extra,
-        )
+        if hierarchical:
+            from megatron_llm_tpu import multislice
+            loss_tok = multislice.sliced_forward(
+                model, params, micro, rng_key, num_slices,
+                train=not forward_only, sequence_parallel=sp, extra=extra,
+            )
+        else:
+            loss_tok = model(
+                params,
+                micro["tokens"],
+                labels=micro["labels"],
+                rng_key=rng_key,
+                train=not forward_only,
+                sequence_parallel=sp,
+                **extra,
+            )
         moe_aux = None
         if moe_on:
             loss_tok, moe_aux = loss_tok
@@ -296,6 +312,7 @@ def pretrain(
     log_validation_ppl: bool = False,
     resilience=None,
     telemetry=None,
+    preempt_exit_code: int = 0,
 ):
     """Minimal-dependency pretrain loop (the full CLI driver lives in
     ``finetune.py`` / ``pretrain_gpt.py`` at the repo root).
@@ -364,11 +381,22 @@ def pretrain(
     straggler = trace.straggler if trace is not None else None
     skip_iters = frozenset(skip_iters or ())
 
+    num_slices = getattr(parallel_cfg, "num_slices", 1) or 1
     num_micro = max(
         train_cfg.global_batch_size
-        // (train_cfg.micro_batch_size * parallel_cfg.data_parallel_size),
+        // (train_cfg.micro_batch_size * parallel_cfg.data_parallel_size
+            * num_slices),
         1,
     )
+    # per-slice attribution: map the gathered per-host timer snapshots
+    # onto slices so the JSONL stream and straggler events name the slice
+    # the fleet is waiting on (multi-slice runs only)
+    slice_map = None
+    if num_slices > 1:
+        from megatron_llm_tpu import multislice
+        slice_map = multislice.host_slice_map(num_slices=num_slices)
+        if straggler is not None:
+            straggler.host_slice_map = slice_map
     if optimizer is None:
         optimizer = MegatronOptimizer(
             train_cfg, params_dtype=jax.tree_util.tree_leaves(params)[0].dtype
@@ -704,6 +732,16 @@ def pretrain(
                     throughput=throughput,
                     interval_time=interval_time,
                 )
+                # one snapshot feeds writer + console; the old
+                # write()-then-log() pair double-read (and could
+                # double-reset) every timer.  The gathered per-host
+                # snapshot doubles as the straggler detector's input and
+                # the per-slice attribution source — the allgather
+                # already happened at this boundary.
+                gathered = timers.report(use_writer, iteration,
+                                         normalizer=log_interval)
+                if straggler is not None and gathered:
+                    straggler.check(gathered, iteration)
                 if stream is not None:
                     from megatron_llm_tpu.resilience import recovery_counters
                     from megatron_llm_tpu.telemetry import device_memory_stats
@@ -726,24 +764,37 @@ def pretrain(
                     if trace is not None:
                         g = trace.goodput_summary()
                         rec["goodput_pct"] = g["goodput_pct"]
-                        rec["goodput"] = {k: round(v, 4)
-                                          for k, v in g.items()}
+                        rec["goodput"] = {
+                            k: round(v, 4) if isinstance(v, (int, float))
+                            else v
+                            for k, v in g.items()}
                         rec["recompiles"] = int(
                             counters.get("recompiles", 0))
                         rec["straggler_events"] = int(
                             counters.get("straggler_events", 0))
+                    if slice_map is not None and gathered:
+                        from megatron_llm_tpu import multislice
+                        per_host = gathered.get("train-step")
+                        if per_host is None:
+                            # elementwise max over whatever sections exist
+                            per_host = [max(col) for col
+                                        in zip(*gathered.values())]
+                        st = multislice.slice_times(per_host, slice_map)
+                        rec["slice_times"] = {str(k): round(v, 6)
+                                              for k, v in sorted(st.items())}
+                        ws = multislice.worst_slice(st)
+                        if ws is not None:
+                            rec["worst_slice"] = ws
+                            if trace is not None:
+                                # slice dimension of goodput: the fleet
+                                # waited lag_secs/iter on this slice over
+                                # the whole interval
+                                trace.tracer.goodput.add_slice_stall(
+                                    ws["slice"],
+                                    ws["lag_secs"] * log_interval)
                     if at_stats_boundary:
                         rec["layer_stats"] = ls_host
                     stream.emit(rec)
-                # one snapshot feeds writer + console; the old
-                # write()-then-log() pair double-read (and could
-                # double-reset) every timer.  The gathered per-host
-                # snapshot doubles as the straggler detector's input —
-                # the allgather already happened at this boundary.
-                gathered = timers.report(use_writer, iteration,
-                                         normalizer=log_interval)
-                if straggler is not None and gathered:
-                    straggler.check(gathered, iteration)
                 if use_writer is not None and hasattr(use_writer, "flush"):
                     use_writer.flush()
                 if on_metrics is not None:
@@ -799,7 +850,18 @@ def pretrain(
                     if not saved:
                         _save(iteration)
                     counters["signal_saves"] += 1
-                sys.exit(0)
+                # preemption-aware rescue: the consensus above means every
+                # host (every slice) saw the SIGTERM and reaches this save
+                # + exit together; a non-zero code (17, shared with the
+                # hang watchdog) tells the fleet supervisor to restart —
+                # possibly at a different dp x slice shape (elastic resume)
+                code = int(preempt_exit_code or 0)
+                if code and stream is not None:
+                    stream.emit({"kind": "preempt_rescue",
+                                 "iteration": iteration,
+                                 "exit_code": code,
+                                 "saved": bool(save_dir)})
+                sys.exit(code)
 
             # exit based on duration (reference training.py:746-758)
             if exit_duration_in_mins:

@@ -1,0 +1,148 @@
+"""Worker for tests/test_multihost_cpu.py multi-slice scenarios — one of
+two REAL processes (jax.distributed over localhost gloo, one CPU device
+each), with the ``slice`` mesh axis spanning the process boundary: each
+process IS one slice, so the second reduction hop in the hierarchical
+all-reduce crosses a genuine process (DCN-analogue) link.
+
+Modes (MULTISLICE_MODE env):
+  step     — hierarchical vs flat all-reduce checksum + train-step loss
+             parity across the slice boundary (default)
+  preempt  — run pretrain under DistributedSignalHandler; the parent
+             SIGTERMs ONE process mid-run and both must reach boundary
+             consensus, make the rescue save, and exit PREEMPT_EXIT_CODE.
+
+Not collected by pytest (underscore prefix)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _setup(M=2):
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from megatron_llm_tpu import topology
+    from megatron_llm_tpu.data.data_samplers import place_host_batch
+    from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+    from megatron_llm_tpu.parallel import sharding as sh
+
+    topology.initialize_distributed()
+    rank = jax.process_index()
+    assert jax.process_count() == 2
+
+    mesh = topology.initialize_model_parallel(num_slices=2)
+    assert dict(mesh.shape)["slice"] == 2 and dict(mesh.shape)["dp"] == 1
+    assert topology.slice_id() == rank, (topology.slice_id(), rank)
+    assert topology.data_axes() == ("slice", "dp")
+
+    cfg = llama_config("tiny", num_layers=2, seq_length=32,
+                       max_position_embeddings=32, padded_vocab_size=128)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))     # same seed -> identical
+    params = sh.shard_params(params, model.param_specs(params))
+
+    # every process builds the SAME global batch; leading data dim
+    # spans ('slice', 'dp') so each process holds its slice's half
+    gb = 2
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 128, (M, gb, 32)).astype(np.int32)
+    dsh = NamedSharding(mesh, P(None, ("slice", "dp"), None))
+    batch = {
+        "tokens": place_host_batch(toks, dsh),
+        "labels": place_host_batch(np.roll(toks, -1, axis=-1), dsh),
+        "loss_mask": place_host_batch(np.ones_like(toks, np.float32), dsh),
+    }
+    return rank, mesh, model, params, batch, M
+
+
+def mode_step():
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from megatron_llm_tpu import multislice, topology
+    from megatron_llm_tpu.config import ParallelConfig, TrainConfig
+    from megatron_llm_tpu.optimizer import MegatronOptimizer
+    from megatron_llm_tpu.training import build_train_step
+
+    rank, mesh, model, params, batch, M = _setup()
+
+    # staged ICI-then-DCN reduction vs one flat psum: the second hop
+    # crosses the process boundary; integer values make both exact
+    x = np.arange(2 * 3, dtype=np.float32).reshape(2, 3)
+    xs = jax.device_put(x, NamedSharding(mesh, P(("slice", "dp"))))
+    hier = np.asarray(multislice.hierarchical_allreduce(xs))
+    flat = np.asarray(multislice.flat_allreduce(xs))
+    np.testing.assert_array_equal(hier, flat)
+    np.testing.assert_array_equal(hier, x.sum(0))
+    print(f"RANK{rank} HIERARCHICAL_ALLREDUCE_OK {hier.tolist()}",
+          flush=True)
+
+    from megatron_llm_tpu.parallel import sharding as sh
+
+    tc = TrainConfig(micro_batch_size=1, global_batch_size=2, lr=1e-3)
+    opt = MegatronOptimizer(tc)
+    losses = {}
+    for name, hier_fwd in (("hier", True), ("flat", False)):
+        pc = ParallelConfig(data_parallel_size=1, num_slices=2,
+                            multislice_hierarchical=hier_fwd)
+        # fresh params each path: the train step donates its inputs
+        p = model.init(jax.random.PRNGKey(0))
+        p = sh.shard_params(p, model.param_specs(p))
+        opt_state = opt.init(p)
+        step = build_train_step(model, opt, pc, M)
+        _, _, metrics = step(p, opt_state, batch,
+                             jax.random.PRNGKey(0), 1e-3, 0.0)
+        losses[name] = float(metrics["lm loss"])
+        assert np.isfinite(losses[name])
+    print(f"RANK{rank} LOSS {losses['hier']:.6f}", flush=True)
+    assert abs(losses["hier"] - losses["flat"]) < 1e-6, losses
+    print(f"RANK{rank} HIER_FLAT_PARITY_OK", flush=True)
+
+
+def mode_preempt():
+    import jax
+
+    from megatron_llm_tpu import multislice
+    from megatron_llm_tpu.config import ParallelConfig, TrainConfig
+    from megatron_llm_tpu.dist_signal_handler import DistributedSignalHandler
+    from megatron_llm_tpu.training import pretrain
+
+    # pretrain derives num_micro = gbs / (mbs * dp * slices) = 1
+    rank, mesh, model, params, batch, M = _setup(M=1)
+    save_dir = os.environ["MULTISLICE_SAVE_DIR"]
+
+    def it():
+        while True:
+            yield batch
+
+    tc = TrainConfig(micro_batch_size=1, global_batch_size=2, lr=1e-3,
+                     train_iters=5000)
+    pc = ParallelConfig(data_parallel_size=1, num_slices=2,
+                        multislice_hierarchical=True)
+
+    def on_metrics(i, m):
+        # the parent watches for these to know when to deliver SIGTERM
+        print(f"RANK{rank} STEP {i}", flush=True)
+
+    with DistributedSignalHandler() as handler:
+        # log_interval=1: every iteration is a consensus boundary, so the
+        # rescue triggers promptly after the signal lands on one slice
+        pretrain(model, params, tc, pc, it(), log_interval=1,
+                 save_dir=save_dir, exit_signal_handler=handler,
+                 on_metrics=on_metrics,
+                 preempt_exit_code=multislice.PREEMPT_EXIT_CODE)
+    # unreachable on the preemption path (pretrain sys.exits 17); reaching
+    # here means the signal never arrived
+    print(f"RANK{rank} NO_PREEMPTION", flush=True)
+    sys.exit(3)
+
+
+if __name__ == "__main__":
+    if os.environ.get("MULTISLICE_MODE", "step") == "preempt":
+        mode_preempt()
+    else:
+        mode_step()

@@ -71,10 +71,10 @@ class Utils:
     world_size = 8
 
     @staticmethod
-    def initialize_model_parallel(tp=1, pp=1, vpp=None, cp=1):
+    def initialize_model_parallel(tp=1, pp=1, vpp=None, cp=1, num_slices=1):
         topology.destroy_model_parallel()
         return topology.initialize_model_parallel(
-            tp, pp, vpp, context_parallel_size=cp)
+            tp, pp, vpp, context_parallel_size=cp, num_slices=num_slices)
 
     @staticmethod
     def destroy_model_parallel():

@@ -36,8 +36,8 @@ def test_mesh_rank_order(utils):
     """TP groups are contiguous device blocks (reference:
     parallel_state.py:146-151 — rank order pp outer, dp middle, tp inner)."""
     mesh = utils.initialize_model_parallel(tp=2, pp=2)
-    devs = mesh.devices  # [pp, dp, cp, tp]
-    assert devs.shape == (2, 2, 1, 2)
+    devs = mesh.devices  # [slice, pp, dp, cp, tp]
+    assert devs.shape == (1, 2, 2, 1, 2)
     ids = devs.reshape(2, 2, 2)
     ids = [[[d.id for d in row] for row in plane] for plane in ids]
     # tp neighbours adjacent, dp strides tp, pp strides dp*tp

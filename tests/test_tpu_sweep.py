@@ -1,0 +1,136 @@
+"""Declarative sweep manifest (tools/tpu_sweep.py): manifest validity,
+plan/settle-state logic, the fresh-launch reset policy, and the step
+runner's done / gave-up marking.  No TPU and no real sweep commands —
+the runner is exercised with stub commands and zero backoff."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import tpu_sweep  # noqa: E402
+from tpu_sweep import Step  # noqa: E402
+
+
+def test_manifest_is_valid_and_has_multislice_smoke():
+    tpu_sweep.validate_manifest()
+    names = [s.name for s in tpu_sweep.MANIFEST]
+    assert len(names) == len(set(names))
+    smoke = next(s for s in tpu_sweep.MANIFEST
+                 if s.name == "multislice_smoke")
+    assert not smoke.needs_tpu                  # runs on the CPU mesh
+    assert "--num_slices=2" in smoke.cmd
+    assert smoke.env.get("JAX_PLATFORMS") == "cpu"
+    # the original shell playbook's steps all survived the refactor
+    for legacy in ("fusedbwd", "seq4096", "bigvocab", "bench_final",
+                   "moe", "long", "decode", "optstate"):
+        assert legacy in names
+
+
+def test_validate_rejects_bad_manifests():
+    with pytest.raises(ValueError):
+        tpu_sweep.validate_manifest(
+            [Step("a", "true", 10), Step("a", "true", 10)])
+    with pytest.raises(ValueError):
+        tpu_sweep.validate_manifest([Step("a", "true", 0)])
+    with pytest.raises(ValueError):
+        tpu_sweep.validate_manifest([Step("a", "true", 10, wave=3)])
+    with pytest.raises(ValueError):
+        tpu_sweep.validate_manifest([Step("a", "  ", 10)])
+
+
+def test_ordered_runs_wave1_first():
+    order = tpu_sweep.ordered()
+    waves = [s.wave for s in order]
+    assert waves == sorted(waves)
+    # stable within a wave: manifest order preserved
+    w1 = [s.name for s in order if s.wave == 1]
+    assert w1 == [s.name for s in tpu_sweep.MANIFEST if s.wave == 1]
+
+
+def test_plan_and_settle_state(tmp_path):
+    marks = str(tmp_path)
+    manifest = [Step("x", "true", 10), Step("y", "true", 10, wave=2)]
+    assert [s.name for s in tpu_sweep.plan(marks, manifest)] == ["x", "y"]
+    open(os.path.join(marks, "x.done"), "w").close()
+    assert [s.name for s in tpu_sweep.plan(marks, manifest)] == ["y"]
+    assert tpu_sweep.step_state(marks, "x") == "done"
+    assert not tpu_sweep.all_settled(marks, manifest)
+    open(os.path.join(marks, "y.gaveup"), "w").close()
+    assert tpu_sweep.step_state(marks, "y") == "gave-up"
+    assert tpu_sweep.all_settled(marks, manifest)
+    assert tpu_sweep.plan(marks, manifest) == []
+
+
+def test_reset_for_launch_retries_exhausted_honors_done(tmp_path):
+    marks = str(tmp_path)
+    manifest = [Step("x", "true", 10), Step("y", "true", 10)]
+    open(os.path.join(marks, "x.done"), "w").close()
+    open(os.path.join(marks, "y.gaveup"), "w").close()
+    with open(os.path.join(marks, "y.attempts"), "w") as f:
+        f.write("4")
+    tpu_sweep.reset_for_launch(marks, manifest)
+    assert tpu_sweep.step_state(marks, "x") == "done"       # honored
+    assert tpu_sweep.step_state(marks, "y") == "never-ran"  # retried
+    assert tpu_sweep.attempts(marks, "y") == 0
+
+
+def test_run_step_marks_done_and_gaveup(tmp_path):
+    marks = str(tmp_path / "marks")
+    logs = str(tmp_path / "logs")
+    os.makedirs(marks)
+    os.makedirs(logs)
+
+    ok = Step("ok", "true", 30, needs_tpu=False)
+    assert tpu_sweep.run_step(ok, marks, logs, backoff_secs=0)
+    assert tpu_sweep.step_state(marks, "ok") == "done"
+    # settled steps are not re-run
+    assert tpu_sweep.run_step(ok, marks, logs, backoff_secs=0)
+    assert tpu_sweep.attempts(marks, "ok") == 1
+
+    bad = Step("bad", "false", 30, needs_tpu=False)
+    for i in range(2):
+        assert not tpu_sweep.run_step(bad, marks, logs, max_attempts=2,
+                                      backoff_secs=0)
+    assert tpu_sweep.attempts(marks, "bad") == 2
+    # attempt 3 > max_attempts: marked gave-up (settled), no command run
+    assert tpu_sweep.run_step(bad, marks, logs, max_attempts=2,
+                              backoff_secs=0)
+    assert tpu_sweep.step_state(marks, "bad") == "gave-up"
+
+
+def test_run_step_env_and_log(tmp_path):
+    marks = str(tmp_path / "marks")
+    logs = str(tmp_path / "logs")
+    os.makedirs(marks)
+    os.makedirs(logs)
+    s = Step("echoer", 'sh -c "echo VAL=$SWEEP_PROBE_VAR"', 30,
+             needs_tpu=False, env={"SWEEP_PROBE_VAR": "hello"})
+    assert tpu_sweep.run_step(s, marks, logs, backoff_secs=0)
+    with open(os.path.join(logs, "hunt_echoer.log")) as f:
+        assert "VAL=hello" in f.read()
+
+
+def test_cli_list_and_dry_run(tmp_path):
+    env = dict(os.environ)
+    tools = os.path.dirname(os.path.abspath(tpu_sweep.__file__))
+    out = subprocess.run(
+        [sys.executable, os.path.join(tools, "tpu_sweep.py"),
+         "--list", "--marks", str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "multislice_smoke" in out.stdout
+    assert "never-ran" in out.stdout
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(tools, "tpu_sweep.py"),
+         "--dry-run", "--marks", str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "multislice_smoke" in out.stdout
+    for s in tpu_sweep.MANIFEST:
+        assert s.name in out.stdout

@@ -15,6 +15,12 @@ boundary, megatron_llm_tpu/telemetry.py) and prints:
   update-to-weight ratio seen and the boundaries where any group had
   non-finite gradients (per-layer breakdown: tools/health_report.py).
   Schema-2 streams simply have no such records; both parse.
+* per-slice attribution when the run was multi-slice (schema 4,
+  --num_slices > 1): a worst-slice table — per-slice mean/max step
+  time, how often each slice was the one the fleet waited on, and the
+  cumulative stall seconds it cost (goodput.slice_stall_secs) — plus a
+  fleet-event timeline (``elastic_resume`` / ``preempt_rescue`` kinds).
+  Single-slice streams simply have no such fields; both parse.
 
 Pure stdlib + JSONL parsing — no jax import, so it runs anywhere the log
 file does (laptop, login node) and costs nothing to start.
@@ -139,6 +145,81 @@ def aggregates(records: List[Dict]) -> Dict:
     }
 
 
+def slice_aggregates(records: List[Dict]) -> Optional[Dict]:
+    """Per-slice attribution rollup (schema 4, multi-slice runs): from
+    the per-boundary ``slice_times`` / ``worst_slice`` fields and the
+    cumulative ``goodput.slice_stall_secs`` map.  None when the stream
+    carries no slice dimension (single-slice runs, older schemas)."""
+    per: Dict[str, List[float]] = {}
+    worst_count: Dict[str, int] = {}
+    lag: Dict[str, float] = {}
+    stall: Dict[str, float] = {}
+    for r in records:
+        for k, v in (r.get("slice_times") or {}).items():
+            if isinstance(v, (int, float)):
+                per.setdefault(str(k), []).append(float(v))
+        ws = r.get("worst_slice")
+        if ws and ws.get("slice") is not None:
+            key = str(ws["slice"])
+            worst_count[key] = worst_count.get(key, 0) + 1
+            lag[key] = lag.get(key, 0.0) + float(ws.get("lag_secs") or 0.0)
+        # cumulative counter: the latest record wins
+        gp = (r.get("goodput") or {}).get("slice_stall_secs")
+        if isinstance(gp, dict):
+            stall = {str(k): float(v) for k, v in gp.items()}
+    if not per and not stall:
+        return None
+    slices = sorted(set(per) | set(stall), key=lambda s: (len(s), s))
+    return {
+        s: {
+            "mean_step_secs":
+                sum(per[s]) / len(per[s]) if per.get(s) else None,
+            "max_step_secs": max(per[s]) if per.get(s) else None,
+            "times_worst": worst_count.get(s, 0),
+            "total_lag_secs": lag.get(s, 0.0),
+            "stall_secs": stall.get(s, 0.0),
+        }
+        for s in slices
+    }
+
+
+def slice_table(slices: Dict) -> str:
+    header = (f"{'slice':>6} {'mean step ms':>13} {'max step ms':>12} "
+              f"{'times worst':>12} {'lag secs':>9} {'stall secs':>11}")
+    lines = [header, "-" * len(header)]
+    for s, row in sorted(slices.items(),
+                         key=lambda kv: -kv[1]["stall_secs"]):
+        mean = row["mean_step_secs"]
+        mx = row["max_step_secs"]
+        lines.append(
+            f"{s:>6} "
+            f"{_fmt(mean * 1000.0 if mean is not None else None, '.1f'):>13} "
+            f"{_fmt(mx * 1000.0 if mx is not None else None, '.1f'):>12} "
+            f"{row['times_worst']:>12} "
+            f"{row['total_lag_secs']:>9.2f} "
+            f"{row['stall_secs']:>11.2f}")
+    return "\n".join(lines)
+
+
+def fleet_events(path: str) -> List[Dict]:
+    """Elastic-resume / preemption-rescue events from the stream (these
+    are non-``log`` kinds, so ``load_records`` drops them)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.jsonl")
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") in ("elastic_resume", "preempt_rescue"):
+                out.append(rec)
+    return out
+
+
 def recovery_timeline(records: List[Dict]) -> List[Dict]:
     """Log boundaries where any recovery counter advanced, with deltas."""
     events = []
@@ -173,10 +254,14 @@ def main(argv=None) -> int:
 
     agg = aggregates(records)
     timeline = recovery_timeline(records)
+    slices = slice_aggregates(records)
+    fleet = fleet_events(args.path)
 
     if args.json:
         print(json.dumps({"aggregates": agg,
-                          "recovery_timeline": timeline}, indent=1))
+                          "recovery_timeline": timeline,
+                          "slices": slices,
+                          "fleet_events": fleet}, indent=1))
         return 0
 
     print(per_step_table(records))
@@ -199,6 +284,26 @@ def main(argv=None) -> int:
               f"{_fmt(agg['worst_update_ratio'], '.3g')}"
               f" | NaN-layer events: {agg['nan_layer_events']}"
               f"  (per-layer breakdown: tools/health_report.py)")
+    if slices:
+        print("\nper-slice attribution (fleet waits on its slowest "
+              "slice):")
+        print(slice_table(slices))
+    if fleet:
+        print("\nfleet events:")
+        for ev in fleet:
+            if ev.get("kind") == "elastic_resume":
+                deltas = ", ".join(
+                    f"{k} {v.get('from')} -> {v.get('to')}"
+                    for k, v in (ev.get("changed") or {}).items())
+                print(f"  elastic resume at iteration "
+                      f"{ev.get('iteration', '?')}: {deltas} "
+                      f"(consumed_samples "
+                      f"{ev.get('consumed_samples', '?')})")
+            else:
+                print(f"  preemption rescue at iteration "
+                      f"{ev.get('iteration', '?')}: exit code "
+                      f"{ev.get('exit_code', '?')}, "
+                      f"saved={ev.get('saved')}")
     if timeline:
         print("\nrecovery events:")
         for ev in timeline:

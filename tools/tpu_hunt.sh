@@ -1,135 +1,11 @@
 #!/bin/bash
-# Tunnel watchdog: probe the axon TPU tunnel on a short cycle and run the
-# remaining on-chip playbook steps (docs/perf_tpu.md) the moment it answers.
-# Each step runs under `timeout` so a mid-run tunnel stall kills the step,
-# not the watchdog; partial sweep rows still land in the logs.  A step is
-# retried on the next tunnel window until it exits 0 (max 4 attempts, then
-# it is marked .gaveup — visibly distinct from .done).
+# Thin wrapper kept for muscle memory / existing nohup invocations.
+# The watchdog logic (tunnel probe, settle marks, retry/backoff policy)
+# now lives in the declarative sweep manifest + runner:
 #
-# Usage: nohup bash tools/tpu_hunt.sh >/tmp/tpu_hunt.log 2>&1 &
+#     tools/tpu_sweep.py            (see --list / --dry-run)
+#
+# Usage (unchanged): nohup bash tools/tpu_hunt.sh >/tmp/tpu_hunt.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-
-# Single instance only: concurrent watchdogs mean concurrent jax clients
-# against a tunnel that serializes them (see probe() comment).
-exec 9>/tmp/tpu_hunt.lock
-flock -n 9 || { echo "[hunt] another instance holds /tmp/tpu_hunt.lock; exiting"; exit 1; }
-
-MARKS=/tmp/tpu_hunt_marks
-mkdir -p "$MARKS"
-# A fresh launch retries exhausted steps but honors completed ones; say so
-# out loud instead of skipping silently.
-rm -f "$MARKS"/*.attempts "$MARKS"/*.gaveup
-for f in "$MARKS"/*.done; do
-  [ -e "$f" ] && echo "[hunt] startup: $(basename "$f" .done) already done (stale marker honored; rm $f to re-run)"
-done
-DEADLINE=$(( $(date +%s) + 36000 ))   # give up after 10h
-
-# One list of steps, used by the run loop, all_settled, and the final
-# status report alike.  Timeouts are generous per-group compile budgets.
-# First wave = the VERDICT playbook must-haves; second wave = gravy
-# measurements (MoE dispatch overhead, long-seq + xla comparison,
-# decode throughput) that
-# only run once every first-wave step has settled.
-STEPS=(fusedbwd seq4096 bigvocab bench_final moe long decode optstate)
-step_cmd() {
-  case $1 in
-    fusedbwd)    echo "python tools/mfu_sweep.py fusedbwd" ;;
-    seq4096)     echo "python tools/mfu_sweep.py seq4096" ;;
-    bigvocab)    echo "python tools/mfu_sweep.py bigvocab" ;;
-    bench_final) echo "python bench.py" ;;
-    moe)         echo "python tools/mfu_sweep.py moe" ;;
-    long)        echo "python tools/mfu_sweep.py long" ;;
-    decode)      echo "python tools/decode_bench.py" ;;
-    optstate)    echo "python tools/mfu_sweep.py optstate" ;;
-  esac
-}
-step_tmo() {
-  case $1 in
-    fusedbwd) echo 1500 ;; seq4096) echo 1800 ;;
-    bigvocab) echo 2100 ;; bench_final) echo 900 ;;
-    moe) echo 1200 ;; long) echo 1500 ;; decode) echo 1200 ;;
-    optstate) echo 1200 ;;
-  esac
-}
-
-# 150 s probe: when the tunnel is up, init takes seconds (0.1 s in the
-# 03:45 window); when it is down, init hangs forever, so the timeout just
-# sets the down-cycle length.  CAUTION (verify skill): the tunnel
-# serializes clients and a KILLED client wedges it for several minutes —
-# which is exactly what a timed-out probe is.  The 300 s down-sleep keeps
-# killed probes ≥7.5 min apart so a wedge can clear between probes; never
-# run another jax process concurrently with this watchdog.
-# rc 124 (timeout) = tunnel genuinely hung; any other nonzero rc is a fast
-# local failure (import error, broken env) that probing harder won't fix —
-# surface it and stop instead of reporting "tunnel down" for 10 hours.
-probe() {
-  timeout 150 python - >/tmp/tpu_probe.log 2>&1 9>&- <<'EOF'
-import jax, jax.numpy as jnp
-x = jnp.ones((256, 256))
-assert jax.devices()[0].platform == "tpu"
-float((x @ x).sum())
-EOF
-  local rc=$?
-  if [ "$rc" -ne 0 ] && [ "$rc" -ne 124 ]; then
-    echo "[hunt] probe failed fast (rc=$rc) — local error, not a tunnel hang:"
-    tail -5 /tmp/tpu_probe.log
-    exit 1
-  fi
-  return "$rc"
-}
-
-run_step() {  # name
-  local name=$1
-  [ -f "$MARKS/$name.done" ] || [ -f "$MARKS/$name.gaveup" ] && return 0
-  local att_file="$MARKS/$name.attempts"
-  local att=$(( $(cat "$att_file" 2>/dev/null || echo 0) + 1 ))
-  echo "$att" > "$att_file"
-  if [ "$att" -gt 4 ]; then
-    touch "$MARKS/$name.gaveup"
-    echo "[hunt $(date +%H:%M:%S)] step $name GAVE UP after 4 attempts"
-    return 0
-  fi
-  echo "[hunt $(date +%H:%M:%S)] step $name attempt $att"
-  timeout "$(step_tmo "$name")" bash -c "$(step_cmd "$name")" >> "/tmp/hunt_$name.log" 2>&1 9>&-
-  local rc=$?
-  if [ "$rc" -eq 0 ]; then
-    touch "$MARKS/$name.done"
-    echo "[hunt $(date +%H:%M:%S)] step $name DONE"
-    return 0
-  fi
-  echo "[hunt $(date +%H:%M:%S)] step $name failed (rc=$rc$([ "$rc" -eq 124 ] && echo ' = timeout/killed client'))"
-  # Backoff before the next probe/attempt: (a) a fast deterministic failure
-  # (bad flag, instant OOM) must not burn all 4 attempts inside one window;
-  # (b) a timed-out step is a killed client, which wedges the tunnel for
-  # several minutes -- give it time to clear before the next probe.
-  sleep 180
-  return 1
-}
-
-all_settled() {
-  for s in "${STEPS[@]}"; do
-    [ -f "$MARKS/$s.done" ] || [ -f "$MARKS/$s.gaveup" ] || return 1
-  done
-  return 0
-}
-
-while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  if all_settled; then break; fi
-  if probe; then
-    echo "[hunt $(date +%H:%M:%S)] tunnel UP"
-    for s in "${STEPS[@]}"; do
-      run_step "$s" || continue 2
-    done
-  else
-    echo "[hunt $(date +%H:%M:%S)] tunnel down"
-    sleep 300
-  fi
-done
-echo "[hunt] final status:"
-for s in "${STEPS[@]}"; do
-  if [ -f "$MARKS/$s.done" ]; then st=done
-  elif [ -f "$MARKS/$s.gaveup" ]; then st=GAVE-UP
-  else st=never-ran; fi
-  echo "[hunt]   $s: $st"
-done
+exec python tools/tpu_sweep.py run "$@"

@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""Declarative on-chip sweep: one manifest, one runner.
+
+This replaces the logic that used to live inline in ``tools/tpu_hunt.sh``
+(that script is now a thin exec wrapper).  The playbook steps are data
+(``MANIFEST``), not case tables, so adding a measurement is one entry —
+and the planner/status logic is importable and unit-tested
+(tests/test_tpu_sweep.py) instead of living in bash.
+
+Runner semantics (unchanged from the shell version):
+
+* single instance via an flock'd lockfile — concurrent jax clients wedge
+  the serializing tunnel;
+* a 150 s probe decides whether the TPU tunnel is up: rc 124 means the
+  tunnel is genuinely hung (down-cycle), any other nonzero rc is a fast
+  local failure (import error, broken env) that probing harder won't fix;
+* each step runs under its own timeout; rc 0 marks ``<name>.done``, a
+  failure backs off 180 s (a timed-out step is a killed client that
+  wedges the tunnel for minutes), and after 4 attempts the step is
+  marked ``<name>.gaveup`` — visibly distinct from done;
+* a fresh launch retries exhausted steps but honors ``.done`` markers.
+
+Beyond the shell version: a step may declare ``needs_tpu=False`` (the
+multi-slice smoke runs on the virtual-device CPU mesh), and such steps
+run even while the tunnel is down.
+
+stdlib-only; usage:
+
+    python tools/tpu_sweep.py --list
+    python tools/tpu_sweep.py --dry-run
+    nohup python tools/tpu_sweep.py run >/tmp/tpu_hunt.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The virtual-device CPU environment (tests/conftest.py's contract) for
+# steps that do not need the chip.
+CPU_MESH_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+_SMOKE_FLAGS = (
+    "--model_name=llama2 --num_layers=2 --hidden_size=64 "
+    "--num_attention_heads=4 --seq_length=32 --max_position_embeddings=32 "
+    "--micro_batch_size=1 --train_iters=3 --lr=1e-4 "
+    "--vocab_size=128 --log_interval=1"
+)
+
+
+@dataclass(frozen=True)
+class Step:
+    """One sweep entry.  ``wave`` orders the run (1 = the VERDICT
+    playbook must-haves, 2 = gravy measurements); ``env`` is merged over
+    the inherited environment."""
+
+    name: str
+    cmd: str
+    timeout: int                      # generous per-group compile budget
+    wave: int = 1
+    needs_tpu: bool = True
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+MANIFEST: List[Step] = [
+    Step("fusedbwd", "python tools/mfu_sweep.py fusedbwd", 1500, wave=1),
+    Step("seq4096", "python tools/mfu_sweep.py seq4096", 1800, wave=1),
+    Step("bigvocab", "python tools/mfu_sweep.py bigvocab", 2100, wave=1),
+    Step("bench_final", "python bench.py", 900, wave=1),
+    Step("moe", "python tools/mfu_sweep.py moe", 1200, wave=2),
+    Step("long", "python tools/mfu_sweep.py long", 1500, wave=2),
+    Step("decode", "python tools/decode_bench.py", 1200, wave=2),
+    Step("optstate", "python tools/mfu_sweep.py optstate", 1200, wave=2),
+    # multi-slice elastic runtime smoke: slice=2 x dp=4 on the virtual
+    # CPU mesh (one chip cannot host two slices), hierarchical reduction
+    # on — proves the --num_slices surface end to end
+    Step("multislice_smoke",
+         f"python finetune.py {_SMOKE_FLAGS} "
+         "--global_batch_size=8 --num_slices=2",
+         600, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+]
+
+
+def validate_manifest(manifest: List[Step] = MANIFEST) -> None:
+    seen = set()
+    for s in manifest:
+        if s.name in seen:
+            raise ValueError(f"duplicate step name: {s.name}")
+        seen.add(s.name)
+        if s.timeout <= 0:
+            raise ValueError(f"step {s.name}: timeout must be positive")
+        if s.wave not in (1, 2):
+            raise ValueError(f"step {s.name}: wave must be 1 or 2")
+        if not s.cmd.strip():
+            raise ValueError(f"step {s.name}: empty command")
+
+
+def ordered(manifest: List[Step] = MANIFEST) -> List[Step]:
+    """Run order: wave 1 first, manifest order within a wave (stable)."""
+    return sorted(manifest, key=lambda s: s.wave)
+
+
+# ---------------------------------------------------------------------------
+# Marks: the on-disk settle state (compatible with the old shell layout)
+# ---------------------------------------------------------------------------
+
+def step_state(marks_dir: str, name: str) -> str:
+    if os.path.exists(os.path.join(marks_dir, name + ".done")):
+        return "done"
+    if os.path.exists(os.path.join(marks_dir, name + ".gaveup")):
+        return "gave-up"
+    return "never-ran"
+
+
+def attempts(marks_dir: str, name: str) -> int:
+    try:
+        with open(os.path.join(marks_dir, name + ".attempts")) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def plan(marks_dir: str, manifest: List[Step] = MANIFEST) -> List[Step]:
+    """The steps a run would still execute, in run order."""
+    return [s for s in ordered(manifest)
+            if step_state(marks_dir, s.name) == "never-ran"]
+
+
+def reset_for_launch(marks_dir: str, manifest: List[Step] = MANIFEST) -> None:
+    """Fresh-launch policy: retry exhausted steps, honor completed ones
+    (and say so out loud instead of skipping silently)."""
+    os.makedirs(marks_dir, exist_ok=True)
+    for s in manifest:
+        for suffix in (".attempts", ".gaveup"):
+            try:
+                os.remove(os.path.join(marks_dir, s.name + suffix))
+            except OSError:
+                pass
+        if step_state(marks_dir, s.name) == "done":
+            print(f"[hunt] startup: {s.name} already done (stale marker "
+                  f"honored; rm {marks_dir}/{s.name}.done to re-run)")
+
+
+def status_table(marks_dir: str, manifest: List[Step] = MANIFEST) -> str:
+    lines = []
+    for s in ordered(manifest):
+        tpu = "tpu" if s.needs_tpu else "cpu"
+        lines.append(f"{s.name:<16} wave{s.wave} {tpu:<4} "
+                     f"{s.timeout:>5}s  {step_state(marks_dir, s.name):<9} "
+                     f"{s.cmd}")
+    return "\n".join(lines)
+
+
+def all_settled(marks_dir: str, manifest: List[Step] = MANIFEST) -> bool:
+    return all(step_state(marks_dir, s.name) != "never-ran"
+               for s in manifest)
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+PROBE_SRC = """\
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+assert jax.devices()[0].platform == "tpu"
+float((x @ x).sum())
+"""
+
+
+def probe(timeout: int = 150, log: str = "/tmp/tpu_probe.log") -> bool:
+    """True when the tunnel answers.  A killed (timed-out) client wedges
+    the serializing tunnel for minutes, so callers must keep failed
+    probes well apart.  Exits the sweep on a fast local failure."""
+    with open(log, "w") as f:
+        try:
+            rc = subprocess.run([sys.executable, "-c", PROBE_SRC],
+                                stdout=f, stderr=subprocess.STDOUT,
+                                timeout=timeout, cwd=REPO).returncode
+        except subprocess.TimeoutExpired:
+            return False                    # tunnel genuinely hung
+    if rc == 0:
+        return True
+    print(f"[hunt] probe failed fast (rc={rc}) — local error, not a "
+          f"tunnel hang:", flush=True)
+    with open(log) as f:
+        print("".join(f.readlines()[-5:]), flush=True)
+    sys.exit(1)
+
+
+def _stamp() -> str:
+    return time.strftime("%H:%M:%S")
+
+
+def run_step(step: Step, marks_dir: str, log_dir: str = "/tmp",
+             max_attempts: int = 4, backoff_secs: int = 180) -> bool:
+    """One attempt at a step; returns True when the step is settled
+    (done or gave up), False when the caller should re-probe first."""
+    if step_state(marks_dir, step.name) != "never-ran":
+        return True
+    att = attempts(marks_dir, step.name) + 1
+    with open(os.path.join(marks_dir, step.name + ".attempts"), "w") as f:
+        f.write(str(att))
+    if att > max_attempts:
+        open(os.path.join(marks_dir, step.name + ".gaveup"), "w").close()
+        print(f"[hunt {_stamp()}] step {step.name} GAVE UP after "
+              f"{max_attempts} attempts", flush=True)
+        return True
+    print(f"[hunt {_stamp()}] step {step.name} attempt {att}", flush=True)
+    env = dict(os.environ, **step.env)
+    with open(os.path.join(log_dir, f"hunt_{step.name}.log"), "a") as f:
+        try:
+            rc = subprocess.run(step.cmd, shell=True, stdout=f,
+                                stderr=subprocess.STDOUT, env=env,
+                                timeout=step.timeout, cwd=REPO).returncode
+        except subprocess.TimeoutExpired:
+            rc = 124
+    if rc == 0:
+        open(os.path.join(marks_dir, step.name + ".done"), "w").close()
+        print(f"[hunt {_stamp()}] step {step.name} DONE", flush=True)
+        return True
+    note = " = timeout/killed client" if rc == 124 else ""
+    print(f"[hunt {_stamp()}] step {step.name} failed (rc={rc}{note})",
+          flush=True)
+    # backoff: a fast deterministic failure must not burn every attempt
+    # inside one window; a timed-out step needs the tunnel-wedge to clear
+    time.sleep(backoff_secs)
+    return False
+
+
+def run(marks_dir: str, hours: float, log_dir: str = "/tmp") -> int:
+    import fcntl
+
+    lock = open("/tmp/tpu_hunt.lock", "w")
+    try:
+        fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        print("[hunt] another instance holds /tmp/tpu_hunt.lock; exiting")
+        return 1
+
+    validate_manifest()
+    reset_for_launch(marks_dir)
+    deadline = time.time() + hours * 3600
+
+    while time.time() < deadline:
+        if all_settled(marks_dir):
+            break
+        # CPU-capable steps (the multi-slice smoke) never wait on the
+        # tunnel — run them regardless of its state
+        for s in [s for s in plan(marks_dir) if not s.needs_tpu]:
+            run_step(s, marks_dir, log_dir)
+        tpu_pending = [s for s in plan(marks_dir) if s.needs_tpu]
+        if not tpu_pending:
+            continue                        # loop re-checks all_settled
+        if not probe():
+            print(f"[hunt {_stamp()}] tunnel down", flush=True)
+            time.sleep(300)
+            continue
+        print(f"[hunt {_stamp()}] tunnel UP", flush=True)
+        for s in tpu_pending:
+            if not run_step(s, marks_dir, log_dir):
+                break                       # re-probe before the next try
+
+    print("[hunt] final status:")
+    for s in ordered(MANIFEST):
+        print(f"[hunt]   {s.name}: {step_state(marks_dir, s.name)}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("action", nargs="?", default="run",
+                    choices=["run"], help="run the sweep (default)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the manifest + settle state and exit")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the steps a run would execute and exit")
+    ap.add_argument("--marks", default="/tmp/tpu_hunt_marks",
+                    help="settle-state directory")
+    ap.add_argument("--log-dir", default="/tmp",
+                    help="per-step log directory")
+    ap.add_argument("--hours", type=float, default=10.0,
+                    help="give up after this many hours")
+    args = ap.parse_args(argv)
+
+    validate_manifest()
+    os.makedirs(args.marks, exist_ok=True)
+    if args.list:
+        print(status_table(args.marks))
+        return 0
+    if args.dry_run:
+        for s in plan(args.marks):
+            print(f"{s.name}: timeout {s.timeout}s, "
+                  f"{'tpu' if s.needs_tpu else 'cpu'}: {s.cmd}")
+        return 0
+    return run(args.marks, args.hours, args.log_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,9 @@ def straggler_timeline(trace: Dict) -> List[Dict]:
         out.append({"at_secs": e["ts"] / 1e6,
                     "iteration": a.get("iteration"),
                     "host": a.get("host"), "section": a.get("section"),
+                    # multi-slice runs (telemetry schema 4) name the slice
+                    # the straggling host belongs to; absent otherwise
+                    "slice": a.get("slice"),
                     "secs": a.get("secs"), "median_secs": a.get("median_secs"),
                     "ratio": a.get("ratio")})
     return sorted(out, key=lambda r: r["at_secs"])
@@ -194,7 +197,9 @@ def render(trace: Dict, top_n: int, trend: List[Dict]) -> str:
     st = straggler_timeline(trace)
     lines.append(f"\nstraggler events: {other.get('straggler_events', len(st))}")
     for s in st:
-        lines.append(f"  iteration {s['iteration']}: host {s['host']} "
+        who = (f"slice {s['slice']} host {s['host']}"
+               if s.get("slice") is not None else f"host {s['host']}")
+        lines.append(f"  iteration {s['iteration']}: {who} "
                      f"{s['section']} {(s['secs'] or 0.0) * 1000:.1f} ms = "
                      f"{(s['ratio'] or 0.0):.2f}x median "
                      f"({(s['median_secs'] or 0.0) * 1000:.1f} ms)")
