@@ -383,6 +383,12 @@ def _add_telemetry_args(parser):
     g.add_argument("--flight_recorder_size", type=int, default=64,
                    help="how many step records the in-memory flight "
                         "recorder retains")
+    g.add_argument("--status_port", type=int, default=None,
+                   help="start a stdlib HTTP /health + /metrics endpoint "
+                        "on process 0 serving the latest telemetry record "
+                        "(step, loss, MFU, goodput_pct, recovery "
+                        "counters) as JSON or Prometheus text — the "
+                        "trainer-side twin of the serving /metrics")
     g.add_argument("--profile", action="store_true",
                    help="capture a jax.profiler trace of iterations "
                         "[profile_step_start, profile_step_end] during "

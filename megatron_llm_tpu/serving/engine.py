@@ -170,6 +170,9 @@ class InferenceEngine:
         self.decode_secs = 0.0
         self.finished: Dict[str, int] = {}
         self.warmed_up = False
+        # called with every request_done record (ServerMetrics feeds its
+        # SLO histograms from here); exceptions never reach the engine loop
+        self.request_done_hook: Optional[Any] = None
 
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -265,25 +268,30 @@ class InferenceEngine:
     def submit(self, prompt_tokens: Sequence[int],
                sampling: Optional[SamplingParams] = None,
                stream: bool = False,
-               deadline_secs: Optional[float] = None) -> Request:
+               deadline_secs: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Request:
         return self.submit_many([list(prompt_tokens)],
                                 [sampling or SamplingParams()],
                                 stream=stream,
-                                deadline_secs=deadline_secs)[0]
+                                deadline_secs=deadline_secs,
+                                trace_id=trace_id)[0]
 
     def submit_many(self, prompts: Sequence[Sequence[int]],
                     samplings: Sequence[Optional[SamplingParams]],
                     stream: bool = False,
-                    deadline_secs: Optional[float] = None) -> List[Request]:
+                    deadline_secs: Optional[float] = None,
+                    trace_id: Optional[str] = None) -> List[Request]:
         """Atomic multi-request admission: validates and enqueues all, or
         raises (ValueError -> HTTP 400, QueueFull -> HTTP 429) enqueueing
-        none."""
+        none.  ``trace_id`` (the router's X-Request-Trace) is shared by
+        every sub-request of a multi-prompt call — they are one client
+        request."""
         if deadline_secs is None:
             deadline_secs = (self.config.default_deadline_secs or None)
         reqs = []
         for toks, sp in zip(prompts, samplings):
             r = Request(toks, sp or SamplingParams(), stream=stream,
-                        deadline_secs=deadline_secs)
+                        deadline_secs=deadline_secs, trace_id=trace_id)
             r._pc_submit = time.perf_counter()
             self.scheduler.validate(r)
             reqs.append(r)
@@ -346,8 +354,16 @@ class InferenceEngine:
         for req in sched.sweep_deadlines():
             req._finish(FINISH_DEADLINE)
             self._retire(req)
+        t_admit = time.perf_counter()
+        admitted = []
         for req in sched.admit():
             self._on_admit(req)
+            admitted.append(req)
+        if admitted:
+            # slot-setup cost, split evenly across this round's admits
+            share = (time.perf_counter() - t_admit) / len(admitted)
+            for req in admitted:
+                req.admission_secs += share
         kind, arg = sched.next_action()
         if kind == "prefill":
             self._run_prefill_chunk(arg)
@@ -372,9 +388,23 @@ class InferenceEngine:
         self._context_lens[s] = 0
         self.prefill_tokens_submitted += len(req.prompt_tokens)
         self.prefill_tokens_cached += req.cached_prompt_tokens
+        req._pc_admit = time.perf_counter()
+        req.queue_wait_secs = req._pc_admit - req._pc_submit
+        tracer = tracing.get_tracer()
+        if tracer is not None:
+            # queue wait as a span: visible dead-time on the timeline
+            # between the client's submit and the slot grant
+            tracer.completed("queue_wait", "serve", req._pc_submit,
+                             req.queue_wait_secs, request=req.id,
+                             trace=req.trace_id)
         tracing.instant("admit", "serve", request=req.id, slot=s,
+                        trace=req.trace_id,
                         prompt_tokens=len(req.prompt_tokens),
                         cached_prompt_tokens=req.cached_prompt_tokens)
+        if req.cached_prompt_tokens > 0:
+            tracing.instant("prefix_cache_hit", "serve", request=req.id,
+                            trace=req.trace_id,
+                            tokens=req.cached_prompt_tokens)
 
     # -- prefill --------------------------------------------------------
 
@@ -401,7 +431,8 @@ class InferenceEngine:
         table = self.blocks.tables[req.slot:req.slot + 1].copy()
         t0 = time.perf_counter()
         with tracing.span("prefill_chunk", "serve", request=req.id,
-                          tokens=valid):
+                          trace=req.trace_id, tokens=valid,
+                          cached_tokens=req.cached_prompt_tokens):
             last_logits, self._pages = self._prefill_step(
                 self.params, self._pages, toks, np.int32(start),
                 np.int32(valid), table)
@@ -417,7 +448,9 @@ class InferenceEngine:
                 self._keys[req.slot] = np.asarray(new_key)
             else:
                 jax.block_until_ready(self._pages[0])
-        self.prefill_secs += time.perf_counter() - t0
+        chunk_secs = time.perf_counter() - t0
+        self.prefill_secs += chunk_secs
+        req.prefill_compute_secs += chunk_secs
         self.prefill_chunks += 1
         self.prefill_tokens_computed += valid
         req.prefill_pos = start + valid
@@ -441,8 +474,12 @@ class InferenceEngine:
         bs = self.config.block_size
         for s in slots:
             self._writable(s, int(self._context_lens[s]) // bs)
+        decoding = [r for r in (self.scheduler.active.get(s) for s in slots)
+                    if r is not None and r.state == RequestState.DECODE]
+        traces = sorted({r.trace_id for r in decoding if r.trace_id})
         t0 = time.perf_counter()
-        with tracing.span("decode_step", "serve", batch=len(slots)):
+        with tracing.span("decode_step", "serve", batch=len(slots),
+                          traces=traces):
             next_tokens, self._pages, new_keys = self._decode_step(
                 self.params, self._pages, self._last_tokens,
                 self._context_lens, self.blocks.tables.copy(),
@@ -455,9 +492,17 @@ class InferenceEngine:
         new_keys = np.asarray(new_keys)
         for s in slots:
             self._keys[s] = new_keys[s]
-        self.decode_secs += time.perf_counter() - t0
+        step_secs = time.perf_counter() - t0
+        self.decode_secs += step_secs
         self.decode_steps += 1
         self.occupancy_sum += len(slots)
+        # amortized TPOT accounting: each co-batched request pays an
+        # equal share of the batched step — its true marginal latency,
+        # not the whole step (which double-counts at high occupancy)
+        share = step_secs / max(len(decoding), 1)
+        for req in decoding:
+            req.decode_amortized_secs += share
+            req.decode_tokens += 1
         for s in slots:
             req = self.scheduler.active.get(s)
             if req is None or req.state != RequestState.DECODE:
@@ -511,27 +556,42 @@ class InferenceEngine:
         if tracer is not None and pc0 is not None:
             tracer.completed(
                 "request", "serve", pc0, time.perf_counter() - pc0,
-                request=req.id, prompt_tokens=len(req.prompt_tokens),
+                request=req.id, trace=req.trace_id,
+                prompt_tokens=len(req.prompt_tokens),
                 new_tokens=len(req.out_tokens),
                 finish_reason=req.finish_reason)
+        bstats = self.blocks.stats()
+        tpot = req.tpot_secs()
+        record = {
+            "kind": "serve", "event": "request_done",
+            "request": req.id,
+            "trace_id": req.trace_id,
+            "prompt_tokens": len(req.prompt_tokens),
+            "cached_prompt_tokens": req.cached_prompt_tokens,
+            "prefill_computed_tokens":
+                len(req.prompt_tokens) - req.cached_prompt_tokens,
+            "new_tokens": len(req.out_tokens),
+            "decode_tokens": req.decode_tokens,
+            "finish_reason": req.finish_reason,
+            "ttft_secs": req.ttft_secs(),
+            "latency_secs": req.latency_secs(),
+            "tpot_secs": round(tpot, 6) if tpot is not None else None,
+            "phases": req.phases(),
+            "paged_kernel": self.paged_kernel,
+            "queue_depth": self.queue.depth(),
+            "blocks_free": bstats["blocks_free"],
+            "blocks_in_use": bstats["blocks_in_use"],
+            "blocks_cached_reusable": bstats["blocks_cached_reusable"],
+        }
         stream = telemetry.get_stream()
         if stream is not None:
-            bstats = self.blocks.stats()
-            stream.emit({
-                "kind": "serve", "event": "request_done",
-                "request": req.id,
-                "prompt_tokens": len(req.prompt_tokens),
-                "cached_prompt_tokens": req.cached_prompt_tokens,
-                "new_tokens": len(req.out_tokens),
-                "finish_reason": req.finish_reason,
-                "ttft_secs": req.ttft_secs(),
-                "latency_secs": req.latency_secs(),
-                "paged_kernel": self.paged_kernel,
-                "queue_depth": self.queue.depth(),
-                "blocks_free": bstats["blocks_free"],
-                "blocks_in_use": bstats["blocks_in_use"],
-                "blocks_cached_reusable": bstats["blocks_cached_reusable"],
-            })
+            stream.emit(record)
+        hook = self.request_done_hook
+        if hook is not None:
+            try:
+                hook(record)
+            except Exception:
+                pass    # metrics must never take down the engine loop
 
     def _count_finish(self, reason: Optional[str]) -> None:
         if reason:

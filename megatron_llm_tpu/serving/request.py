@@ -88,10 +88,15 @@ class Request:
     def __init__(self, prompt_tokens: Sequence[int],
                  sampling: SamplingParams,
                  stream: bool = False,
-                 deadline_secs: Optional[float] = None):
+                 deadline_secs: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         if not prompt_tokens:
             raise ValueError("empty prompt (tokenized to zero ids)")
         self.id = next(_REQ_IDS)
+        # router-minted X-Request-Trace id (or server-minted for direct
+        # traffic) — threads through spans + the request_done JSONL so
+        # one request is followable across processes
+        self.trace_id = trace_id
         self.prompt_tokens: List[int] = [int(t) for t in prompt_tokens]
         self.sampling = sampling
         self.out_tokens: List[int] = []
@@ -106,6 +111,17 @@ class Request:
                          if deadline_secs else None)
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
+        # phase attribution (engine-side perf_counter clock; queue wait
+        # and admission are measured by the engine, the rest accumulate
+        # as the request rides prefill chunks / decode steps)
+        self._pc_submit = time.perf_counter()
+        self._pc_admit: Optional[float] = None
+        self.queue_wait_secs: Optional[float] = None
+        self.admission_secs = 0.0
+        self.prefill_compute_secs = 0.0
+        self.decode_amortized_secs = 0.0    # share of batched decode steps
+        self.stream_write_secs = 0.0
+        self.decode_tokens = 0
         self._done = threading.Event()
         self._events: Optional[queue.Queue] = queue.Queue() if stream \
             else None
@@ -117,7 +133,9 @@ class Request:
             self.t_first_token = time.monotonic()
         self.out_tokens.append(int(token))
         if self._events is not None:
+            t0 = time.perf_counter()
             self._events.put(("token", int(token)))
+            self.stream_write_secs += time.perf_counter() - t0
 
     def _finish(self, reason: str, error: Optional[str] = None) -> None:
         if self.state == RequestState.DONE:
@@ -152,6 +170,28 @@ class Request:
         if self.t_done is None:
             return None
         return self.t_done - self.t_submit
+
+    def tpot_secs(self) -> Optional[float]:
+        """True time-per-output-token: this request's amortized share of
+        the batched decode steps it rode, per generated token.  None
+        until a decode step has completed."""
+        if self.decode_tokens <= 0:
+            return None
+        return self.decode_amortized_secs / self.decode_tokens
+
+    def phases(self) -> dict:
+        """Wall-clock attribution for the request_done record: where this
+        request's latency went.  Queue wait is submit→admit; admission is
+        its share of slot setup; prefill/decode are its share of the
+        jitted dispatches; stream_write is SSE back-pressure."""
+        return {
+            "queue_secs": (round(self.queue_wait_secs, 6)
+                           if self.queue_wait_secs is not None else None),
+            "admission_secs": round(self.admission_secs, 6),
+            "prefill_secs": round(self.prefill_compute_secs, 6),
+            "decode_secs": round(self.decode_amortized_secs, 6),
+            "stream_write_secs": round(self.stream_write_secs, 6),
+        }
 
     def result(self, timeout: Optional[float] = None) -> "Request":
         """Block until the engine finishes this request."""

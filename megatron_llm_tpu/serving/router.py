@@ -36,9 +36,20 @@ import http.client
 import json
 import threading
 import time
+import uuid
 from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 from urllib.parse import urlparse
+
+# request trace header (mirrors text_generation_server.TRACE_HEADER —
+# redeclared so the router stays importable with stdlib alone)
+TRACE_HEADER = "X-Request-Trace"
+
+
+def _new_trace_id() -> str:
+    """Router-local trace-id mint (same format as tracing.new_trace_id;
+    duplicated to keep this module jax-free)."""
+    return uuid.uuid4().hex[:16]
 
 
 class Backend:
@@ -127,6 +138,29 @@ def _sum_numeric(dst: Dict[str, object], src: Dict[str, object]) -> None:
                 _sum_numeric(sub, v)
 
 
+def _collect_non_numeric(dst: Dict[str, Dict[str, object]],
+                         src: Dict[str, object], replica: str,
+                         path: str = "") -> None:
+    """Collect non-numeric leaves (e.g. ``engine.paged_kernel:
+    "pallas"``) as a dotted-path -> {replica: value} map.  These can't
+    be summed, but a fleet where one replica runs the XLA fallback is
+    exactly the situation the aggregated /metrics must surface instead
+    of silently dropping."""
+    for k, v in src.items():
+        dotted = f"{path}{k}"
+        if isinstance(v, dict):
+            _collect_non_numeric(dst, v, replica, path=f"{dotted}.")
+        elif isinstance(v, str):
+            dst.setdefault(dotted, {})[replica] = v
+
+
+def _is_histogram(d: object) -> bool:
+    """Structural twin of telemetry.is_histogram_snapshot, kept local so
+    the router imports nothing beyond stdlib."""
+    return (isinstance(d, dict) and "count" in d and "sum" in d
+            and isinstance(d.get("buckets"), dict))
+
+
 def _numeric_only(d: Dict[str, object]) -> Dict[str, object]:
     """Drop non-numeric leaves (URLs etc.) so the dict is safe for the
     Prometheus text renderer."""
@@ -152,10 +186,15 @@ class ReplicaRouter:
                  affinity_chars: int = 256,
                  affinity_max: int = 4096,
                  health_interval_secs: float = 2.0,
-                 request_timeout_secs: float = 600.0):
+                 request_timeout_secs: float = 600.0,
+                 tracer=None):
         if not backend_urls:
             raise ValueError("router needs at least one backend")
         self.backends = [Backend(u) for u in backend_urls]
+        # duck-typed span recorder (tracing.SpanTracer when the process
+        # runs with --trace_dir; anything with completed()/instant()):
+        # injected rather than imported so the router stays stdlib-pure
+        self.tracer = tracer
         self.fail_threshold = int(fail_threshold)
         self.cooldown_secs = float(cooldown_secs)
         self.max_cooldown_secs = float(max_cooldown_secs)
@@ -224,32 +263,46 @@ class ReplicaRouter:
 
     def _open(self, b: Backend, method: str, path: str,
               body: Optional[bytes],
-              timeout: Optional[float] = None) -> http.client.HTTPConnection:
+              timeout: Optional[float] = None,
+              trace_id: Optional[str] = None) -> http.client.HTTPConnection:
         conn = http.client.HTTPConnection(
             b.host, b.port,
             timeout=self.request_timeout_secs if timeout is None
             else timeout)
         headers = {"Content-Type": "application/json"} if body else {}
+        if trace_id:
+            headers[TRACE_HEADER] = trace_id
         conn.request(method, path, body=body, headers=headers)
         return conn
 
     # -- dispatch -------------------------------------------------------
 
-    def dispatch(self, method: str, path: str, body: Optional[bytes]
+    def dispatch(self, method: str, path: str, body: Optional[bytes],
+                 trace_id: Optional[str] = None
                  ) -> Tuple[int, Dict[str, str], bytes]:
         """Route one buffered (non-streaming) request.  Transport
         failures fail over to the next live replica; 429s collect and
-        merge.  Raises ``NoBackendAvailable`` / ``AllBackendsThrottled``."""
+        merge.  Raises ``NoBackendAvailable`` / ``AllBackendsThrottled``.
+
+        The trace id is minted *before* the candidate loop, so a request
+        replayed on another replica after a failover keeps one identity
+        across the fleet."""
+        if trace_id is None:
+            trace_id = _new_trace_id()
+        t_route = time.perf_counter()
+        attempts = 0
         key = _affinity_key(body or b"", self.affinity_chars) \
             if method in ("PUT", "POST") else None
         cands = self._candidates(key)
         throttle_bodies: List[dict] = []
         for b in cands:
+            attempts += 1
             with self._lock:
                 b.in_flight += 1
             conn = None
             try:
-                conn = self._open(b, method, path, body)
+                conn = self._open(b, method, path, body,
+                                  trace_id=trace_id)
                 resp = conn.getresponse()
                 data = resp.read()
                 headers = dict(resp.getheaders())
@@ -263,6 +316,9 @@ class ReplicaRouter:
                 with self._lock:
                     b.in_flight -= 1
                     self.failovers_total += 1
+                if self.tracer is not None:
+                    self.tracer.instant("failover", "serve",
+                                        trace=trace_id, backend=b.url)
                 continue
             conn.close()
             with self._lock:
@@ -279,6 +335,11 @@ class ReplicaRouter:
                     throttle_bodies.append({})
                 continue
             self._remember_affinity(key, b)
+            if self.tracer is not None:
+                self.tracer.completed(
+                    "route_request", "serve", t_route,
+                    time.perf_counter() - t_route, trace=trace_id,
+                    backend=b.url, status=status, attempts=attempts)
             return status, headers, data
         if throttle_bodies:
             self.throttled_total += 1
@@ -304,26 +365,38 @@ class ReplicaRouter:
             "estimated_wait_secs": best("estimated_wait_secs", None),
         }
 
-    def dispatch_stream(self, method: str, path: str, body: Optional[bytes]
+    def dispatch_stream(self, method: str, path: str, body: Optional[bytes],
+                        trace_id: Optional[str] = None
                         ) -> Tuple[int, Dict[str, str], Iterator[bytes]]:
         """Route a streaming (SSE) request.  Fails over while no byte has
         been forwarded; once the response starts, a mid-stream death
         surfaces to the client (the engine has already consumed the
-        request's sampling state, so a silent replay could diverge)."""
+        request's sampling state, so a silent replay could diverge).
+        As in ``dispatch``, the trace id predates the candidate loop —
+        a pre-first-byte failover replays under the same id."""
+        if trace_id is None:
+            trace_id = _new_trace_id()
+        t_route = time.perf_counter()
+        attempts = 0
         key = _affinity_key(body or b"", self.affinity_chars)
         cands = self._candidates(key)
         throttle_bodies: List[dict] = []
         for b in cands:
+            attempts += 1
             with self._lock:
                 b.in_flight += 1
             try:
-                conn = self._open(b, method, path, body)
+                conn = self._open(b, method, path, body,
+                                  trace_id=trace_id)
                 resp = conn.getresponse()
             except (OSError, http.client.HTTPException):
                 self._record_failure(b)
                 with self._lock:
                     b.in_flight -= 1
                     self.failovers_total += 1
+                if self.tracer is not None:
+                    self.tracer.instant("failover", "serve",
+                                        trace=trace_id, backend=b.url)
                 continue
             self._record_success(b)
             if resp.status == 429:
@@ -341,6 +414,8 @@ class ReplicaRouter:
                 continue
             headers = dict(resp.getheaders())
             self._remember_affinity(key, b)
+            tracer = self.tracer
+            n_attempts = attempts
 
             def relay(resp=resp, conn=conn, b=b) -> Iterator[bytes]:
                 try:
@@ -355,6 +430,13 @@ class ReplicaRouter:
                         b.in_flight -= 1
                         b.requests += 1
                         self.requests_total += 1
+                    if tracer is not None:
+                        # the routed span closes when the stream drains:
+                        # it covers the whole relay, not just connect
+                        tracer.completed(
+                            "route_stream", "serve", t_route,
+                            time.perf_counter() - t_route, trace=trace_id,
+                            backend=b.url, attempts=n_attempts)
 
             return resp.status, headers, relay()
         if throttle_bodies:
@@ -440,9 +522,14 @@ class ReplicaRouter:
     def aggregated_metrics(self) -> Dict[str, object]:
         """Router snapshot + per-backend /metrics + a numeric sum over
         the replicas that answered (fleet totals: tokens/sec columns add,
-        cache hit counters add, ...)."""
+        cache hit counters add, histogram buckets add — which makes the
+        summed ``histograms`` the true fleet distributions).  Non-numeric
+        leaves land in ``aggregate.per_replica`` as per-replica maps, and
+        fleet SLO percentiles are recomputed from the merged buckets
+        (percentiles never sum)."""
         per_backend: Dict[str, object] = {}
         aggregate: Dict[str, object] = {}
+        per_replica: Dict[str, Dict[str, object]] = {}
         for i, b in enumerate(self.backends):
             snap = None
             try:
@@ -460,6 +547,26 @@ class ReplicaRouter:
             per_backend[f"backend_{i}"] = snap
             if isinstance(snap, dict):
                 _sum_numeric(aggregate, snap)
+                _collect_non_numeric(per_replica, snap, f"backend_{i}")
+        hists = aggregate.get("histograms")
+        if isinstance(hists, dict):
+            try:
+                from megatron_llm_tpu.telemetry import histogram_percentile
+
+                slo: Dict[str, object] = {}
+                for name, h in hists.items():
+                    if not _is_histogram(h):
+                        continue
+                    for q, tag in ((0.50, "p50"), (0.95, "p95"),
+                                   (0.99, "p99")):
+                        slo[f"{name}_{tag}"] = histogram_percentile(h, q)
+                aggregate["slo"] = slo
+            except ImportError:
+                # stdlib-only deployment without the package on path:
+                # drop the (meaninglessly summed) percentiles instead
+                aggregate.pop("slo", None)
+        if per_replica:
+            aggregate["per_replica"] = per_replica
         return {"router": self.snapshot(), "aggregate": aggregate,
                 "backends": per_backend}
 
@@ -476,9 +583,9 @@ class RouterServer:
     def run(self, host: str = "0.0.0.0", port: int = 8000) -> None:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-        # PR 5's renderer; imported lazily so the router stays importable
-        # without the model-serving stack
-        from megatron_llm_tpu.text_generation_server import (
+        # PR 5's renderer (canonical home now telemetry.py); imported
+        # lazily so the router stays importable without the serving stack
+        from megatron_llm_tpu.telemetry import (
             _wants_prometheus,
             prometheus_exposition,
         )
@@ -486,11 +593,14 @@ class RouterServer:
         router = self.router
 
         class Handler(BaseHTTPRequestHandler):
-            def _send_json(self, code: int, body: dict):
+            def _send_json(self, code: int, body: dict,
+                           trace_id: str = None):
                 data = json.dumps(body).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                if trace_id:
+                    self.send_header(TRACE_HEADER, trace_id)
                 if code == 429:
                     self.send_header("Retry-After", str(max(int(
                         body.get("retry_after_secs") or 1), 1)))
@@ -501,6 +611,11 @@ class RouterServer:
                 n = int(self.headers.get("Content-Length", 0))
                 return self.rfile.read(n) if n else b""
 
+            def _trace_id(self) -> str:
+                # honor a client-supplied id (an upstream gateway may
+                # already own the trace), mint otherwise
+                return self.headers.get(TRACE_HEADER) or _new_trace_id()
+
             def do_PUT(self):
                 if self.path in ("/api/stream", "/generate/stream"):
                     self._do_stream()
@@ -508,19 +623,22 @@ class RouterServer:
                 if self.path not in ("/api", "/generate"):
                     self.send_error(404)
                     return
+                trace_id = self._trace_id()
                 try:
                     status, headers, data = router.dispatch(
-                        "PUT", self.path, self._body())
+                        "PUT", self.path, self._body(), trace_id=trace_id)
                 except AllBackendsThrottled as exc:
-                    self._send_json(429, exc.body)
+                    self._send_json(429, exc.body, trace_id=trace_id)
                     return
                 except NoBackendAvailable as exc:
-                    self._send_json(503, {"message": str(exc)})
+                    self._send_json(503, {"message": str(exc)},
+                                    trace_id=trace_id)
                     return
                 self.send_response(status)
                 self.send_header("Content-Type", headers.get(
                     "Content-Type", "application/json"))
                 self.send_header("Content-Length", str(len(data)))
+                self.send_header(TRACE_HEADER, trace_id)
                 ra = headers.get("Retry-After")
                 if ra:
                     self.send_header("Retry-After", ra)
@@ -528,20 +646,23 @@ class RouterServer:
                 self.wfile.write(data)
 
             def _do_stream(self):
+                trace_id = self._trace_id()
                 try:
                     status, headers, chunks = router.dispatch_stream(
-                        "PUT", self.path, self._body())
+                        "PUT", self.path, self._body(), trace_id=trace_id)
                 except AllBackendsThrottled as exc:
-                    self._send_json(429, exc.body)
+                    self._send_json(429, exc.body, trace_id=trace_id)
                     return
                 except NoBackendAvailable as exc:
-                    self._send_json(503, {"message": str(exc)})
+                    self._send_json(503, {"message": str(exc)},
+                                    trace_id=trace_id)
                     return
                 self.send_response(status)
                 self.send_header("Content-Type", headers.get(
                     "Content-Type", "text/event-stream"))
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                self.send_header(TRACE_HEADER, trace_id)
                 self.end_headers()
                 try:
                     for chunk in chunks:

@@ -47,8 +47,10 @@ only at deterministic log boundaries (see ``timers.Timers``).
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -226,6 +228,180 @@ def device_memory_stats(device=None) -> Dict[str, int]:
 
 
 # ---------------------------------------------------------------------------
+# Fixed-bucket histograms (SLO accounting)
+# ---------------------------------------------------------------------------
+
+# Prometheus-style latency buckets (seconds).  Fixed across the fleet so
+# replica histograms merge by bucket-sum in the router's /metrics.
+DEFAULT_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+_INF_LABEL = "+Inf"
+
+
+def _bucket_label(bound: float) -> str:
+    return format(bound, "g")
+
+
+class Histogram:
+    """Stdlib fixed-bucket histogram, mergeable by bucket-sum.
+
+    Snapshots carry per-bucket (non-cumulative) counts keyed by the
+    bucket's upper bound, plus ``count`` and ``sum`` — all additive, so
+    the router's recursive numeric sum over replica snapshots IS the
+    fleet histogram.  Percentiles come from ``histogram_percentile``
+    (linear interpolation inside the winning bucket), computed at read
+    time and never stored, so they can't be accidentally summed."""
+
+    def __init__(self, bounds=DEFAULT_LATENCY_BUCKETS):
+        self.bounds = tuple(sorted(float(b) for b in bounds))
+        if not self.bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.bounds) + 1)     # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value) -> None:
+        if value is None:
+            return
+        v = float(value)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        buckets = {_bucket_label(b): counts[i]
+                   for i, b in enumerate(self.bounds)}
+        buckets[_INF_LABEL] = counts[-1]
+        return {"buckets": buckets, "count": total, "sum": round(s, 9)}
+
+
+def is_histogram_snapshot(d: Any) -> bool:
+    """Structural check shared by the Prometheus renderer and the router
+    aggregation: a dict with a str->number ``buckets`` dict plus
+    ``count``/``sum`` leaves."""
+    return (isinstance(d, dict) and "count" in d and "sum" in d
+            and isinstance(d.get("buckets"), dict))
+
+
+def histogram_percentile(snap: Dict[str, Any], q: float) -> Optional[float]:
+    """Estimate the q-quantile from a (possibly merged) histogram
+    snapshot.  Linear interpolation within the winning bucket; the +Inf
+    bucket answers with its lower edge (the largest finite bound) — an
+    under-estimate, never an invention.  None on an empty histogram."""
+    if not is_histogram_snapshot(snap):
+        return None
+    total = snap.get("count") or 0
+    if total <= 0:
+        return None
+    items = []
+    for k, v in snap["buckets"].items():
+        bound = float("inf") if k in (_INF_LABEL, "inf") else float(k)
+        items.append((bound, int(v)))
+    items.sort()
+    target = max(min(float(q), 1.0), 0.0) * total
+    cum = 0
+    lo = 0.0
+    for bound, c in items:
+        if c > 0 and cum + c >= target:
+            if bound == float("inf"):
+                return lo
+            frac = (target - cum) / c if c else 1.0
+            return lo + (bound - lo) * max(min(frac, 1.0), 0.0)
+        cum += c
+        if bound != float("inf"):
+            lo = bound
+    return lo
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (shared by serving /metrics, the router's
+# fleet /metrics, and the trainer's --status_port endpoint)
+# ---------------------------------------------------------------------------
+
+def _metric_name(name: str) -> str:
+    name = "".join(c if (c.isalnum() and c.isascii()) or c == "_"
+                   else "_" for c in name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def prometheus_exposition(snapshot: dict,
+                          prefix: str = "megatron_serve_") -> str:
+    """Render a metrics snapshot dict as Prometheus text exposition
+    format (0.0.4) so standard scrapers can hit ``/metrics`` without a
+    JSON-translating sidecar.  Nested dicts (the ``engine`` block, its
+    per-reason completion counts) flatten into underscore-joined names;
+    None values (e.g. empty-window percentiles) are omitted; numbers are
+    exported as gauges — the scraper cannot tell a monotone counter from
+    a level, and gauge is always safe.  Histogram snapshots (the
+    ``Histogram.snapshot()`` shape) render as proper Prometheus
+    histograms: cumulative ``_bucket{le=...}`` series plus ``_sum`` and
+    ``_count``."""
+    lines = []
+
+    def emit(name, value):
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return
+        name = _metric_name(name)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {float(value):g}")
+
+    def emit_histogram(name, snap):
+        name = _metric_name(name)
+        items = []
+        for k, v in snap["buckets"].items():
+            bound = float("inf") if k in (_INF_LABEL, "inf") else float(k)
+            items.append((bound, k, int(v)))
+        items.sort()
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        for bound, label, c in items:
+            cum += c
+            lines.append(f'{name}_bucket{{le="{label}"}} {cum}')
+        lines.append(f"{name}_sum {float(snap.get('sum') or 0.0):g}")
+        lines.append(f"{name}_count {int(snap.get('count') or 0)}")
+
+    def walk(d, path):
+        for k, v in sorted(d.items()):
+            if is_histogram_snapshot(v):
+                emit_histogram(f"{path}{k}", v)
+            elif isinstance(v, dict):
+                walk(v, f"{path}{k}_")
+            else:
+                emit(f"{path}{k}", v)
+
+    walk(snapshot, prefix)
+    return "\n".join(lines) + "\n"
+
+
+def _wants_prometheus(path: str, accept: str) -> bool:
+    """Content negotiation for /metrics: an explicit ?format=prometheus
+    query wins; otherwise an Accept header preferring text/plain (what
+    the Prometheus scraper sends) selects the text exposition."""
+    query = path.partition("?")[2]
+    for pair in query.split("&"):
+        if pair.partition("=")[::2] == ("format", "prometheus"):
+            return True
+    accept = accept.lower()
+    return ("text/plain" in accept or "openmetrics" in accept) \
+        and "application/json" not in accept
+
+
+# ---------------------------------------------------------------------------
 # Structured JSONL stream
 # ---------------------------------------------------------------------------
 
@@ -235,7 +411,13 @@ def device_memory_stats(device=None) -> Dict[str, int]:
 # 4: + per-slice attribution on multi-slice runs (slice_times /
 #    worst_slice / goodput.slice_stall_secs) and the elastic_resume /
 #    preempt_rescue event kinds — see multislice.py
-TELEMETRY_SCHEMA_VERSION = 4
+# 5: serve request_done records gain trace_id (the router-minted
+#    X-Request-Trace id), per-request phase attribution (phases.queue_secs
+#    / admission_secs / prefill_secs / decode_secs / stream_write_secs),
+#    tpot_secs (amortized per-output-token decode latency), decode_tokens
+#    and prefill_computed_tokens — see serving/engine.py and
+#    tools/serve_report.py
+TELEMETRY_SCHEMA_VERSION = 5
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 
@@ -246,13 +428,17 @@ class TelemetryStream:
     aggregates for the end-of-run summary (mean MFU etc. — percentiles
     are the offline ``tools/telemetry_report.py``'s job)."""
 
-    def __init__(self, log_dir: str, flight_recorder_size: int = 64):
+    def __init__(self, log_dir: Optional[str] = None,
+                 flight_recorder_size: int = 64):
         self.log_dir = log_dir
         self.flight_recorder = FlightRecorder(flight_recorder_size)
         self._file = None
+        # a StatusServer (--status_port) sees every emitted record; None
+        # when no live endpoint is attached
+        self.status_server: Optional["StatusServer"] = None
         self._sums = {"steps": 0, "mfu": 0.0, "mfu_n": 0,
                       "tokens_per_sec_per_device": 0.0, "step_time": 0.0}
-        if jax.process_index() == 0:
+        if log_dir and jax.process_index() == 0:
             os.makedirs(log_dir, exist_ok=True)
             self._file = open(os.path.join(log_dir, STREAM_FILENAME),
                               "a", buffering=1)
@@ -262,7 +448,12 @@ class TelemetryStream:
         rec = {"schema": TELEMETRY_SCHEMA_VERSION, "kind": "log",
                "time_unix": time.time(), **record}
         if self._file is not None:
-            self._file.write(json.dumps(rec) + "\n")
+            try:
+                self._file.write(json.dumps(rec) + "\n")
+            except ValueError:
+                pass    # closed mid-shutdown while the engine retires
+        if self.status_server is not None:
+            self.status_server.update(rec)
         self.flight_recorder.record(rec)
         s = self._sums
         s["steps"] += 1
@@ -292,7 +483,7 @@ class TelemetryStream:
         }
 
     def dump_flight_recorder(self, reason: str = "") -> Optional[str]:
-        if not len(self.flight_recorder):
+        if self.log_dir is None or not len(self.flight_recorder):
             return None
         path = os.path.join(self.log_dir, FLIGHT_RECORDER_FILENAME)
         return self.flight_recorder.dump(path, reason=reason)
@@ -418,6 +609,101 @@ class ProfilerSession:
 
 
 # ---------------------------------------------------------------------------
+# Trainer live-status endpoint (--status_port)
+# ---------------------------------------------------------------------------
+
+class StatusServer:
+    """Stdlib HTTP ``/health`` + ``/metrics`` over the latest telemetry
+    record — the trainer-side twin of the serving server's endpoints, so
+    the same scraper config covers both halves of the system.  Runs as a
+    daemon thread on process 0 only; ``update()`` is called from the
+    stream's ``emit()`` so it costs one dict assignment per log boundary.
+
+    ``/health``  -> {"status": "ok", "iteration", "secs_since_last_record",
+                     "uptime_secs"}
+    ``/metrics`` -> the latest record as JSON, or Prometheus text
+                    exposition under the usual negotiation
+                    (?format=prometheus or an Accept preferring
+                    text/plain), prefix ``megatron_train_``.
+    """
+
+    def __init__(self, port: int, host: str = "0.0.0.0"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self._latest: Optional[Dict[str, Any]] = None
+        self._latest_at: Optional[float] = None
+        self._t_start = time.time()
+        self._lock = threading.Lock()
+        status = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *a):     # silence per-request noise
+                pass
+
+            def _send(self, code, payload, content_type="application/json"):
+                body = payload if isinstance(payload, bytes) \
+                    else json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path
+                if path.partition("?")[0] == "/health":
+                    self._send(200, status.health())
+                elif path.partition("?")[0] == "/metrics":
+                    latest = status.latest() or {}
+                    if _wants_prometheus(path,
+                                         self.headers.get("Accept", "")):
+                        text = prometheus_exposition(
+                            latest, prefix="megatron_train_")
+                        self._send(200, text.encode(),
+                                   content_type="text/plain; version=0.0.4")
+                    else:
+                        self._send(200, latest)
+                else:
+                    self._send(404, {"message": "not found"})
+
+        self.httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]    # resolved when port=0
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="status-server",
+            daemon=True)
+        self._thread.start()
+
+    def update(self, rec: Dict[str, Any]) -> None:
+        # keep only JSON-serializable leaves; the record already is
+        with self._lock:
+            self._latest = rec
+            self._latest_at = time.time()
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._latest) if self._latest else None
+
+    def health(self) -> Dict[str, Any]:
+        with self._lock:
+            latest, at = self._latest, self._latest_at
+        return {
+            "status": "ok",
+            "iteration": (latest or {}).get("iteration"),
+            "secs_since_last_record":
+                (round(time.time() - at, 3) if at else None),
+            "uptime_secs": round(time.time() - self._t_start, 3),
+        }
+
+    def close(self) -> None:
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
 # Bundle + CLI wiring
 # ---------------------------------------------------------------------------
 
@@ -429,6 +715,7 @@ class Telemetry:
     stream: Optional[TelemetryStream] = None
     profiler: Optional[ProfilerSession] = None
     tracing: Optional[Any] = None       # a tracing.Tracing bundle
+    status: Optional[StatusServer] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
@@ -443,6 +730,8 @@ class Telemetry:
         if self.tracing is not None:
             # writes the trace file, then uninstalls the module registry
             self.tracing.close()
+        if self.status is not None:
+            self.status.close()
         if self.stream is not None:
             if get_stream() is self.stream:
                 install_stream(None)
@@ -479,6 +768,20 @@ def build_telemetry(args, model) -> Telemetry:
     elif getattr(args, "profiler_port", None):
         # a live-capture server without a pre-chosen window
         jax.profiler.start_server(int(args.profiler_port))
+    status_port = getattr(args, "status_port", None)
+    if status_port is not None and jax.process_index() == 0:
+        if t.stream is None:
+            # in-memory stream: the endpoint needs emit() records even
+            # when nothing asked for the JSONL file
+            t.stream = TelemetryStream(
+                None,
+                flight_recorder_size=getattr(
+                    args, "flight_recorder_size", 64))
+            install_stream(t.stream)
+        t.status = StatusServer(int(status_port))
+        t.stream.status_server = t.status
+        print(f" [telemetry] status endpoint on port {t.status.port} "
+              f"(/health, /metrics)", flush=True)
     from megatron_llm_tpu import tracing as _tracing
 
     t.tracing = _tracing.build_tracing(args)    # None without --trace_dir

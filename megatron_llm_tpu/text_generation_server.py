@@ -37,9 +37,20 @@ from megatron_llm_tpu.text_generation.api import (
     generate_and_post_process,
     resolve_stop_rules,
 )
+# canonical home is telemetry.py (the trainer's --status_port and the
+# router reuse them); re-exported here for existing importers
+from megatron_llm_tpu.telemetry import (   # noqa: F401
+    Histogram,
+    histogram_percentile,
+    prometheus_exposition,
+    _wants_prometheus,
+)
+from megatron_llm_tpu.tracing import new_trace_id
 
 MAX_PROMPTS = 128       # defaults; override with --serve_max_prompts /
 MAX_TOKENS = 1024       # --serve_max_tokens (arguments.py)
+
+TRACE_HEADER = "X-Request-Trace"
 
 
 class ServerMetrics:
@@ -64,6 +75,30 @@ class ServerMetrics:
         self.streamed = 0           # SSE requests served
         self.tokens_generated = 0
         self.engine_stats_fn = None  # set when an engine is attached
+        # SLO histograms over the full serving lifetime (the bounded
+        # latency window above keeps its p50/p95 for cheap liveness
+        # checks; these are the mergeable fleet-wide truth).  Fed from
+        # the engine's request_done hook.
+        self.histograms = {
+            "ttft_secs": Histogram(),
+            "tpot_secs": Histogram(),
+            "e2e_secs": Histogram(),
+            "queue_wait_secs": Histogram(),
+        }
+
+    def observe_request_done(self, record: dict) -> None:
+        """Engine ``request_done_hook``: fold one finished request's
+        latency phases into the SLO histograms.  Never raises (the
+        engine guards it too, but belt and braces)."""
+        try:
+            self.histograms["ttft_secs"].observe(record.get("ttft_secs"))
+            self.histograms["tpot_secs"].observe(record.get("tpot_secs"))
+            self.histograms["e2e_secs"].observe(record.get("latency_secs"))
+            phases = record.get("phases") or {}
+            self.histograms["queue_wait_secs"].observe(
+                phases.get("queue_secs"))
+        except Exception:
+            pass
 
     def observe(self, secs: float, status: int, tokens: int = 0,
                 streamed: bool = False) -> None:
@@ -98,6 +133,17 @@ class ServerMetrics:
             }
         out["latency_p50_secs"] = self._percentile(lat, 0.50) if lat else None
         out["latency_p95_secs"] = self._percentile(lat, 0.95) if lat else None
+        # histogram snapshots are additive across replicas (the router
+        # bucket-sums them); the derived slo percentiles ride alongside
+        # as plain (non-summable) gauges and are recomputed fleet-wide
+        # from the merged buckets by the router
+        out["histograms"] = {name: h.snapshot()
+                             for name, h in self.histograms.items()}
+        out["slo"] = {}
+        for name, h in self.histograms.items():
+            snap = out["histograms"][name]
+            for q, tag in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                out["slo"][f"{name}_{tag}"] = histogram_percentile(snap, q)
         fn = self.engine_stats_fn
         if fn is not None:
             try:
@@ -105,51 +151,6 @@ class ServerMetrics:
             except Exception:
                 pass
         return out
-
-
-def prometheus_exposition(snapshot: dict,
-                          prefix: str = "megatron_serve_") -> str:
-    """Render a ``ServerMetrics.snapshot()`` dict as Prometheus text
-    exposition format (0.0.4) so standard scrapers can hit ``/metrics``
-    without a JSON-translating sidecar.  Nested dicts (the ``engine``
-    block, its per-reason completion counts) flatten into underscore-
-    joined names; None values (e.g. empty-window percentiles) are
-    omitted; everything is exported as a gauge — the scraper cannot tell
-    a monotone counter from a level, and gauge is always safe."""
-    lines = []
-
-    def emit(name, value):
-        if isinstance(value, bool) or not isinstance(value, (int, float)):
-            return
-        name = "".join(c if (c.isalnum() and c.isascii()) or c == "_"
-                       else "_" for c in name)
-        if name and name[0].isdigit():
-            name = "_" + name
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {float(value):g}")
-
-    def walk(d, path):
-        for k, v in sorted(d.items()):
-            if isinstance(v, dict):
-                walk(v, f"{path}{k}_")
-            else:
-                emit(f"{path}{k}", v)
-
-    walk(snapshot, prefix)
-    return "\n".join(lines) + "\n"
-
-
-def _wants_prometheus(path: str, accept: str) -> bool:
-    """Content negotiation for /metrics: an explicit ?format=prometheus
-    query wins; otherwise an Accept header preferring text/plain (what
-    the Prometheus scraper sends) selects the text exposition."""
-    query = path.partition("?")[2]
-    for pair in query.split("&"):
-        if pair.partition("=")[::2] == ("format", "prometheus"):
-            return True
-    accept = accept.lower()
-    return ("text/plain" in accept or "openmetrics" in accept) \
-        and "application/json" not in accept
 
 
 def _count_tokens(body: dict) -> int:
@@ -264,7 +265,7 @@ class MegatronGenerate:
 
     # -- dispatch -------------------------------------------------------
 
-    def handle(self, payload: dict):
+    def handle(self, payload: dict, trace_id=None):
         try:
             err, knobs = self._parse(payload)
         except (TypeError, ValueError) as exc:
@@ -279,7 +280,7 @@ class MegatronGenerate:
                       and not knobs["logprobs"]
                       and knobs["tokens_to_generate"] > 0)
         if use_engine:
-            return self._handle_engine(knobs)
+            return self._handle_engine(knobs, trace_id=trace_id)
         return self._handle_legacy(knobs)
 
     def _handle_legacy(self, knobs: dict):
@@ -355,7 +356,8 @@ class MegatronGenerate:
             ban_pair=(ban_pairs[0] if ban_pairs else None),
         )
 
-    def _submit_engine(self, knobs: dict, stream: bool = False):
+    def _submit_engine(self, knobs: dict, stream: bool = False,
+                       trace_id=None):
         """Returns (None, requests) or ((code, body), None)."""
         from megatron_llm_tpu.serving.request import QueueFull
 
@@ -365,7 +367,8 @@ class MegatronGenerate:
             samplings = [self._sampling_params(knobs, i)
                          for i in range(len(token_lists))]
             reqs = self.engine.submit_many(token_lists, samplings,
-                                           stream=stream)
+                                           stream=stream,
+                                           trace_id=trace_id)
             return None, reqs
         except QueueFull as exc:
             # tell clients how backed up we are, not just "go away":
@@ -383,10 +386,10 @@ class MegatronGenerate:
         dl = getattr(self.engine.config, "default_deadline_secs", 0) or 0
         return dl + 60.0 if dl else 600.0
 
-    def _handle_engine(self, knobs: dict):
+    def _handle_engine(self, knobs: dict, trace_id=None):
         from megatron_llm_tpu.serving.request import EngineError
 
-        err, reqs = self._submit_engine(knobs)
+        err, reqs = self._submit_engine(knobs, trace_id=trace_id)
         if err is not None:
             return err
         texts, segments, tokens = [], [], []
@@ -407,7 +410,7 @@ class MegatronGenerate:
             segments.append([self.tokenizer.detokenize([t]) for t in row])
         return 200, {"text": texts, "segments": segments, "tokens": tokens}
 
-    def handle_stream(self, payload: dict):
+    def handle_stream(self, payload: dict, trace_id=None):
         """SSE path (``PUT /api/stream``): returns ``(code, body, None)``
         on rejection or ``(200, {}, events)`` where ``events`` yields one
         JSON-able dict per token and a final ``{"done": ...}`` record."""
@@ -431,7 +434,8 @@ class MegatronGenerate:
             return 400, {"message": "streaming requires "
                                     "tokens_to_generate > 0"}, None
         self._log(payload, knobs)
-        err, reqs = self._submit_engine(knobs, stream=True)
+        err, reqs = self._submit_engine(knobs, stream=True,
+                                        trace_id=trace_id)
         if err is not None:
             return err[0], err[1], None
         req = reqs[0]
@@ -467,17 +471,23 @@ class MegatronServer:
         self.metrics = ServerMetrics()
         if engine is not None:
             self.metrics.engine_stats_fn = engine.stats
+            # every retired request feeds the SLO histograms, whether it
+            # arrived over HTTP or was submitted in-process
+            engine.request_done_hook = self.metrics.observe_request_done
 
     def run(self, host: str = "0.0.0.0", port: int = 5000):
         generator = self.generator
         metrics = self.metrics
 
         class Handler(BaseHTTPRequestHandler):
-            def _send_json(self, code: int, body: dict):
+            def _send_json(self, code: int, body: dict,
+                           trace_id: str = None):
                 data = json.dumps(body).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                if trace_id:
+                    self.send_header(TRACE_HEADER, trace_id)
                 if code == 429:
                     self.send_header("Retry-After", str(max(int(
                         body.get("retry_after_secs", 1)), 1)))
@@ -488,6 +498,12 @@ class MegatronServer:
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n) or b"{}")
 
+            def _trace_id(self):
+                # the router minted one upstream; mint locally only for
+                # direct (router-less) traffic so every request is
+                # traceable either way
+                return self.headers.get(TRACE_HEADER) or new_trace_id()
+
             def do_PUT(self):
                 if self.path in ("/api/stream", "/generate/stream"):
                     self._do_stream()
@@ -496,35 +512,39 @@ class MegatronServer:
                     self.send_error(404)
                     return
                 t0 = time.perf_counter()
+                trace_id = self._trace_id()
                 try:
                     payload = self._read_payload()
                 except (ValueError, json.JSONDecodeError):
                     metrics.observe(time.perf_counter() - t0, 400)
                     self.send_error(400, "invalid JSON")
                     return
-                code, body = generator.handle(payload)
+                code, body = generator.handle(payload, trace_id=trace_id)
                 metrics.observe(time.perf_counter() - t0, code,
                                 tokens=(_count_tokens(body)
                                         if code == 200 else 0))
-                self._send_json(code, body)
+                self._send_json(code, body, trace_id=trace_id)
 
             def _do_stream(self):
                 t0 = time.perf_counter()
+                trace_id = self._trace_id()
                 try:
                     payload = self._read_payload()
                 except (ValueError, json.JSONDecodeError):
                     metrics.observe(time.perf_counter() - t0, 400)
                     self.send_error(400, "invalid JSON")
                     return
-                code, body, events = generator.handle_stream(payload)
+                code, body, events = generator.handle_stream(
+                    payload, trace_id=trace_id)
                 if events is None:
                     metrics.observe(time.perf_counter() - t0, code)
-                    self._send_json(code, body)
+                    self._send_json(code, body, trace_id=trace_id)
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                self.send_header(TRACE_HEADER, trace_id)
                 self.end_headers()
                 n_tokens = 0
                 try:

@@ -55,6 +55,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass
@@ -630,6 +631,39 @@ def dump_trace(reason: str = "") -> Optional[str]:
         return _ACTIVE.write_trace(reason=reason)
     except Exception:
         return None
+
+
+def new_trace_id() -> str:
+    """A fleet-unique request trace id (the ``X-Request-Trace`` value).
+    16 hex chars: short enough to read in logs, unique enough for any
+    realistic request volume.  The serving router mints one per inbound
+    request; replicas mint their own only for direct (router-less)
+    traffic."""
+    return uuid.uuid4().hex[:16]
+
+
+def start_trace_flusher(bundle: Tracing,
+                        interval_secs: float = 5.0) -> threading.Thread:
+    """Periodically write ``bundle``'s trace file from a daemon thread.
+
+    Long-lived serving processes never reach the trainer's clean
+    ``close()`` boundary — without a flusher the Chrome trace only
+    exists after graceful shutdown, which is exactly when you don't
+    need it.  The returned thread carries a ``stop`` Event; set it (and
+    optionally join) to stop flushing."""
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(interval_secs):
+            try:
+                bundle.write_trace(reason="periodic")
+            except Exception:
+                pass
+
+    t = threading.Thread(target=loop, name="trace-flusher", daemon=True)
+    t.stop = stop           # type: ignore[attr-defined]
+    t.start()
+    return t
 
 
 def build_tracing(args) -> Optional[Tracing]:

@@ -49,7 +49,21 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--paged_kernel", choices=["auto", "on", "off"],
                    default="auto")
+    p.add_argument("--structured_log_dir", default=None,
+                   help="stream request_done JSONL (trace-id e2e tests)")
+    p.add_argument("--trace_dir", default=None,
+                   help="write Chrome trace spans with trace ids")
     args = p.parse_args()
+    if args.structured_log_dir:
+        from megatron_llm_tpu import telemetry
+        telemetry.install_stream(
+            telemetry.TelemetryStream(args.structured_log_dir))
+    if args.trace_dir:
+        from megatron_llm_tpu import tracing
+        bundle = tracing.Tracing(tracer=tracing.SpanTracer(),
+                                 trace_dir=args.trace_dir)
+        tracing.install_tracing(bundle)
+        tracing.start_trace_flusher(bundle, interval_secs=0.5)
     if args.paged_kernel == "on":
         # no TPU in the test environment: run the Pallas kernel in
         # interpret mode so decode_kernel_available() is true on CPU

@@ -106,6 +106,9 @@ def test_run_bench_stream_measures_ttft(stub_server):
     assert r["ok"] == 4
     assert r["tokens_total"] == 4 * 3        # streamed tokens only
     assert r["ttft_mean_secs"] is not None and r["ttft_p50_secs"] >= 0
+    # TPOT is client-observed inter-token latency, stream-only
+    assert r["tpot_mean_secs"] is not None and r["tpot_mean_secs"] >= 0
+    assert r["tpot_p95_secs"] >= r["tpot_p50_secs"] >= 0
 
 
 def test_run_bench_poisson_arrivals(stub_server):

@@ -1,0 +1,237 @@
+"""tools/serve_report.py + tools/trace_report.py --merge smoke tests on
+synthetic fixtures — stdlib-only (no model, no jax), including subprocess
+CLI invocations so CI exercises exactly what an operator runs."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+import serve_report  # noqa: E402
+
+
+def _record(i, cached=0, ttft=0.2, e2e=1.0, tpot=0.02,
+            finish="length", trace=True):
+    return {
+        "schema": 5, "kind": "serve", "event": "request_done",
+        "time_unix": 1700000000 + i, "request": f"req-{i}",
+        "trace_id": f"{i:016x}" if trace else None,
+        "prompt_tokens": 16, "cached_prompt_tokens": cached,
+        "prefill_computed_tokens": 16 - cached, "new_tokens": 8,
+        "decode_tokens": 8, "finish_reason": finish,
+        "ttft_secs": ttft, "latency_secs": e2e, "tpot_secs": tpot,
+        "phases": {"queue_secs": 0.05, "admission_secs": 0.001,
+                   "prefill_secs": 0.1, "decode_secs": tpot * 8,
+                   "stream_write_secs": 0.002},
+        "paged_kernel": "xla", "queue_depth": 0, "blocks_free": 10,
+        "blocks_in_use": 2, "blocks_cached_reusable": 1,
+    }
+
+
+def _write_log(dirpath, records):
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, "telemetry.jsonl"), "w") as f:
+        f.write("not json\n")                    # parser must skip junk
+        f.write(json.dumps({"kind": "log", "iteration": 1}) + "\n")
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return dirpath
+
+
+@pytest.fixture
+def serve_log(tmp_path):
+    recs = [_record(i, cached=8 if i < 4 else 0,
+                    ttft=0.1 + 0.1 * i, e2e=0.5 + 0.25 * i,
+                    tpot=0.01 + 0.01 * i) for i in range(8)]
+    recs.append(_record(8, finish="deadline", trace=False))
+    return _write_log(str(tmp_path / "replica0"), recs)
+
+
+def test_analyze_summary_phases_and_cache_split(serve_log):
+    r = serve_report.analyze([serve_log], ttft_slo=0.45, tpot_slo=0.045)
+    assert r["summary"]["requests"] == 9
+    assert r["traced"] == 8
+    assert r["finish_reasons"] == {"length": 8, "deadline": 1}
+    # percentiles over raw values (nearest-rank, same as serve_bench)
+    e2e = sorted(0.5 + 0.25 * i for i in range(8)) + [1.0]
+    assert r["summary"]["e2e_p50_secs"] == serve_report._percentile(e2e, .5)
+    # phase shares computed against mean e2e
+    assert r["phases"]["prefill_secs"]["mean_secs"] == pytest.approx(0.1)
+    assert 0 < r["phases"]["prefill_secs"]["share"] < 1
+    assert r["phases"]["unattributed_secs"] >= 0
+    # cache strata: i<4 carried cached pages
+    assert r["by_cache"]["cache_hit"]["requests"] == 4
+    assert r["by_cache"]["cache_miss"]["requests"] == 5
+    assert r["by_cache"]["cache_hit"]["e2e_mean_secs"] < \
+        r["by_cache"]["cache_miss"]["e2e_mean_secs"]
+    # SLO attainment: ttft <= 0.45 -> i in 0..3 (0.1..0.4) plus the
+    # deadline record (0.2) = 5 of 9; tpot <= 0.045 -> i in 0..3 + 0.02
+    assert r["slo"]["ttft_attained"] == pytest.approx(5 / 9)
+    assert r["slo"]["joint_attained"] == pytest.approx(5 / 9)
+
+
+def test_analyze_multi_log_per_replica(tmp_path):
+    a = _write_log(str(tmp_path / "ra"),
+                   [_record(i, e2e=0.5) for i in range(3)])
+    b = _write_log(str(tmp_path / "rb"),
+                   [_record(i, e2e=2.0) for i in range(3)])
+    r = serve_report.analyze([a, b])
+    assert r["summary"]["requests"] == 6
+    assert set(r["replicas"]) == {a, b}
+    assert r["replicas"][a]["e2e_mean_secs"] == pytest.approx(0.5)
+    assert r["replicas"][b]["e2e_mean_secs"] == pytest.approx(2.0)
+
+
+def test_slo_counts_unmeasured_dimension_as_met(tmp_path):
+    rec = _record(0)
+    rec["tpot_secs"] = None                      # 1-token answer
+    log = _write_log(str(tmp_path / "r"), [rec])
+    r = serve_report.analyze([log], ttft_slo=10.0, tpot_slo=1e-9)
+    assert r["slo"]["tpot_attained"] == 1.0
+
+
+def test_cli_table_json_and_empty_exit_codes(serve_log, tmp_path):
+    env = dict(os.environ)
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "serve_report.py"),
+         serve_log, "--ttft_slo", "0.45"],
+        capture_output=True, text=True, env=env, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
+    assert "phase breakdown" in out.stdout
+    assert "SLO attainment" in out.stdout
+    assert "cache_hit" in out.stdout
+
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "serve_report.py"),
+         serve_log, "--json"],
+        capture_output=True, text=True, env=env, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)["summary"]["requests"] == 9
+
+    empty = _write_log(str(tmp_path / "empty"), [])
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "serve_report.py"), empty],
+        capture_output=True, text=True, env=env, cwd=str(ROOT))
+    assert out.returncode == 2
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "serve_report.py"),
+         str(tmp_path / "missing")],
+        capture_output=True, text=True, env=env, cwd=str(ROOT))
+    assert out.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# trace_report.py --merge on synthetic router + replica traces
+# ---------------------------------------------------------------------------
+
+TID = "cafe0123cafe0123"
+
+
+def _router_trace():
+    return {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+             "args": {"name": "host0"}},
+            {"ph": "X", "name": "route_request", "cat": "serve",
+             "ts": 0.0, "dur": 500_000.0, "pid": 0, "tid": 0,
+             "args": {"trace": TID, "backend": "127.0.0.1:5000",
+                      "attempts": 1, "status": 200}},
+        ],
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_start_unix": 1000.0},
+    }
+
+
+def _replica_trace():
+    return {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+             "args": {"name": "host0"}},
+            {"ph": "X", "name": "queue_wait", "cat": "serve",
+             "ts": 0.0, "dur": 30_000.0, "pid": 0, "tid": 0,
+             "args": {"trace": TID, "request": "r1"}},
+            {"ph": "X", "name": "prefill_chunk", "cat": "serve",
+             "ts": 40_000.0, "dur": 120_000.0, "pid": 0, "tid": 0,
+             "args": {"trace": TID, "request": "r1", "tokens": 16}},
+            {"ph": "X", "name": "decode_step", "cat": "serve",
+             "ts": 200_000.0, "dur": 50_000.0, "pid": 0, "tid": 0,
+             "args": {"traces": [TID, "ffff000011112222"]}},
+        ],
+        "displayTimeUnit": "ms",
+        # the replica's clock started 0.1s after the router's
+        "otherData": {"trace_start_unix": 1000.1},
+    }
+
+
+@pytest.fixture
+def trace_files(tmp_path):
+    router = tmp_path / "router_trace.json"
+    replica = tmp_path / "replica_trace.json"
+    router.write_text(json.dumps(_router_trace()))
+    replica.write_text(json.dumps(_replica_trace()))
+    return str(router), str(replica)
+
+
+def test_merge_cli_stitches_one_timeline(trace_files, tmp_path):
+    """Acceptance: one trace id threads router -> replica, and --merge
+    renders both processes' spans on a single timeline."""
+    router, replica = trace_files
+    out_path = str(tmp_path / "merged.json")
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "trace_report.py"),
+         router, replica, "--merge", "--out", out_path,
+         "--trace", TID],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
+    assert "merged 2 traces" in out.stdout
+    assert TID in out.stdout                     # the request timeline
+
+    merged = json.loads(Path(out_path).read_text())
+    evs = merged["traceEvents"]
+    spans = [e for e in evs if e["ph"] != "M"]
+    # both source processes present, distinct pids
+    assert {e["pid"] for e in spans} == {0, 1}
+    names = {e["name"]: e for e in spans}
+    # clock alignment: the replica file's 0.1s unix skew became a
+    # +100_000us shift, so queue_wait starts inside route_request
+    assert names["queue_wait"]["ts"] == pytest.approx(100_000.0)
+    assert names["route_request"]["ts"] == pytest.approx(0.0)
+    # per-file process_name metadata labels both sides
+    labels = [e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"]
+    assert any("router_trace" in l for l in labels)
+    assert any("replica_trace" in l for l in labels)
+    # the one trace id appears on spans from BOTH processes
+    tagged_pids = {e["pid"] for e in spans
+                   if e.get("args", {}).get("trace") == TID
+                   or TID in (e.get("args", {}).get("traces") or ())}
+    assert tagged_pids == {0, 1}
+
+
+def test_merge_requires_flag_for_multiple_inputs(trace_files):
+    router, replica = trace_files
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "trace_report.py"),
+         router, replica],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert out.returncode == 2
+    assert "--merge" in out.stderr
+
+
+def test_merge_json_timeline_output(trace_files):
+    router, replica = trace_files
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "trace_report.py"),
+         router, replica, "--merge", "--trace", TID, "--json"],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)
+    assert [r["name"] for r in rows] == \
+        ["route_request", "queue_wait", "prefill_chunk", "decode_step"]
+    assert rows[0]["at_secs"] == pytest.approx(0.0)
+    assert rows[1]["at_secs"] == pytest.approx(0.1)

@@ -46,11 +46,14 @@ class _Stub:
     /metrics — enough surface for the router."""
 
     def __init__(self, name: str, sleep: float = 0.0,
-                 throttle_body=None, serial: bool = False):
+                 throttle_body=None, serial: bool = False,
+                 metrics_extra=None):
         self.name = name
         self.sleep = sleep
         self.throttle_body = throttle_body
+        self.metrics_extra = metrics_extra or {}
         self.hits = []
+        self.trace_headers = []
         self.healthy = True
         lock = threading.Lock()
         stub = self
@@ -70,6 +73,8 @@ class _Stub:
                 n = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(n) or b"{}")
                 stub.hits.append(payload)
+                stub.trace_headers.append(
+                    self.headers.get("X-Request-Trace"))
                 if stub.throttle_body is not None:
                     self._json(429, stub.throttle_body)
                     return
@@ -98,10 +103,14 @@ class _Stub:
                     self._json(200 if stub.healthy else 503,
                                {"status": "ok"})
                 elif self.path.startswith("/metrics"):
-                    self._json(200, {
-                        "requests": len(stub.hits),
-                        "engine": {"tokens_generated": 10,
-                                   "queue_depth": 1}})
+                    engine = {"tokens_generated": 10, "queue_depth": 1}
+                    body = {"requests": len(stub.hits), "engine": engine}
+                    for k, v in stub.metrics_extra.items():
+                        if k == "engine":
+                            engine.update(v)
+                        else:
+                            body[k] = v
+                    self._json(200, body)
                 else:
                     self.send_error(404)
 
@@ -333,6 +342,133 @@ def test_linear_scaling_over_serial_stubs(stubs):
     t_two = run_fleet([p.url for p in pair])
     assert t_one / t_two >= 1.3, \
         f"no scaling: 1 replica {t_one:.3f}s vs 2 replicas {t_two:.3f}s"
+
+
+# ---------------------------------------------------------------------------
+# request-lifecycle tracing + fleet SLO histograms
+# ---------------------------------------------------------------------------
+
+class _RecordingTracer:
+    """Duck-typed span recorder standing in for tracing.SpanTracer (the
+    router takes anything with completed()/instant())."""
+
+    def __init__(self):
+        self.events = []
+
+    def completed(self, name, category, start, dur_secs, **attrs):
+        self.events.append(("X", name, attrs))
+
+    def instant(self, name, category="other", **attrs):
+        self.events.append(("i", name, attrs))
+
+
+def test_trace_header_minted_and_propagated(stubs):
+    a = stubs("a")
+    router = ReplicaRouter([a.url], health_interval_secs=999)
+    router.dispatch("PUT", "/api", _payload("1 2"))
+    minted = a.trace_headers[0]
+    assert minted and len(minted) == 16
+    int(minted, 16)                            # hex-parseable
+    # a caller-supplied id is forwarded verbatim, never re-minted
+    router.dispatch("PUT", "/api", _payload("1 2"), trace_id="cafe" * 4)
+    assert a.trace_headers[1] == "cafe" * 4
+
+
+def test_router_server_echoes_trace_header(router_server):
+    url, _, (a, b) = router_server
+    explicit = "deadbeef00112233"
+    req = urllib.request.Request(
+        url + "/api", data=_payload("1 2 3"), method="PUT",
+        headers={"X-Request-Trace": explicit})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers["X-Request-Trace"] == explicit
+    assert (a.trace_headers + b.trace_headers).count(explicit) == 1
+    # no client header: the router mints one and reports it back
+    req = urllib.request.Request(url + "/api", data=_payload("9 8 7"),
+                                 method="PUT")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        minted = resp.headers["X-Request-Trace"]
+    assert minted and len(minted) == 16
+    assert minted in a.trace_headers + b.trace_headers
+
+
+def test_trace_id_survives_failover_with_spans(stubs):
+    """Acceptance: a request requeued onto another replica after a
+    transport failure keeps ONE trace id fleet-wide, and the router's
+    spans record both the failover and the eventual route."""
+    live = stubs("live")
+    dead_url = f"127.0.0.1:{_free_port()}"
+    tracer = _RecordingTracer()
+    router = ReplicaRouter([dead_url, live.url], fail_threshold=2,
+                           cooldown_secs=30.0, health_interval_secs=999,
+                           tracer=tracer)
+    tid = "feedface01234567"
+    status, _, _ = router.dispatch("PUT", "/api", _payload("1 2"),
+                                   trace_id=tid)
+    assert status == 200
+    assert router.failovers_total >= 1
+    assert live.trace_headers[-1] == tid       # replay kept its identity
+    fo = next(attrs for ph, name, attrs in tracer.events
+              if name == "failover")
+    assert fo["trace"] == tid
+    rr = next(attrs for ph, name, attrs in tracer.events
+              if name == "route_request")
+    assert rr["trace"] == tid and rr["attempts"] == 2
+
+
+def test_stream_failover_before_first_byte_keeps_trace_id(stubs):
+    live = stubs("live")
+    dead_url = f"127.0.0.1:{_free_port()}"
+    tracer = _RecordingTracer()
+    router = ReplicaRouter([dead_url, live.url], fail_threshold=2,
+                           cooldown_secs=30.0, health_interval_secs=999,
+                           tracer=tracer)
+    tid = "beefbeefbeefbeef"
+    status, headers, body_iter = router.dispatch_stream(
+        "PUT", "/api/stream", _payload("5 6"), trace_id=tid)
+    assert status == 200
+    b"".join(body_iter)                        # drain -> span closes
+    assert live.trace_headers[-1] == tid
+    rs = next(attrs for ph, name, attrs in tracer.events
+              if name == "route_stream")
+    assert rs["trace"] == tid and rs["attempts"] == 2
+
+
+def test_aggregated_metrics_passes_through_non_numeric(stubs):
+    """Bugfix satellite: replica fields that cannot be summed (e.g. one
+    replica on the Pallas kernel, one on the XLA fallback) surface as a
+    per-replica map instead of being silently dropped."""
+    a = stubs("a", metrics_extra={"engine": {"paged_kernel": "pallas"}})
+    b = stubs("b", metrics_extra={"engine": {"paged_kernel": "xla"}})
+    router = ReplicaRouter([a.url, b.url], health_interval_secs=999)
+    m = router.aggregated_metrics()
+    assert m["aggregate"]["per_replica"]["engine.paged_kernel"] == \
+        {"backend_0": "pallas", "backend_1": "xla"}
+    # numeric fleet sums are unaffected
+    assert m["aggregate"]["engine"]["tokens_generated"] == 20
+
+
+def test_fleet_histogram_merge_and_slo_recompute(stubs):
+    """Histogram buckets sum across replicas (bucket counts are
+    additive); fleet percentiles are recomputed from the merged buckets
+    — never summed (a p95 of 0.99s from 0.09 + 0.9 would be nonsense)."""
+    h_a = {"buckets": {"0.1": 4, "1": 0, "+Inf": 0},
+           "count": 4, "sum": 0.2}
+    h_b = {"buckets": {"0.1": 0, "1": 4, "+Inf": 0},
+           "count": 4, "sum": 2.0}
+    a = stubs("a", metrics_extra={"histograms": {"ttft_secs": h_a},
+                                  "slo": {"ttft_secs_p95": 0.09}})
+    b = stubs("b", metrics_extra={"histograms": {"ttft_secs": h_b},
+                                  "slo": {"ttft_secs_p95": 0.9}})
+    router = ReplicaRouter([a.url, b.url], health_interval_secs=999)
+    m = router.aggregated_metrics()
+    merged = m["aggregate"]["histograms"]["ttft_secs"]
+    assert merged["buckets"] == {"0.1": 4, "1": 4, "+Inf": 0}
+    assert merged["count"] == 8
+    from megatron_llm_tpu.telemetry import histogram_percentile
+    p95 = m["aggregate"]["slo"]["ttft_secs_p95"]
+    assert p95 == pytest.approx(histogram_percentile(merged, 0.95))
+    assert 0.1 < p95 <= 1.0                    # not 0.99 (the naive sum)
 
 
 # ---------------------------------------------------------------------------

@@ -262,15 +262,25 @@ def test_engine_admission_control_queue_full(model_and_params):
     eng.stop()
 
 
-def test_engine_zero_recompiles_after_warmup(engine, model_and_params):
+def test_engine_zero_recompiles_after_warmup(engine, model_and_params,
+                                             tmp_path):
     """Acceptance: after warmup, arbitrary traffic (ragged prompt
     lengths, mixed sampling params, churn through slots) triggers ZERO
     XLA compiles — the continuous-batching property the fixed-shape
-    step design exists for."""
+    step design exists for.  The full observability stack (JSONL stream,
+    per-request phase attribution, SLO histograms) runs during the
+    traffic: it is host-side-only bookkeeping and must stay free."""
+    from megatron_llm_tpu import telemetry
+    from megatron_llm_tpu.text_generation_server import ServerMetrics
+
     tracer = tracing.SpanTracer()
     det = tracing.RecompileDetector(tracer)
     tr = tracing.Tracing(tracer=tracer, recompile=det)
     tracing.install_tracing(tr)
+    stream = telemetry.TelemetryStream(str(tmp_path))
+    telemetry.install_stream(stream)
+    metrics = ServerMetrics()
+    engine.request_done_hook = metrics.observe_request_done
     try:
         det.mark_steady()
         reqs = []
@@ -281,13 +291,79 @@ def test_engine_zero_recompiles_after_warmup(engine, model_and_params):
                 top_k=0 if i % 3 == 0 else 5 + i,
                 top_p=0.0 if i % 2 == 0 else 0.9,
                 seed=i, eod_id=63)
-            reqs.append(engine.submit(list(range(1, 2 + (i % 7))), sp))
+            reqs.append(engine.submit(list(range(1, 2 + (i % 7))), sp,
+                                      trace_id=f"{i:016x}"))
         for r in reqs:
             r.result(timeout=180)
         assert det.recompiles == 0, \
             f"{det.recompiles} recompiles after warmup: {list(det.events)}"
+        # the observability stack saw every request while staying free
+        # (results signal before the engine thread finishes retiring the
+        # request, so give the last hook call a moment to land)
+        for _ in range(100):
+            if metrics.histograms["e2e_secs"].count == 10:
+                break
+            time.sleep(0.05)
+        assert metrics.histograms["e2e_secs"].count == 10
+        assert metrics.histograms["ttft_secs"].count == 10
+        snap = metrics.snapshot()
+        assert snap["slo"]["e2e_secs_p95"] > 0
     finally:
+        engine.request_done_hook = None
         tracing.install_tracing(None)
+        telemetry.install_stream(None)
+        stream.close()
+    import json as _json
+    records = [_json.loads(line) for line in
+               (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    done = [r for r in records if r.get("event") == "request_done"]
+    assert len(done) == 10
+    assert {r["trace_id"] for r in done} == {f"{i:016x}"
+                                             for i in range(10)}
+    for r in done:
+        assert r["phases"]["prefill_secs"] > 0
+
+
+def test_request_done_schema_golden(engine, tmp_path):
+    """Golden record for the serve JSONL contract: bumping the schema or
+    the request_done shape must be a conscious act (update this test AND
+    the schema history comment in telemetry.py)."""
+    from megatron_llm_tpu import telemetry
+
+    assert telemetry.TELEMETRY_SCHEMA_VERSION == 5
+    captured = []
+    engine.request_done_hook = captured.append
+    stream = telemetry.TelemetryStream(str(tmp_path))
+    telemetry.install_stream(stream)
+    try:
+        engine.submit([7, 8, 9], SamplingParams(max_new_tokens=4, **GREEDY),
+                      trace_id="aaaabbbbccccdddd").result(timeout=120)
+        for _ in range(100):        # retire (and the hook) lands async
+            if captured:
+                break
+            time.sleep(0.05)
+    finally:
+        engine.request_done_hook = None
+        telemetry.install_stream(None)
+        stream.close()
+    assert len(captured) == 1
+    rec = captured[0]
+    assert frozenset(rec) == frozenset((
+        "kind", "event", "request", "trace_id", "prompt_tokens",
+        "cached_prompt_tokens", "prefill_computed_tokens", "new_tokens",
+        "decode_tokens", "finish_reason", "ttft_secs", "latency_secs",
+        "tpot_secs", "phases", "paged_kernel", "queue_depth",
+        "blocks_free", "blocks_in_use", "blocks_cached_reusable"))
+    assert frozenset(rec["phases"]) == frozenset((
+        "queue_secs", "admission_secs", "prefill_secs", "decode_secs",
+        "stream_write_secs"))
+    # the streamed form gains exactly the envelope stamps
+    import json as _json
+    line = [_json.loads(ln) for ln in
+            (tmp_path / "telemetry.jsonl").read_text().splitlines()
+            if "request_done" in ln][0]
+    assert frozenset(line) == frozenset(rec) | {"schema", "time_unix"}
+    assert line["schema"] == 5
 
 
 def test_engine_int8_kv_cache_serves(model_and_params):

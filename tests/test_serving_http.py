@@ -316,6 +316,193 @@ def test_metrics_content_negotiation(served):
         assert b"megatron_serve_requests" in resp.read()
 
 
+# ---------------------------------------------------------------------------
+# request-lifecycle tracing + SLO histograms
+# ---------------------------------------------------------------------------
+
+def _put_raw(url, payload, path="/api", headers=None):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(), method="PUT",
+        headers=headers or {})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def test_trace_header_minted_and_echoed(served):
+    _, _, url = served
+    payload = {"prompts": ["5 6"], "tokens_to_generate": 2,
+               "temperature": 0.0, "no_log": True}
+    _, headers, _ = _put_raw(url, payload)
+    minted = headers.get("X-Request-Trace")
+    assert minted and len(minted) == 16
+    int(minted, 16)                             # hex-parseable
+    _, headers, _ = _put_raw(url, payload,
+                             headers={"X-Request-Trace": "abcd" * 4})
+    assert headers.get("X-Request-Trace") == "abcd" * 4
+
+
+def test_request_done_record_carries_trace_and_phases(served, tmp_path):
+    """The replica's request_done JSONL record carries the router-visible
+    trace id plus the full phase attribution of the request's wall-clock
+    (queue wait, admission, prefill compute, amortized decode, stream
+    write) and a true engine-side TPOT."""
+    from megatron_llm_tpu import telemetry
+
+    _, _, url = served
+    stream = telemetry.TelemetryStream(str(tmp_path))
+    telemetry.install_stream(stream)
+    tid = "0123456789abcdef"
+    done = []
+    try:
+        _put_raw(url, {"prompts": ["5 6 7"], "tokens_to_generate": 6,
+                       "temperature": 0.0, "no_log": True},
+                 headers={"X-Request-Trace": tid})
+        # the result signals before the engine thread retires the
+        # request, so poll for the JSONL record before tearing down
+        path = tmp_path / "telemetry.jsonl"
+        for _ in range(100):
+            if path.exists():
+                records = [json.loads(line) for line
+                           in path.read_text().splitlines()]
+                done = [r for r in records
+                        if r.get("event") == "request_done"
+                        and r.get("trace_id") == tid]
+                if done:
+                    break
+            time.sleep(0.05)
+    finally:
+        telemetry.install_stream(None)
+        stream.close()
+    assert len(done) == 1
+    rec = done[0]
+    assert rec["schema"] == telemetry.TELEMETRY_SCHEMA_VERSION
+    assert rec["prompt_tokens"] == 3 and rec["new_tokens"] >= 1
+    assert rec["prefill_computed_tokens"] == \
+        rec["prompt_tokens"] - rec["cached_prompt_tokens"]
+    phases = rec["phases"]
+    assert set(phases) == {"queue_secs", "admission_secs", "prefill_secs",
+                           "decode_secs", "stream_write_secs"}
+    assert phases["queue_secs"] >= 0 and phases["prefill_secs"] > 0
+    if rec["decode_tokens"] > 0:
+        assert rec["tpot_secs"] > 0
+        assert rec["tpot_secs"] * rec["decode_tokens"] == pytest.approx(
+            phases["decode_secs"], rel=1e-3)
+
+
+def test_spans_carry_trace_id(served):
+    """Every engine span of a request carries its trace id, so the
+    replica's Chrome trace can be stitched to the router's by id."""
+    from megatron_llm_tpu import tracing
+
+    _, _, url = served
+    tracer = tracing.SpanTracer()
+    tracing.install_tracing(tracing.Tracing(tracer=tracer))
+    tid = "fedcba9876543210"
+    try:
+        _put_raw(url, {"prompts": ["6 7 8"], "tokens_to_generate": 6,
+                       "temperature": 0.0, "no_log": True},
+                 headers={"X-Request-Trace": tid})
+        for _ in range(100):        # the final span lands at retire
+            if any(ev["name"] == "request"
+                   and ev["args"].get("trace") == tid
+                   for ev in list(tracer._events)):
+                break
+            time.sleep(0.05)
+    finally:
+        tracing.install_tracing(None)
+    events = list(tracer._events)
+    by_name = {}
+    for ev in events:
+        by_name.setdefault(ev["name"], []).append(ev)
+    for name in ("queue_wait", "prefill_chunk", "request"):
+        tagged = [ev for ev in by_name.get(name, ())
+                  if ev["args"].get("trace") == tid]
+        assert tagged, f"no {name} span tagged with the trace id"
+    # decode steps are batched: they carry the id in a `traces` list
+    decode = [ev for ev in by_name.get("decode_step", ())
+              if tid in (ev["args"].get("traces") or ())]
+    assert decode, "no decode_step span listing the trace id"
+
+
+def test_metrics_histograms_and_slo(served):
+    _, _, url = served
+    _put(url, {"prompts": ["3 4 5"], "tokens_to_generate": 4,
+               "temperature": 0.0, "no_log": True})
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+        m = json.loads(resp.read())
+    for name in ("ttft_secs", "tpot_secs", "e2e_secs", "queue_wait_secs"):
+        h = m["histograms"][name]
+        assert set(h) == {"buckets", "count", "sum"}
+        assert h["count"] >= 1 and "+Inf" in h["buckets"]
+        assert sum(h["buckets"].values()) == h["count"]
+    assert m["slo"]["e2e_secs_p95"] > 0
+    assert m["slo"]["ttft_secs_p50"] is not None
+    with urllib.request.urlopen(url + "/metrics?format=prometheus",
+                                timeout=30) as resp:
+        body = resp.read().decode()
+    assert "# TYPE megatron_serve_histograms_ttft_secs histogram" in body
+    assert 'megatron_serve_histograms_ttft_secs_bucket{le="+Inf"}' in body
+    assert "megatron_serve_histograms_e2e_secs_count" in body
+    assert "megatron_serve_histograms_e2e_secs_sum" in body
+
+
+def test_serve_report_matches_serve_bench(served, tmp_path):
+    """Acceptance: on one mixed cached/uncached workload, the offline
+    serve_report reproduces serve_bench's e2e p95 from the same run's
+    JSONL (engine-side timing excludes HTTP overhead, hence the
+    tolerance), with a phase breakdown and SLO attainment."""
+    import sys as _sys
+    from pathlib import Path as _Path
+
+    _sys.path.insert(0, str(_Path(__file__).resolve().parent.parent
+                            / "tools"))
+    import serve_bench
+    import serve_report
+    from megatron_llm_tpu import telemetry
+
+    _, _, url = served
+    stream = telemetry.TelemetryStream(str(tmp_path))
+    telemetry.install_stream(stream)
+    try:
+        bench = serve_bench.run_bench(
+            url, clients=4, requests=12, tokens=8, prefix_tokens=12,
+            shared_prefix_frac=0.5, seed=3)
+        path = tmp_path / "telemetry.jsonl"
+        for _ in range(100):        # wait for the last retire to land
+            if path.exists() and sum(
+                    1 for line in path.read_text().splitlines()
+                    if "request_done" in line) >= 12:
+                break
+            time.sleep(0.05)
+    finally:
+        telemetry.install_stream(None)
+        stream.close()
+    assert bench["errors"] == 0
+
+    report = serve_report.analyze([str(tmp_path)], ttft_slo=1000.0,
+                                  tpot_slo=1000.0)
+    assert report["summary"]["requests"] == 12
+    assert report["traced"] == 12              # every request got an id
+    # mixed workload: the shared 12-token header fills a block, so
+    # repeats hit the prefix cache while unique-header requests miss
+    assert report["by_cache"]["cache_hit"]["requests"] >= 1
+    assert report["by_cache"]["cache_miss"]["requests"] >= 1
+    # e2e p95 agreement within tolerance
+    bench_p95 = bench["latency_p95_secs"]
+    report_p95 = report["summary"]["e2e_p95_secs"]
+    assert report_p95 is not None
+    assert abs(report_p95 - bench_p95) <= max(0.5 * bench_p95, 0.3), \
+        f"serve_report p95 {report_p95} vs serve_bench p95 {bench_p95}"
+    # phase breakdown is populated
+    assert report["phases"]["prefill_secs"]["mean_secs"] > 0
+    assert report["phases"]["decode_secs"]["mean_secs"] > 0
+    # unreachable SLOs attain 100%, impossible ones 0%
+    assert report["slo"]["joint_attained"] == 1.0
+    strict = serve_report.analyze([str(tmp_path)], ttft_slo=0.0,
+                                  tpot_slo=0.0)
+    assert strict["slo"]["ttft_attained"] == 0.0
+
+
 def test_deadline_maps_to_503(model_and_params):
     """A request whose deadline expires mid-flight is a 503, not a 200
     with silently truncated output."""

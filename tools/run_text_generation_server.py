@@ -35,6 +35,17 @@ def extra_args(parser):
 
 def main():
     args = initialize_megatron(extra_args_provider=extra_args)
+    # serving observability: --structured_log_dir streams request_done
+    # JSONL (analyze offline with tools/serve_report.py), --trace_dir
+    # records Chrome spans with per-request trace ids (merge with the
+    # router's file via tools/trace_report.py --merge)
+    from megatron_llm_tpu import telemetry, tracing
+    if args.structured_log_dir:
+        telemetry.install_stream(
+            telemetry.TelemetryStream(args.structured_log_dir))
+    trace_bundle = tracing.build_tracing(args)
+    if trace_bundle is not None:
+        tracing.start_trace_flusher(trace_bundle)
     # same per-model presets and derivations as finetune.py: the CLI is
     # self-sufficient (--model_name=llama2 implies rotary/swiglu/
     # rmsnorm/no-bias; gemma gets its sqrt(hidden) embedding scale)

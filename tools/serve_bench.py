@@ -55,7 +55,8 @@ JSON_SCHEMA_KEYS = (
     "wall_secs", "requests_per_sec", "tokens_total", "tokens_per_sec",
     "latency_mean_secs", "latency_p50_secs", "latency_p95_secs",
     "latency_p99_secs", "ttft_mean_secs", "ttft_p50_secs",
-    "ttft_p95_secs", "stream", "rate", "prefix_tokens",
+    "ttft_p95_secs", "tpot_mean_secs", "tpot_p50_secs",
+    "tpot_p95_secs", "stream", "rate", "prefix_tokens",
     "shared_prefix_frac", "prefill_tokens_submitted",
     "prefill_tokens_computed", "prefill_tokens_cached",
     "prefill_computed_frac", "prefix_cache_hits", "prefix_cache_misses",
@@ -81,13 +82,17 @@ def _fetch_metrics(base_url: str, timeout: float = 10.0):
 
 def _one_request(base_url: str, payload: dict, stream: bool,
                  timeout: float) -> dict:
-    """Returns {ok, status, secs, ttft_secs, tokens, error?}."""
+    """Returns {ok, status, secs, ttft_secs, tpot_secs, tokens, error?}.
+    TPOT (time per output token) is client-observed inter-token latency
+    — (last token - first token) / (tokens - 1) — measurable only on the
+    streaming path, where tokens arrive one SSE event at a time."""
     path = "/api/stream" if stream else "/api"
     req = urllib.request.Request(
         base_url + path, data=json.dumps(payload).encode(),
         headers={"Content-Type": "application/json"}, method="PUT")
     t0 = time.perf_counter()
     ttft = None
+    t_last = None
     tokens = 0
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -98,8 +103,9 @@ def _one_request(base_url: str, payload: dict, stream: bool,
                         continue
                     ev = json.loads(line[len(b"data: "):])
                     if "token" in ev:
+                        t_last = time.perf_counter()
                         if ttft is None:
-                            ttft = time.perf_counter() - t0
+                            ttft = t_last - t0
                         tokens += 1
                     if ev.get("done"):
                         break
@@ -110,18 +116,24 @@ def _one_request(base_url: str, payload: dict, stream: bool,
                 if isinstance(toks, list):
                     tokens = sum(len(t) for t in toks
                                  if isinstance(t, list))
+            tpot = None
+            if stream and tokens > 1 and ttft is not None:
+                tpot = (t_last - (t0 + ttft)) / (tokens - 1)
             return {"ok": True, "status": 200,
                     "secs": time.perf_counter() - t0,
-                    "ttft_secs": ttft, "tokens": tokens}
+                    "ttft_secs": ttft, "tpot_secs": tpot,
+                    "tokens": tokens}
     except urllib.error.HTTPError as e:
         e.read()
         return {"ok": False, "status": e.code,
                 "secs": time.perf_counter() - t0, "ttft_secs": None,
-                "tokens": 0, "retry_after": e.headers.get("Retry-After")}
+                "tpot_secs": None, "tokens": 0,
+                "retry_after": e.headers.get("Retry-After")}
     except Exception as e:  # noqa: BLE001 - a bench must not die mid-run
         return {"ok": False, "status": 0,
                 "secs": time.perf_counter() - t0, "ttft_secs": None,
-                "tokens": 0, "error": f"{type(e).__name__}: {e}"}
+                "tpot_secs": None, "tokens": 0,
+                "error": f"{type(e).__name__}: {e}"}
 
 
 def build_prompt(ticket: int, prompt: str, prefix_tokens: int,
@@ -202,6 +214,7 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
     ok = [r for r in results if r["ok"]]
     lat = [r["secs"] for r in ok]
     ttft = [r["ttft_secs"] for r in ok if r["ttft_secs"] is not None]
+    tpot = [r["tpot_secs"] for r in ok if r.get("tpot_secs") is not None]
     total_tokens = sum(r["tokens"] for r in ok)
     by_status = {}
     for r in results:
@@ -224,6 +237,10 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         "ttft_mean_secs": sum(ttft) / len(ttft) if ttft else None,
         "ttft_p50_secs": _percentile(ttft, 0.50),
         "ttft_p95_secs": _percentile(ttft, 0.95),
+        # client-observed per-output-token decode latency (--stream only)
+        "tpot_mean_secs": sum(tpot) / len(tpot) if tpot else None,
+        "tpot_p50_secs": _percentile(tpot, 0.50),
+        "tpot_p95_secs": _percentile(tpot, 0.95),
         "stream": stream,
         "rate": rate,
         "prefix_tokens": prefix_tokens,
@@ -311,6 +328,8 @@ def print_table(r: dict) -> None:
         ("ttft mean", _fmt(r["ttft_mean_secs"], "s")),
         ("ttft p50", _fmt(r["ttft_p50_secs"], "s")),
         ("ttft p95", _fmt(r["ttft_p95_secs"], "s")),
+        ("tpot p50", _fmt(r["tpot_p50_secs"], "s")),
+        ("tpot p95", _fmt(r["tpot_p95_secs"], "s")),
     ]
     eng = r.get("server_engine")
     if eng:

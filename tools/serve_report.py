@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Offline analyzer for serving telemetry JSONL (request_done records).
+
+Reads the ``kind: "serve", event: "request_done"`` records a serving
+replica writes into its ``--structured_log_dir`` (telemetry schema >= 5:
+trace_id, per-request phase attribution, tpot_secs) and prints:
+
+* latency percentiles — e2e / TTFT / TPOT p50/p95/p99 over every
+  finished request (the offline twin of the live ``/metrics``
+  histograms, but exact: computed from raw values, not buckets)
+* a phase breakdown — where request wall-clock went: queue wait,
+  admission, prefill compute, amortized decode, stream write; mean
+  seconds per request and share of mean e2e latency
+* SLO attainment — the fraction of requests meeting configurable TTFT
+  (``--ttft_slo``) and TPOT (``--tpot_slo``) targets, individually and
+  jointly (the Gemma-on-TPU serving framing: "X% of requests within
+  TTFT <= a and TPOT <= b")
+* cache-hit stratification — the same latency table split by whether
+  the request adopted prefix-cache pages (``cached_prompt_tokens > 0``),
+  quantifying what the PR 6 prefix cache is worth end-to-end
+* per-replica comparison — pass several JSONL files/dirs (one per
+  replica) and each gets its own column plus the fleet total
+
+Pure stdlib — no jax import, runs anywhere the files do.
+
+Usage:
+    python tools/serve_report.py LOG_DIR_OR_JSONL [more...] \\
+        [--ttft_slo SECS] [--tpot_slo SECS] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+STREAM_FILENAME = "telemetry.jsonl"     # mirrors telemetry.STREAM_FILENAME
+
+PHASE_KEYS = ("queue_secs", "admission_secs", "prefill_secs",
+              "decode_secs", "stream_write_secs")
+
+
+def load_records(path: str) -> List[Dict]:
+    """request_done records from a telemetry.jsonl (or its dir)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, STREAM_FILENAME)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no serve log at {path}")
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "serve" \
+                    and rec.get("event") == "request_done":
+                out.append(rec)
+    return out
+
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    # nearest-rank with rounding — same estimator as tools/serve_bench.py
+    # so the two tools agree on identical samples
+    if not values:
+        return None
+    s = sorted(values)
+    return s[min(int(q * (len(s) - 1) + 0.5), len(s) - 1)]
+
+
+def _vals(records: List[Dict], key: str) -> List[float]:
+    return [r[key] for r in records
+            if isinstance(r.get(key), (int, float))]
+
+
+def latency_summary(records: List[Dict]) -> Dict:
+    out: Dict[str, object] = {"requests": len(records)}
+    for key, name in (("latency_secs", "e2e"), ("ttft_secs", "ttft"),
+                      ("tpot_secs", "tpot")):
+        vals = _vals(records, key)
+        out[f"{name}_mean_secs"] = (sum(vals) / len(vals)
+                                    if vals else None)
+        for q, tag in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+            out[f"{name}_{tag}_secs"] = _percentile(vals, q)
+    return out
+
+
+def phase_breakdown(records: List[Dict]) -> Dict:
+    """Mean seconds per phase and its share of mean e2e latency.  The
+    phases need not sum to e2e (decode is amortized; the gap is
+    scheduling slack + result pickup), so ``unattributed`` closes the
+    account."""
+    e2e = _vals(records, "latency_secs")
+    mean_e2e = sum(e2e) / len(e2e) if e2e else 0.0
+    out: Dict[str, object] = {"mean_e2e_secs": mean_e2e or None}
+    attributed = 0.0
+    for key in PHASE_KEYS:
+        vals = [p[key] for p in (r.get("phases") or {} for r in records)
+                if isinstance(p.get(key), (int, float))]
+        mean = sum(vals) / len(vals) if vals else 0.0
+        attributed += mean
+        out[key] = {"mean_secs": mean,
+                    "share": (mean / mean_e2e) if mean_e2e else None}
+    out["unattributed_secs"] = max(mean_e2e - attributed, 0.0) \
+        if mean_e2e else None
+    return out
+
+
+def slo_attainment(records: List[Dict], ttft_slo: float,
+                   tpot_slo: float) -> Dict:
+    """Fraction of finished requests meeting each target.  A request
+    with no measurement for a dimension (e.g. tpot on a 1-token answer)
+    counts as meeting it — it cannot have violated it."""
+    n = len(records)
+
+    def ok(rec, key, target):
+        v = rec.get(key)
+        return not isinstance(v, (int, float)) or v <= target
+
+    ttft_ok = sum(ok(r, "ttft_secs", ttft_slo) for r in records)
+    tpot_ok = sum(ok(r, "tpot_secs", tpot_slo) for r in records)
+    both = sum(ok(r, "ttft_secs", ttft_slo)
+               and ok(r, "tpot_secs", tpot_slo) for r in records)
+    return {
+        "ttft_slo_secs": ttft_slo,
+        "tpot_slo_secs": tpot_slo,
+        "ttft_attained": (ttft_ok / n) if n else None,
+        "tpot_attained": (tpot_ok / n) if n else None,
+        "joint_attained": (both / n) if n else None,
+    }
+
+
+def cache_stratified(records: List[Dict]) -> Dict:
+    hits = [r for r in records
+            if (r.get("cached_prompt_tokens") or 0) > 0]
+    misses = [r for r in records
+              if (r.get("cached_prompt_tokens") or 0) == 0]
+    return {"cache_hit": latency_summary(hits),
+            "cache_miss": latency_summary(misses)}
+
+
+def analyze(paths: List[str], ttft_slo: float = 1.0,
+            tpot_slo: float = 0.25) -> Dict:
+    """Full report over one or more replicas' serve logs."""
+    per_replica: Dict[str, Dict] = {}
+    all_records: List[Dict] = []
+    for p in paths:
+        records = load_records(p)
+        all_records.extend(records)
+        if len(paths) > 1:
+            per_replica[p] = {
+                **latency_summary(records),
+                "slo": slo_attainment(records, ttft_slo, tpot_slo),
+            }
+    out = {
+        "paths": list(paths),
+        "summary": latency_summary(all_records),
+        "phases": phase_breakdown(all_records),
+        "slo": slo_attainment(all_records, ttft_slo, tpot_slo),
+        "by_cache": cache_stratified(all_records),
+        "finish_reasons": {},
+        "traced": sum(1 for r in all_records if r.get("trace_id")),
+    }
+    for r in all_records:
+        fr = r.get("finish_reason") or "?"
+        out["finish_reasons"][fr] = out["finish_reasons"].get(fr, 0) + 1
+    if per_replica:
+        out["replicas"] = per_replica
+    return out
+
+
+def _fmt(v, unit="s") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4f}{unit}"
+    return f"{v}{unit}"
+
+
+def _latency_lines(s: Dict, indent: str = "  ") -> List[str]:
+    lines = [f"{indent}requests: {s['requests']}"]
+    for name in ("e2e", "ttft", "tpot"):
+        lines.append(
+            f"{indent}{name:>4}  mean {_fmt(s[f'{name}_mean_secs']):>9}"
+            f"  p50 {_fmt(s[f'{name}_p50_secs']):>9}"
+            f"  p95 {_fmt(s[f'{name}_p95_secs']):>9}"
+            f"  p99 {_fmt(s[f'{name}_p99_secs']):>9}")
+    return lines
+
+
+def render(report: Dict) -> str:
+    lines = [f"serve_report over {len(report['paths'])} log(s): "
+             f"{report['summary']['requests']} requests "
+             f"({report['traced']} traced)"]
+    lines += _latency_lines(report["summary"])
+
+    ph = report["phases"]
+    mean_e2e = ph.get("mean_e2e_secs") or 0.0
+    lines.append("\nphase breakdown (mean per request):")
+    for key in PHASE_KEYS:
+        p = ph[key]
+        share = p["share"]
+        pct = f"{share * 100:5.1f}%" if share is not None else "    -"
+        lines.append(f"  {key:>18} {_fmt(p['mean_secs']):>10} {pct}")
+    if ph.get("unattributed_secs") is not None:
+        frac = ph["unattributed_secs"] / mean_e2e if mean_e2e else 0.0
+        lines.append(f"  {'unattributed':>18} "
+                     f"{_fmt(ph['unattributed_secs']):>10} "
+                     f"{frac * 100:5.1f}%")
+
+    slo = report["slo"]
+    lines.append(f"\nSLO attainment (ttft <= {slo['ttft_slo_secs']}s, "
+                 f"tpot <= {slo['tpot_slo_secs']}s):")
+    for key in ("ttft_attained", "tpot_attained", "joint_attained"):
+        v = slo[key]
+        lines.append(f"  {key:>14}: "
+                     + (f"{v * 100:.1f}%" if v is not None else "-"))
+
+    lines.append("\nby prefix-cache outcome:")
+    for name in ("cache_hit", "cache_miss"):
+        s = report["by_cache"][name]
+        lines.append(f"  {name} ({s['requests']} requests):")
+        if s["requests"]:
+            lines += _latency_lines(s, indent="    ")
+
+    if report.get("finish_reasons"):
+        lines.append("\nfinish reasons: "
+                     + json.dumps(report["finish_reasons"],
+                                  sort_keys=True))
+
+    for path, s in (report.get("replicas") or {}).items():
+        lines.append(f"\nreplica {path} "
+                     f"(joint SLO "
+                     + (f"{s['slo']['joint_attained'] * 100:.1f}%"
+                        if s['slo']['joint_attained'] is not None
+                        else "-") + "):")
+        lines += _latency_lines(s)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize serving request_done telemetry")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="telemetry.jsonl file(s) or --structured_log_dir "
+                         "dir(s); several -> per-replica comparison")
+    ap.add_argument("--ttft_slo", type=float, default=1.0,
+                    help="time-to-first-token target in seconds")
+    ap.add_argument("--tpot_slo", type=float, default=0.25,
+                    help="time-per-output-token target in seconds")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    args = ap.parse_args(argv)
+
+    try:
+        report = analyze(args.paths, ttft_slo=args.ttft_slo,
+                         tpot_slo=args.tpot_slo)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if report["summary"]["requests"] == 0:
+        print("no request_done records found (serve with "
+              "--structured_log_dir and schema >= 5)", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:         # e.g. piped into head
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

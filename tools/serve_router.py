@@ -42,12 +42,27 @@ def parse_args(argv=None):
     p.add_argument("--affinity_max", type=int, default=4096,
                    help="max tracked affinity entries (LRU beyond)")
     p.add_argument("--request_timeout_secs", type=float, default=600.0)
+    p.add_argument("--trace_dir", default=None,
+                   help="record router-side Chrome spans (route_request, "
+                        "route_stream, failover) keyed by X-Request-Trace "
+                        "ids; merge with replica traces via "
+                        "tools/trace_report.py --merge")
     return p.parse_args(argv)
 
 
 def main(argv=None):
     args = parse_args(argv)
     from megatron_llm_tpu.serving.router import ReplicaRouter, RouterServer
+
+    # the router module itself stays stdlib-pure; span recording is an
+    # opt-in that pulls in the tracing machinery only when requested
+    tracer = None
+    if args.trace_dir:
+        from megatron_llm_tpu.tracing import (SpanTracer, Tracing,
+                                              start_trace_flusher)
+        tracer = SpanTracer()
+        start_trace_flusher(Tracing(tracer=tracer,
+                                    trace_dir=args.trace_dir))
 
     router = ReplicaRouter(
         [u for u in args.backends.split(",") if u.strip()],
@@ -58,6 +73,7 @@ def main(argv=None):
         affinity_max=args.affinity_max,
         health_interval_secs=args.health_interval_secs,
         request_timeout_secs=args.request_timeout_secs,
+        tracer=tracer,
     )
     RouterServer(router).run(host=args.host, port=args.port)
 

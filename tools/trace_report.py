@@ -19,10 +19,21 @@ prints:
 When the sibling ``telemetry.jsonl`` (``--structured_log_dir``) exists,
 the per-boundary ``goodput_pct`` trend is appended.
 
+``--merge`` stitches several processes' traces (e.g. the serving
+router's + each replica's) onto ONE Chrome-trace timeline: every
+trace carries its wall-clock origin (``otherData.trace_start_unix``),
+so events shift onto a shared clock and each input file becomes its own
+process row.  A request's ``route_request`` span (router) then lines up
+under the same trace id as its ``queue_wait`` / ``prefill_chunk`` /
+``decode_step`` spans (replica) — the fleet-wide request lifecycle in
+one Perfetto view.
+
 Pure stdlib — no jax import, runs anywhere the files do.
 
 Usage:
     python tools/trace_report.py TRACE_DIR_OR_JSON [--top N] [--json]
+    python tools/trace_report.py A/trace.json B/trace.json --merge \
+        --out merged.json [--trace TRACE_ID]
 """
 
 from __future__ import annotations
@@ -144,6 +155,84 @@ def goodput_trend(log_dir: str) -> List[Dict]:
     return out
 
 
+def merge_traces(traces: List[Dict],
+                 names: Optional[List[str]] = None) -> Dict:
+    """Merge N Chrome traces onto one timeline.
+
+    Each SpanTracer trace's timestamps are relative to its own process
+    start; ``otherData.trace_start_unix`` anchors that origin to the
+    wall clock.  The earliest origin becomes the merged zero, every
+    other file's events shift right by its offset, and each file gets a
+    distinct pid (with a ``process_name`` metadata row naming it) so
+    Perfetto shows one row per process."""
+    if not traces:
+        raise ValueError("nothing to merge")
+    names = names or [f"trace_{i}" for i in range(len(traces))]
+    origins = []
+    for t in traces:
+        o = (t.get("otherData") or {}).get("trace_start_unix")
+        origins.append(float(o) if o is not None else None)
+    known = [o for o in origins if o is not None]
+    base = min(known) if known else 0.0
+    events: List[Dict] = []
+    for i, (t, name) in enumerate(zip(traces, names)):
+        shift_us = ((origins[i] - base) * 1e6
+                    if origins[i] is not None else 0.0)
+        label = f"p{i}:{os.path.basename(name) or name}"
+        events.append({"ph": "M", "name": "process_name", "pid": i,
+                       "tid": 0, "args": {"name": label}})
+        for e in t.get("traceEvents", []):
+            e = dict(e)
+            e["pid"] = i
+            if e.get("ph") == "M":
+                if e.get("name") == "process_name":
+                    continue    # replaced by the per-file label above
+            else:
+                e["ts"] = e.get("ts", 0.0) + shift_us
+            events.append(e)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": list(names),
+            "trace_start_unix": base,
+        },
+    }
+
+
+def request_timeline(merged: Dict, trace_id: str) -> List[Dict]:
+    """All events carrying a given request trace id, across every merged
+    process, in time order — the 'where did this slow request spend its
+    time' answer."""
+    out = []
+    for e in merged.get("traceEvents", []):
+        if e.get("ph") == "M":
+            continue
+        a = e.get("args") or {}
+        ids = a.get("traces") if isinstance(a.get("traces"), list) \
+            else [a.get("trace")]
+        if trace_id not in ids:
+            continue
+        out.append({"pid": e.get("pid"), "name": e.get("name"),
+                    "ph": e.get("ph"), "at_secs": e.get("ts", 0.0) / 1e6,
+                    "dur_secs": e.get("dur", 0.0) / 1e6,
+                    "args": {k: v for k, v in a.items()
+                             if k not in ("trace", "traces", "goodput")}})
+    return sorted(out, key=lambda r: r["at_secs"])
+
+
+def render_timeline(rows: List[Dict], trace_id: str) -> str:
+    lines = [f"request {trace_id}: {len(rows)} events"]
+    for r in rows:
+        extra = (" " + json.dumps(r["args"], sort_keys=True)
+                 if r["args"] else "")
+        dur = (f" {r['dur_secs'] * 1000:.1f} ms"
+               if r["ph"] == "X" else "")
+        lines.append(f"  @ {r['at_secs']:9.4f}s p{r['pid']} "
+                     f"{r['name']}{dur}{extra}")
+    return "\n".join(lines)
+
+
 def _bar(frac: float) -> str:
     n = int(round(max(min(frac, 1.0), 0.0) * BAR_WIDTH))
     return "#" * n + "." * (BAR_WIDTH - n)
@@ -215,8 +304,21 @@ def render(trace: Dict, top_n: int, trend: List[Dict]) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="summarize a span trace (trace.json)")
-    ap.add_argument("path", help="trace.json or the --trace_dir")
+        description="summarize a span trace (trace.json), or --merge "
+                    "several processes' traces onto one timeline")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="trace.json or the --trace_dir (several with "
+                         "--merge)")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge the given traces (router + replicas) "
+                         "onto one Chrome-trace timeline via their "
+                         "trace_start_unix anchors")
+    ap.add_argument("--out", default=None,
+                    help="with --merge: write the merged Chrome trace "
+                         "here (loadable in Perfetto)")
+    ap.add_argument("--trace", default=None,
+                    help="with --merge: print the cross-process timeline "
+                         "of this request trace id")
     ap.add_argument("--log_dir", default=None,
                     help="telemetry.jsonl (or its dir) for the per-boundary "
                          "goodput trend; defaults to the trace's own dir")
@@ -226,16 +328,47 @@ def main(argv=None) -> int:
                     help="emit the summary as one JSON object")
     args = ap.parse_args(argv)
 
+    if len(args.paths) > 1 and not args.merge:
+        print("multiple traces require --merge", file=sys.stderr)
+        return 2
+
+    if args.merge:
+        try:
+            traces = [load_trace(p) for p in args.paths]
+        except (FileNotFoundError, json.JSONDecodeError) as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        merged = merge_traces(traces, names=args.paths)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(merged, f)
+            print(f"merged {len(args.paths)} traces "
+                  f"({len(merged['traceEvents'])} events) -> {args.out}")
+        if args.trace:
+            rows = request_timeline(merged, args.trace)
+            if args.json:
+                print(json.dumps(rows, indent=1))
+            else:
+                print(render_timeline(rows, args.trace))
+        elif not args.out:
+            if args.json:
+                print(json.dumps(merged))
+            else:
+                print(f"merged {len(args.paths)} traces "
+                      f"({len(merged['traceEvents'])} events); use --out "
+                      f"to save or --trace ID for a request timeline")
+        return 0
+
     try:
-        trace = load_trace(args.path)
+        trace = load_trace(args.paths[0])
     except (FileNotFoundError, json.JSONDecodeError) as e:
         print(str(e), file=sys.stderr)
         return 2
 
     log_dir = args.log_dir
     if log_dir is None:
-        log_dir = args.path if os.path.isdir(args.path) \
-            else os.path.dirname(os.path.abspath(args.path))
+        log_dir = args.paths[0] if os.path.isdir(args.paths[0]) \
+            else os.path.dirname(os.path.abspath(args.paths[0]))
     trend = goodput_trend(log_dir)
 
     if args.json:
