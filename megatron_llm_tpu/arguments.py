@@ -474,6 +474,27 @@ def _add_inference_args(parser):
                    help="share KV pages across requests with equal "
                         "prompt prefixes (refcounted copy-on-write "
                         "pages, LRU reuse); 0 disables")
+    # serving resilience (serving/resilience.py;
+    # docs/guide/fault_tolerance.md "Serving resilience")
+    g.add_argument("--serve_watchdog_secs", type=float, default=0.0,
+                   help="engine watchdog: when no dispatch completes "
+                        "within this many seconds while work is pending, "
+                        "dump diagnostics and restart the engine "
+                        "in-process (requeueing interrupted requests); "
+                        "0 disables")
+    g.add_argument("--serve_preemption", type=int, default=1,
+                   help="pool-pressure preemption: on an oversubscribed "
+                        "--serve_num_blocks pool, evict a strictly-"
+                        "larger running request back to the queue head "
+                        "so a starving admission can proceed; 0 disables")
+    g.add_argument("--serve_restart_backoff_secs", type=float, default=0.5,
+                   help="base delay of the exponential restart-storm "
+                        "backoff (repeated engine restarts within 60s)")
+    g.add_argument("--serve_fault_inject", type=str, default="",
+                   help="deterministic serving chaos spec, e.g. "
+                        "'nan@12,hang@30:5,slow@40:250,oom@8' (1-based "
+                        "engine dispatch indices; each trigger fires "
+                        "once).  Testing only.")
 
 
 def _add_resilience_args(parser):

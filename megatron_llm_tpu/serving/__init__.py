@@ -16,11 +16,16 @@ from megatron_llm_tpu.serving.kv_blocks import (
     derive_num_blocks,
 )
 from megatron_llm_tpu.serving.request import (
+    FINISH_NONFINITE,
     EngineError,
     QueueFull,
     Request,
     RequestQueue,
     SamplingParams,
+)
+from megatron_llm_tpu.serving.resilience import (
+    EngineWatchdog,
+    ServingFaultInjector,
 )
 from megatron_llm_tpu.serving.router import (
     AllBackendsThrottled,
@@ -37,6 +42,8 @@ __all__ = [
     "BlockManager",
     "EngineConfig",
     "EngineError",
+    "EngineWatchdog",
+    "FINISH_NONFINITE",
     "InferenceEngine",
     "NoBackendAvailable",
     "NoCapacity",
@@ -47,6 +54,7 @@ __all__ = [
     "RouterServer",
     "SamplingParams",
     "Scheduler",
+    "ServingFaultInjector",
     "chain_block_digests",
     "derive_num_blocks",
 ]

@@ -27,6 +27,30 @@ on admission are numpy row writes — because a stray
 ``device_array.at[python_int].set()`` or ``array[slot:slot+1]`` would
 compile a fresh tiny executable per distinct slot index and trip the
 recompile detector.
+
+Resilience (serving/resilience.py; docs/guide/fault_tolerance.md):
+
+* **Non-finite sentinel** — the decode step and first-token sampler
+  additionally return per-slot ``isfinite(logits).all()`` flags.  They
+  ride the same compiled programs and are fetched with the sampled
+  tokens, so the check is free of recompiles and extra dispatches; a
+  poisoned slot is evicted with ``finish_reason="nonfinite"`` while its
+  batch-mates keep decoding untouched.
+* **In-process restart** — all restartable state (block manager,
+  scheduler, KV pages, per-slot arrays) lives in one ``_EngineState``
+  object.  ``restart()`` swaps in a fresh state of identical shapes
+  (every jitted program cache-hits — no recompile) and abandons the old
+  one to the wedged thread, which can only scribble on garbage; requests
+  that never produced a byte requeue at the queue head, mid-stream ones
+  fail cleanly.  The ``EngineWatchdog`` triggers this when no dispatch
+  completes within ``watchdog_secs`` while work is pending.
+* **Pool-pressure preemption** — when admission stalls on *blocks* (a
+  deliberately oversubscribed ``num_blocks`` pool) while a slot is
+  free, the scheduler evicts a strictly-larger running request back to
+  the queue head (pages released and prefix-registered, generated
+  tokens kept) so the head can run; re-admission prefills over
+  ``Request.context_tokens()`` and greedy continuations are
+  token-identical.
 """
 
 from __future__ import annotations
@@ -51,11 +75,16 @@ from megatron_llm_tpu.serving.request import (
     FINISH_DEADLINE,
     FINISH_ERROR,
     FINISH_LENGTH,
+    FINISH_NONFINITE,
     FINISH_STOP,
     Request,
     RequestQueue,
     RequestState,
     SamplingParams,
+)
+from megatron_llm_tpu.serving.resilience import (
+    EngineWatchdog,
+    ServingFaultInjector,
 )
 from megatron_llm_tpu.serving.scheduler import Scheduler
 from megatron_llm_tpu.text_generation.generation import init_paged_kv_caches
@@ -78,6 +107,12 @@ class EngineConfig:
     # mode in tests), 'on' forces it, 'off' keeps the XLA gather branch.
     # The resolved path is reported as stats()['paged_kernel'].
     paged_kernel: str = "auto"
+    # resilience (--serve_watchdog_secs / --serve_preemption /
+    # --serve_fault_inject; serving/resilience.py)
+    watchdog_secs: float = 0.0      # 0 = no engine watchdog
+    preemption: bool = True         # pool-pressure preemption
+    fault_spec: str = ""            # chaos injection, e.g. "nan@12,hang@30"
+    restart_backoff_secs: float = 0.5   # restart-storm backoff base
 
 
 def _key_from_seed(seed: int) -> np.ndarray:
@@ -88,6 +123,29 @@ def _key_from_seed(seed: int) -> np.ndarray:
     seed = int(seed)
     return np.array([(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF],
                     np.uint32)
+
+
+@dataclass
+class _EngineState:
+    """Everything a restart replaces.  The wedged thread keeps its
+    reference to the OLD state object, so whatever it writes when (if)
+    it finally wakes up lands in abandoned arrays; request-visible
+    effects are additionally gated on ``st is self._st`` after every
+    dispatch."""
+
+    gen: int
+    blocks: BlockManager
+    scheduler: Scheduler
+    pages: Any
+    last_tokens: np.ndarray
+    context_lens: np.ndarray
+    active: np.ndarray
+    temps: np.ndarray
+    top_ks: np.ndarray
+    top_ps: np.ndarray
+    ban_a: np.ndarray
+    ban_b: np.ndarray
+    keys: np.ndarray
 
 
 class InferenceEngine:
@@ -107,19 +165,11 @@ class InferenceEngine:
             cfg.max_model_len = int(mcfg.max_position_embeddings)
         cfg.max_model_len = min(cfg.max_model_len,
                                 int(mcfg.max_position_embeddings))
-        max_blocks_per_slot = -(-cfg.max_model_len // cfg.block_size)
-        num_blocks = derive_num_blocks(
+        self._max_blocks_per_slot = -(-cfg.max_model_len // cfg.block_size)
+        self._num_blocks = derive_num_blocks(
             cfg.num_slots, cfg.block_size, cfg.max_model_len,
             cfg.num_blocks or None)
-        self.blocks = BlockManager(num_blocks, cfg.block_size,
-                                   cfg.num_slots, max_blocks_per_slot,
-                                   prefix_cache=cfg.prefix_cache)
         self.queue = RequestQueue(cfg.max_queue_depth)
-        self.scheduler = Scheduler(self.queue, self.blocks,
-                                   cfg.max_model_len)
-        self._pages = init_paged_kv_caches(
-            mcfg, num_blocks, cfg.block_size,
-            quantized=cfg.int8_kv_cache)
 
         # resolve the decode attention path ONCE (it is a static config
         # field of the jitted decode step, so flipping it later would
@@ -141,17 +191,7 @@ class InferenceEngine:
             paged_attention_kernel=(
                 "on" if self.paged_kernel == "pallas" else "off"))
 
-        S = cfg.num_slots
-        # host-side per-slot state; uploaded whole each step
-        self._last_tokens = np.zeros(S, np.int32)
-        self._context_lens = np.zeros(S, np.int32)
-        self._active = np.zeros(S, np.int32)
-        self._temps = np.ones(S, np.float32)
-        self._top_ks = np.zeros(S, np.int32)
-        self._top_ps = np.zeros(S, np.float32)
-        self._ban_a = np.full(S, -1, np.int32)
-        self._ban_b = np.full(S, -1, np.int32)
-        self._keys = np.zeros((S, 2), np.uint32)
+        self._st = self._new_state(gen=0)
 
         self._decode_step = jax.jit(self._decode_impl)
         self._prefill_step = jax.jit(self._prefill_impl)
@@ -170,6 +210,14 @@ class InferenceEngine:
         self.decode_secs = 0.0
         self.finished: Dict[str, int] = {}
         self.warmed_up = False
+        # resilience counters + machinery (serving/resilience.py)
+        self.engine_restarts = 0
+        self.slots_evicted_nonfinite = 0
+        self.fault_injector = ServingFaultInjector.from_spec(cfg.fault_spec)
+        self._dispatches = 0            # prefill chunks + decode steps
+        self._watchdog: Optional[EngineWatchdog] = None
+        self._restart_lock = threading.Lock()
+        self._restart_times: List[float] = []
         # called with every request_done record (ServerMetrics feeds its
         # SLO histograms from here); exceptions never reach the engine loop
         self.request_done_hook: Optional[Any] = None
@@ -178,6 +226,52 @@ class InferenceEngine:
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
         self._submit_lock = threading.Lock()
+
+    def _new_state(self, gen: int,
+                   carry: Optional[_EngineState] = None) -> _EngineState:
+        """Fresh restartable state.  Shapes are identical every time, so
+        the page init and every jitted program cache-hit — a restart
+        compiles nothing.  Scheduler counters carry across restarts (the
+        fleet-visible totals must not reset)."""
+        cfg = self.config
+        blocks = BlockManager(self._num_blocks, cfg.block_size,
+                              cfg.num_slots, self._max_blocks_per_slot,
+                              prefix_cache=cfg.prefix_cache)
+        sched = Scheduler(self.queue, blocks, cfg.max_model_len)
+        if carry is not None:
+            old = carry.scheduler
+            sched.admitted = old.admitted
+            sched.rejected_len = old.rejected_len
+            sched.deadline_evictions = old.deadline_evictions
+            sched.preemptions = old.preemptions
+        S = cfg.num_slots
+        return _EngineState(
+            gen=gen,
+            blocks=blocks,
+            scheduler=sched,
+            pages=init_paged_kv_caches(self.model.cfg, self._num_blocks,
+                                       cfg.block_size,
+                                       quantized=cfg.int8_kv_cache),
+            last_tokens=np.zeros(S, np.int32),
+            context_lens=np.zeros(S, np.int32),
+            active=np.zeros(S, np.int32),
+            temps=np.ones(S, np.float32),
+            top_ks=np.zeros(S, np.int32),
+            top_ps=np.zeros(S, np.float32),
+            ban_a=np.full(S, -1, np.int32),
+            ban_b=np.full(S, -1, np.int32),
+            keys=np.zeros((S, 2), np.uint32),
+        )
+
+    # current-state views (the HTTP server, tools and tests address the
+    # engine, not a state generation)
+    @property
+    def blocks(self) -> BlockManager:
+        return self._st.blocks
+
+    @property
+    def scheduler(self) -> Scheduler:
+        return self._st.scheduler
 
     # ------------------------------------------------------------------
     # jitted device programs (fixed shapes; everything traced)
@@ -208,6 +302,11 @@ class InferenceEngine:
             params, tokens, positions, None, cfg,
             rng_key=None, train=False, kv_caches=caches)
         logits = logits[:, 0, :].astype(jnp.float32)        # [S, V]
+        # non-finite sentinel: per-slot health of the raw model logits,
+        # computed before the (legitimately -inf) ban masking below.
+        # Rides this same program and is fetched with the tokens — the
+        # host-side check costs no dispatch and no recompile.
+        finite = jnp.isfinite(logits).all(axis=-1)          # [S] bool
         V = logits.shape[-1]
         # ban pair (prevent_newline_after_colon): token b is illegal
         # immediately after token a
@@ -217,7 +316,7 @@ class InferenceEngine:
         sub = jax.vmap(lambda k: jax.random.split(k, 2))(keys)  # [S, 2, 2]
         next_tokens = sample_batched(logits, sub[:, 0], top_ks, top_ps,
                                      temps)
-        return next_tokens, self._strip_pages(new_caches), sub[:, 1]
+        return next_tokens, self._strip_pages(new_caches), sub[:, 1], finite
 
     def _prefill_impl(self, params, pages, tokens, start_pos, valid_len,
                       block_table):
@@ -251,6 +350,7 @@ class InferenceEngine:
 
     def _sample_first_impl(self, logits, key, top_k, top_p, temp,
                            ban_a, ban_b, last_prompt_tok):
+        finite = jnp.isfinite(logits).all()     # sentinel, pre-masking
         logits = logits[None, :]                            # [1, V]
         V = logits.shape[-1]
         banned = (ban_a >= 0) & (last_prompt_tok == ban_a)
@@ -259,7 +359,7 @@ class InferenceEngine:
         sub = jax.random.split(key, 2)
         tok = sample_batched(logits, sub[0][None], top_k[None],
                              top_p[None], temp[None])
-        return tok[0], sub[1]
+        return tok[0], sub[1], finite
 
     # ------------------------------------------------------------------
     # submission (any thread)
@@ -307,6 +407,11 @@ class InferenceEngine:
     def start(self) -> "InferenceEngine":
         assert self._thread is None, "engine already started"
         self._running = True
+        if self.config.watchdog_secs > 0 and self._watchdog is None:
+            self._watchdog = EngineWatchdog(
+                timeout_secs=self.config.watchdog_secs,
+                has_work=lambda: self._st.scheduler.has_work(),
+                on_fire=lambda: self.restart("watchdog")).start()
         self._thread = threading.Thread(target=self._loop,
                                         name="serving-engine", daemon=True)
         self._thread.start()
@@ -314,51 +419,132 @@ class InferenceEngine:
 
     def stop(self, timeout: float = 30.0) -> None:
         self._running = False
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        st = self._st
         for req in self.queue.drain():
             req._finish(FINISH_ABORTED)
-        for req in list(self.scheduler.active.values()):
+        for req in list(st.scheduler.active.values()):
             req._finish(FINISH_ABORTED)
-            self.scheduler.evict(req)
+            st.scheduler.evict(req)
         stream = telemetry.get_stream()
         if stream is not None:
             stream.emit({"kind": "serve", "event": "engine_stop",
                          **self.stats()})
 
     def _loop(self) -> None:
-        while self._running:
+        st = self._st
+        while self._running and st is self._st:
             try:
-                did_work = self.step()
+                did_work = self.step(st)
             except Exception as e:  # noqa: BLE001 - engine must survive
-                self._fail_all(f"{type(e).__name__}: {e}")
+                self._fail_all(st, f"{type(e).__name__}: {e}")
                 did_work = False
+            if st is not self._st:
+                return              # restarted under our feet: stand down
+            if did_work and self._watchdog is not None:
+                self._watchdog.progress()
             if not did_work:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
 
-    def _fail_all(self, msg: str) -> None:
-        self._active[:] = 0
-        for req in list(self.scheduler.active.values()):
+    def _fail_all(self, st: _EngineState, msg: str) -> None:
+        st.active[:] = 0
+        for req in list(st.scheduler.active.values()):
             req._finish(FINISH_ERROR, error=msg)
-            self.scheduler.evict(req)
+            st.scheduler.evict(req)
             self._count_finish(FINISH_ERROR)
 
-    def step(self) -> bool:
+    def restart(self, reason: str) -> None:
+        """Tear down and restart the engine in-process: swap in a fresh
+        state (identical shapes — every jitted program cache-hits),
+        requeue interrupted requests that never produced a byte at the
+        queue head, fail mid-stream ones cleanly, and replace the engine
+        thread.  The wedged thread keeps the abandoned state object and
+        is gated out of every request-visible effect.  Callable from any
+        thread (the watchdog calls it from its own)."""
+        with self._restart_lock:
+            old = self._st
+            self.engine_restarts += 1
+            requeue: List[Request] = []
+            failed: List[Request] = []
+            for req in list(old.scheduler.active.values()):
+                if req.state == RequestState.DONE:
+                    continue
+                if req._events is not None and req.t_first_token is not None:
+                    failed.append(req)      # streamed bytes already left
+                else:
+                    requeue.append(req)
+            tracing.instant("engine_restart", "serve", reason=reason,
+                            gen=old.gen, requeued=len(requeue),
+                            failed=len(failed))
+            stream = telemetry.get_stream()
+            if stream is not None:
+                stream.emit({"kind": "serve", "event": "engine_restart",
+                             "reason": reason, "gen": old.gen,
+                             "requeued": len(requeue),
+                             "failed": len(failed)})
+            # publish the fresh state FIRST: from here on the old thread
+            # fails its `st is self._st` guards and cannot touch requests
+            self._st = self._new_state(gen=old.gen + 1, carry=old)
+            for req in failed:
+                req._finish(FINISH_ERROR,
+                            error=f"engine restarted mid-stream ({reason})")
+                self._count_finish(FINISH_ERROR)
+            # queue-head requeue in original submit order (last submitted
+            # inserted first ends up behind earlier ones)
+            for req in sorted(requeue, key=lambda r: r.t_submit,
+                              reverse=True):
+                req.reset_for_requeue()
+                self.queue.put_front(req)
+            # restart-storm backoff: repeated fires within a minute back
+            # off exponentially so a hard-wedged model can't hot-loop
+            # dump/restart cycles
+            now = time.monotonic()
+            self._restart_times = [t for t in self._restart_times
+                                   if now - t < 60.0] + [now]
+            storms = len(self._restart_times) - 1
+            if storms > 0 and self.config.restart_backoff_secs > 0:
+                delay = min(self.config.restart_backoff_secs
+                            * 2 ** (storms - 1), 30.0)
+                print(f" [engine] restart storm ({storms + 1} in 60s): "
+                      f"backing off {delay:.1f}s", flush=True)
+                time.sleep(delay)
+            if self._running:
+                self._thread = threading.Thread(
+                    target=self._loop, name="serving-engine", daemon=True)
+                self._thread.start()
+            if self._watchdog is not None:
+                self._watchdog.progress()
+            self._wake.set()
+
+    def step(self, st: Optional[_EngineState] = None) -> bool:
         """One scheduling decision + device call.  Returns False when
         idle.  Public so tests can single-step the engine without the
         background thread."""
-        sched = self.scheduler
+        st = st if st is not None else self._st
+        sched = st.scheduler
+        # fault injection stays disarmed through warmup — chaos specs
+        # index steady-state dispatches
+        inj = self.fault_injector if self.warmed_up else None
         for req in sched.sweep_deadlines():
             req._finish(FINISH_DEADLINE)
-            self._retire(req)
+            self._retire(st, req)
         t_admit = time.perf_counter()
         admitted = []
-        for req in sched.admit():
-            self._on_admit(req)
-            admitted.append(req)
+        if inj is not None and inj.maybe_oom(self._dispatches + 1):
+            pass        # injected pool exhaustion: head retries next step
+        else:
+            for req in sched.admit():
+                self._on_admit(st, req)
+                admitted.append(req)
+            if not admitted and self.config.preemption:
+                admitted = self._try_preempt(st)
         if admitted:
             # slot-setup cost, split evenly across this round's admits
             share = (time.perf_counter() - t_admit) / len(admitted)
@@ -366,26 +552,32 @@ class InferenceEngine:
                 req.admission_secs += share
         kind, arg = sched.next_action()
         if kind == "prefill":
-            self._run_prefill_chunk(arg)
+            self._dispatches += 1
+            if inj is not None:
+                inj.before_dispatch(self._dispatches)
+            self._run_prefill_chunk(st, arg)
             return True
         if kind == "decode":
-            self._run_decode(arg)
+            self._dispatches += 1
+            if inj is not None:
+                inj.before_dispatch(self._dispatches)
+            self._run_decode(st, arg)
             return True
         return False
 
     # -- admission ------------------------------------------------------
 
-    def _on_admit(self, req: Request) -> None:
+    def _on_admit(self, st: _EngineState, req: Request) -> None:
         s = req.slot
         sp = req.sampling
-        self._temps[s] = sp.temperature
-        self._top_ks[s] = sp.top_k
-        self._top_ps[s] = sp.top_p
-        self._ban_a[s] = sp.ban_pair[0] if sp.ban_pair else -1
-        self._ban_b[s] = sp.ban_pair[1] if sp.ban_pair else -1
-        self._keys[s] = _key_from_seed(sp.seed)
-        self._active[s] = 0             # stays masked until prefill done
-        self._context_lens[s] = 0
+        st.temps[s] = sp.temperature
+        st.top_ks[s] = sp.top_k
+        st.top_ps[s] = sp.top_p
+        st.ban_a[s] = sp.ban_pair[0] if sp.ban_pair else -1
+        st.ban_b[s] = sp.ban_pair[1] if sp.ban_pair else -1
+        st.keys[s] = _key_from_seed(sp.seed)
+        st.active[s] = 0            # stays masked until prefill done
+        st.context_lens[s] = 0
         self.prefill_tokens_submitted += len(req.prompt_tokens)
         self.prefill_tokens_cached += req.cached_prompt_tokens
         req._pc_admit = time.perf_counter()
@@ -406,48 +598,110 @@ class InferenceEngine:
                             trace=req.trace_id,
                             tokens=req.cached_prompt_tokens)
 
+    # -- pool-pressure preemption ---------------------------------------
+
+    def _try_preempt(self, st: _EngineState) -> List[Request]:
+        """Admission stalled with work queued: when a slot is free but
+        the head's worst-case block reservation is not (a deliberately
+        oversubscribed pool), evict a strictly-larger running request
+        and retry.  Returns the requests admitted into the freed
+        capacity (empty when preemption cannot help)."""
+        head = self.queue.peek()
+        if head is None or head.past_deadline():
+            return []
+        bstats = st.blocks.stats()
+        if bstats["slots_in_use"] >= bstats["slots_total"]:
+            return []       # slot-bound, not block-bound: just wait
+        victim = st.scheduler.select_victim(head)
+        if victim is None:
+            return []
+        # requeue order matters: preempt() put_fronts the victim, which
+        # would place it AHEAD of the head it is yielding to — FIFO
+        # admission would then hand the victim straight back its own
+        # freed pages.  Pop the head first and re-front it after, so the
+        # queue reads [head, victim, ...] and the freed capacity goes to
+        # the smaller request (the engine thread is the only popper, so
+        # the pop/put_front pair cannot lose a request).
+        popped = self.queue.pop()
+        self._preempt(st, victim)
+        if popped is not None:
+            self.queue.put_front(popped)
+        admitted = []
+        for req in st.scheduler.admit():
+            self._on_admit(st, req)
+            admitted.append(req)
+        return admitted
+
+    def _preempt(self, st: _EngineState, victim: Request) -> None:
+        s = victim.slot
+        # tokens with KV actually on device (see _retire): registered so
+        # the victim's re-admission re-adopts its own pages
+        n_written = (int(st.context_lens[s]) if st.context_lens[s] > 0
+                     else victim.prefill_pos)
+        st.active[s] = 0
+        st.context_lens[s] = 0
+        tracing.instant("preempt", "serve", request=victim.id, slot=s,
+                        trace=victim.trace_id,
+                        generated=len(victim.out_tokens))
+        stream = telemetry.get_stream()
+        if stream is not None:
+            stream.emit({"kind": "serve", "event": "preemption",
+                         "request": victim.id, "trace_id": victim.trace_id,
+                         "generated": len(victim.out_tokens),
+                         "n_written": n_written})
+        st.scheduler.preempt(victim, token_ids=victim.context_tokens(),
+                             n_written=n_written)
+
     # -- prefill --------------------------------------------------------
 
-    def _writable(self, slot: int, block_idx: int) -> None:
+    def _writable(self, st: _EngineState, slot: int, block_idx: int) -> None:
         """Copy-on-write barrier before a device write into a slot's
         logical page: if the block manager swaps in a private copy,
         mirror the page contents on device."""
-        res = self.blocks.ensure_writable(slot, block_idx)
+        res = st.blocks.ensure_writable(slot, block_idx)
         if res is not None:
             new_b, src_b = res
-            self._pages = self._cow_copy(self._pages, np.int32(src_b),
-                                         np.int32(new_b))
+            st.pages = self._cow_copy(st.pages, np.int32(src_b),
+                                      np.int32(new_b))
 
-    def _run_prefill_chunk(self, req: Request) -> None:
+    def _run_prefill_chunk(self, st: _EngineState, req: Request) -> None:
         C = self.config.prefill_chunk
+        # prefill over the full context — prompt plus anything generated
+        # before a preemption/restart requeued this request (identical to
+        # the prompt for never-interrupted requests)
+        ptoks = req.context_tokens()
         start = req.prefill_pos
-        chunk = req.prompt_tokens[start:start + C]
+        chunk = ptoks[start:start + C]
         valid = len(chunk)
         toks = np.zeros((1, C), np.int32)
         toks[0, :valid] = chunk
         bs = self.config.block_size
         for bi in range(start // bs, (start + valid - 1) // bs + 1):
-            self._writable(req.slot, bi)
-        table = self.blocks.tables[req.slot:req.slot + 1].copy()
+            self._writable(st, req.slot, bi)
+        table = st.blocks.tables[req.slot:req.slot + 1].copy()
         t0 = time.perf_counter()
+        finite = True
         with tracing.span("prefill_chunk", "serve", request=req.id,
                           trace=req.trace_id, tokens=valid,
                           cached_tokens=req.cached_prompt_tokens):
-            last_logits, self._pages = self._prefill_step(
-                self.params, self._pages, toks, np.int32(start),
+            last_logits, st.pages = self._prefill_step(
+                self.params, st.pages, toks, np.int32(start),
                 np.int32(valid), table)
-            done = start + valid >= len(req.prompt_tokens)
+            done = start + valid >= len(ptoks)
             if done:
-                tok, new_key = self._sample_first(
-                    last_logits, self._keys[req.slot],
-                    self._top_ks[req.slot], self._top_ps[req.slot],
-                    self._temps[req.slot], self._ban_a[req.slot],
-                    self._ban_b[req.slot],
-                    np.int32(req.prompt_tokens[-1]))
+                tok, new_key, finite = self._sample_first(
+                    last_logits, st.keys[req.slot],
+                    st.top_ks[req.slot], st.top_ps[req.slot],
+                    st.temps[req.slot], st.ban_a[req.slot],
+                    st.ban_b[req.slot],
+                    np.int32(ptoks[-1]))
                 tok = int(tok)
-                self._keys[req.slot] = np.asarray(new_key)
+                finite = bool(finite)
+                st.keys[req.slot] = np.asarray(new_key)
             else:
-                jax.block_until_ready(self._pages[0])
+                jax.block_until_ready(st.pages[0])
+        if st is not self._st:
+            return          # engine restarted mid-dispatch: stale state
         chunk_secs = time.perf_counter() - t0
         self.prefill_secs += chunk_secs
         req.prefill_compute_secs += chunk_secs
@@ -456,42 +710,57 @@ class InferenceEngine:
         req.prefill_pos = start + valid
         # freshly filled full blocks become shareable right away, so a
         # burst of same-prefix requests hits even mid-prefill
-        self.blocks.commit_prefix(req.slot, req.prompt_tokens,
-                                  req.prefill_pos)
+        st.blocks.commit_prefix(req.slot, ptoks, req.prefill_pos)
         if not done:
+            return
+        inj = self.fault_injector if self.warmed_up else None
+        if inj is not None and inj.poison_nonfinite(self._dispatches):
+            finite = False
+        if not finite:
+            self._evict_nonfinite(st, req)
             return
         # prompt fully cached: request enters the decode batch
         s = req.slot
         req.state = RequestState.DECODE
-        self._context_lens[s] = len(req.prompt_tokens)
-        self._active[s] = 1
-        self._last_tokens[s] = tok
-        self._emit_and_check(req, tok)
+        st.context_lens[s] = len(ptoks)
+        st.active[s] = 1
+        st.last_tokens[s] = tok
+        self._emit_and_check(st, req, tok)
 
     # -- decode ---------------------------------------------------------
 
-    def _run_decode(self, slots: List[int]) -> None:
+    def _run_decode(self, st: _EngineState, slots: List[int]) -> None:
         bs = self.config.block_size
         for s in slots:
-            self._writable(s, int(self._context_lens[s]) // bs)
-        decoding = [r for r in (self.scheduler.active.get(s) for s in slots)
+            self._writable(st, s, int(st.context_lens[s]) // bs)
+        decoding = [r for r in (st.scheduler.active.get(s) for s in slots)
                     if r is not None and r.state == RequestState.DECODE]
         traces = sorted({r.trace_id for r in decoding if r.trace_id})
         t0 = time.perf_counter()
         with tracing.span("decode_step", "serve", batch=len(slots),
                           traces=traces):
-            next_tokens, self._pages, new_keys = self._decode_step(
-                self.params, self._pages, self._last_tokens,
-                self._context_lens, self.blocks.tables.copy(),
-                self._active, self._temps, self._top_ks, self._top_ps,
-                self._ban_a, self._ban_b, self._keys)
+            next_tokens, st.pages, new_keys, finite = self._decode_step(
+                self.params, st.pages, st.last_tokens,
+                st.context_lens, st.blocks.tables.copy(),
+                st.active, st.temps, st.top_ks, st.top_ps,
+                st.ban_a, st.ban_b, st.keys)
             next_tokens = np.asarray(next_tokens)
         # key chains advance ONLY for decoding slots: a slot mid-prefill
         # keeps its admission-time seed key, so a request's sample stream
         # depends on its seed alone, not on batch-mates' decode traffic
         new_keys = np.asarray(new_keys)
+        finite = np.asarray(finite).copy()
         for s in slots:
-            self._keys[s] = new_keys[s]
+            st.keys[s] = new_keys[s]
+        if st is not self._st:
+            return          # engine restarted mid-dispatch: stale state
+        inj = self.fault_injector if self.warmed_up else None
+        if slots and inj is not None \
+                and inj.poison_nonfinite(self._dispatches):
+            # flip only the fetched host-side flag of the lowest busy
+            # slot: device state is untouched, so batch-mates are
+            # trivially token-identical to an uninjected run
+            finite[min(slots)] = False
         step_secs = time.perf_counter() - t0
         self.decode_secs += step_secs
         self.decode_steps += 1
@@ -504,22 +773,40 @@ class InferenceEngine:
             req.decode_amortized_secs += share
             req.decode_tokens += 1
         for s in slots:
-            req = self.scheduler.active.get(s)
+            req = st.scheduler.active.get(s)
             if req is None or req.state != RequestState.DECODE:
+                continue
+            if not finite[s]:
+                # slot-level fault isolation: only the poisoned slot is
+                # evicted; the loop continues with its batch-mates
+                self._evict_nonfinite(st, req)
                 continue
             # the step wrote last_tokens[s] into the cache at
             # context_lens[s] and sampled the next token
-            self._context_lens[s] += 1
+            st.context_lens[s] += 1
             tok = int(next_tokens[s])
-            self._last_tokens[s] = tok
+            st.last_tokens[s] = tok
             sp = req.sampling
             if sp.top_p_decay > 0.0:
-                self._top_ps[s] = sp.top_p_at(len(req.out_tokens) + 1)
-            self._emit_and_check(req, tok)
+                st.top_ps[s] = sp.top_p_at(len(req.out_tokens) + 1)
+            self._emit_and_check(st, req, tok)
 
     # -- completion -----------------------------------------------------
 
-    def _emit_and_check(self, req: Request, tok: int) -> None:
+    def _evict_nonfinite(self, st: _EngineState, req: Request) -> None:
+        """Non-finite sentinel tripped for this slot: structured failure
+        (HTTP maps ``finish_reason="nonfinite"`` to a 500) and eviction
+        WITHOUT registering its pages — KV written by a poisoned forward
+        pass must never enter the prefix cache."""
+        self.slots_evicted_nonfinite += 1
+        tracing.instant("slot_evicted_nonfinite", "serve", request=req.id,
+                        slot=req.slot, trace=req.trace_id)
+        req._finish(FINISH_NONFINITE,
+                    error="non-finite logits detected for this slot")
+        self._retire(st, req)
+
+    def _emit_and_check(self, st: _EngineState, req: Request,
+                        tok: int) -> None:
         prev = (req.out_tokens[-1] if req.out_tokens
                 else req.prompt_tokens[-1])
         req._emit_token(tok)
@@ -534,9 +821,9 @@ class InferenceEngine:
             reason = FINISH_LENGTH
         if reason is not None:
             req._finish(reason)
-            self._retire(req)
+            self._retire(st, req)
 
-    def _retire(self, req: Request) -> None:
+    def _retire(self, st: _EngineState, req: Request) -> None:
         s = req.slot
         n_written = 0
         if s is not None:
@@ -545,11 +832,13 @@ class InferenceEngine:
             # context_lens stays 0 through prefill), else the prefill
             # progress.  Blocks beyond that were reserved but never
             # written and go straight back to the free list.
-            n_written = (int(self._context_lens[s])
-                         if self._context_lens[s] > 0
+            n_written = (int(st.context_lens[s])
+                         if st.context_lens[s] > 0
                          else req.prefill_pos)
-            self._active[s] = 0
-        self.scheduler.evict(req, token_ids=req.tokens, n_written=n_written)
+            st.active[s] = 0
+        if req.finish_reason == FINISH_NONFINITE:
+            n_written = 0   # poisoned KV: register nothing for reuse
+        st.scheduler.evict(req, token_ids=req.tokens, n_written=n_written)
         self._count_finish(req.finish_reason)
         tracer = tracing.get_tracer()
         pc0 = getattr(req, "_pc_submit", None)
@@ -560,7 +849,7 @@ class InferenceEngine:
                 prompt_tokens=len(req.prompt_tokens),
                 new_tokens=len(req.out_tokens),
                 finish_reason=req.finish_reason)
-        bstats = self.blocks.stats()
+        bstats = st.blocks.stats()
         tpot = req.tpot_secs()
         record = {
             "kind": "serve", "event": "request_done",
@@ -569,7 +858,7 @@ class InferenceEngine:
             "prompt_tokens": len(req.prompt_tokens),
             "cached_prompt_tokens": req.cached_prompt_tokens,
             "prefill_computed_tokens":
-                len(req.prompt_tokens) - req.cached_prompt_tokens,
+                max(len(req.prompt_tokens) - req.cached_prompt_tokens, 0),
             "new_tokens": len(req.out_tokens),
             "decode_tokens": req.decode_tokens,
             "finish_reason": req.finish_reason,
@@ -610,6 +899,7 @@ class InferenceEngine:
         ``tracing.RecompileDetector.mark_steady()`` — after this, serving
         arbitrary requests triggers zero compiles."""
         assert self._thread is None, "warm up before start()"
+        st = self._st
         prompt = [1] * min(self.config.prefill_chunk + 1,
                            max(self.config.max_model_len - 4, 1))
         req = Request(prompt, SamplingParams(max_new_tokens=3,
@@ -618,14 +908,14 @@ class InferenceEngine:
         self.queue.put(req)
         deadline = time.monotonic() + 300.0
         while req.state != RequestState.DONE:
-            if not self.step():
+            if not self.step(st):
                 break
             if time.monotonic() > deadline:
                 raise TimeoutError("engine warmup did not converge")
         # compile the copy-on-write page copy (garbage -> garbage is a
         # no-op) so a later COW event can't trip the recompile detector
-        self._pages = self._cow_copy(self._pages, np.int32(0), np.int32(0))
-        jax.block_until_ready(self._pages[0])
+        st.pages = self._cow_copy(st.pages, np.int32(0), np.int32(0))
+        jax.block_until_ready(st.pages[0])
         self.warmed_up = True
         tracing.instant("engine_warm", "serve")
 
@@ -656,5 +946,7 @@ class InferenceEngine:
             "finished": dict(self.finished),
             "warmed_up": self.warmed_up,
             "paged_kernel": self.paged_kernel,
+            "engine_restarts": self.engine_restarts,
+            "slots_evicted_nonfinite": self.slots_evicted_nonfinite,
         })
         return s

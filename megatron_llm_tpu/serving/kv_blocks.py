@@ -213,6 +213,19 @@ class BlockManager:
         with self._lock:
             return self._slot_cached.get(slot, 0)
 
+    def slot_releasable_blocks(self, slot: int) -> int:
+        """How many blocks ``free(slot)`` would actually return to the
+        allocatable set (free list or LRU): blocks this slot owns solely.
+        Shared-prefix pages (refcount > 1) stay pinned by their other
+        owners, so they don't count — the preemption victim picker uses
+        this to avoid evicting a request whose pages are mostly shared
+        and would free nothing."""
+        with self._lock:
+            blocks = self._slot_blocks.get(slot)
+            if blocks is None:
+                return 0
+            return sum(1 for b in blocks if self._refcounts.get(b, 1) <= 1)
+
     def _commit_locked(self, blocks: List[int],
                        token_ids: Sequence[int], n_written: int) -> None:
         """Register every fully written, not-yet-registered block under

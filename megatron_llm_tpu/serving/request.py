@@ -27,6 +27,7 @@ FINISH_STOP = "stop"            # eod / extra stop id / stop bigram
 FINISH_DEADLINE = "deadline"    # per-request deadline exceeded
 FINISH_ERROR = "error"
 FINISH_ABORTED = "aborted"      # engine shutdown / client gone
+FINISH_NONFINITE = "nonfinite"  # slot evicted by the non-finite sentinel
 
 
 class QueueFull(Exception):
@@ -122,6 +123,7 @@ class Request:
         self.decode_amortized_secs = 0.0    # share of batched decode steps
         self.stream_write_secs = 0.0
         self.decode_tokens = 0
+        self.preempt_count = 0          # pool-pressure preemptions survived
         self._done = threading.Event()
         self._events: Optional[queue.Queue] = queue.Queue() if stream \
             else None
@@ -152,6 +154,25 @@ class Request:
         return (self.deadline is not None
                 and (now if now is not None else time.monotonic())
                 > self.deadline)
+
+    def context_tokens(self) -> List[int]:
+        """Prompt plus everything generated so far — what a re-admission
+        after preemption must prefill over so the generation continues
+        exactly where it stopped (already-emitted tokens are never
+        re-emitted; greedy continuations are token-identical)."""
+        return self.prompt_tokens + self.out_tokens
+
+    def reset_for_requeue(self) -> None:
+        """Return a running request to the QUEUED state after a
+        preemption or engine restart.  Generated tokens are kept (they
+        were already streamed / will be part of the final result); the
+        slot binding and prefill progress are dropped so re-admission
+        prefills over ``context_tokens()`` from scratch (hitting its own
+        just-registered prefix pages when the cache is on)."""
+        self.state = RequestState.QUEUED
+        self.slot = None
+        self.prefill_pos = 0
+        self.preempt_count += 1
 
     # -- client side ----------------------------------------------------
 
@@ -239,6 +260,14 @@ class RequestQueue:
     def pop(self) -> Optional[Request]:
         with self._lock:
             return self._items.pop(0) if self._items else None
+
+    def put_front(self, request: Request) -> None:
+        """Requeue at the head, jumping the FIFO — preemption victims and
+        restart-interrupted requests go back first so they are not
+        starved by traffic that arrived after them.  Deliberately exempt
+        from the depth bound: the request was already admitted once."""
+        with self._lock:
+            self._items.insert(0, request)
 
     def peek(self) -> Optional[Request]:
         with self._lock:

@@ -72,6 +72,10 @@ class Backend:
         self.dead_until = 0.0       # monotonic; breaker cooldown end
         self.dead_marks = 0         # times the breaker tripped
         self.last_health_ok: Optional[float] = None
+        # replica answered /health with status "draining": it is ALIVE
+        # (no breaker involvement, in-flight streams keep relaying) but
+        # must receive no new dispatches until it reports "ok" again
+        self.draining = False
 
     def available(self, fail_threshold: int,
                   now: Optional[float] = None) -> bool:
@@ -94,6 +98,7 @@ class Backend:
             "cooldown_remaining_secs": round(
                 max(self.dead_until - now, 0.0), 3),
             "dead_marks": self.dead_marks,
+            "draining": int(self.draining),
         }
 
 
@@ -206,6 +211,7 @@ class ReplicaRouter:
         self._lock = threading.Lock()
         self.requests_total = 0
         self.failovers_total = 0
+        self.mid_stream_failures_total = 0
         self.throttled_total = 0
         self.no_backend_total = 0
         self.affinity_hits = 0
@@ -215,11 +221,14 @@ class ReplicaRouter:
     # -- candidate selection --------------------------------------------
 
     def _candidates(self, affinity_key: Optional[str]) -> List[Backend]:
-        """Live backends, sticky replica first, rest least-loaded."""
+        """Live backends, sticky replica first, rest least-loaded.
+        Draining replicas are alive but excluded — they are finishing
+        their in-flight work on the way to a clean exit."""
         now = time.monotonic()
         with self._lock:
             live = [b for b in self.backends
-                    if b.available(self.fail_threshold, now)]
+                    if b.available(self.fail_threshold, now)
+                    and not b.draining]
             live.sort(key=lambda b: (b.in_flight, b.requests))
             sticky = (self._affinity.get(affinity_key)
                       if affinity_key else None)
@@ -420,7 +429,31 @@ class ReplicaRouter:
             def relay(resp=resp, conn=conn, b=b) -> Iterator[bytes]:
                 try:
                     while True:
-                        chunk = resp.read(1024)
+                        try:
+                            chunk = resp.read(1024)
+                        except (OSError, http.client.HTTPException) as e:
+                            # replica died after the first byte: too late
+                            # to fail over (a replay could diverge), so
+                            # flush whatever made it out of the replica,
+                            # then close the stream with a well-formed SSE
+                            # error event and let the breaker see it
+                            partial = getattr(e, "partial", b"")
+                            if partial:
+                                yield partial
+                            self._record_failure(b)
+                            with self._lock:
+                                self.mid_stream_failures_total += 1
+                            if tracer is not None:
+                                tracer.instant(
+                                    "mid_stream_failure", "serve",
+                                    trace=trace_id, backend=b.url)
+                            payload = json.dumps({
+                                "message": "replica died mid-stream",
+                                "backend": b.url,
+                                "trace_id": trace_id})
+                            yield ("event: error\ndata: "
+                                   + payload + "\n\n").encode()
+                            break
                         if not chunk:
                             break
                         yield chunk
@@ -452,24 +485,40 @@ class ReplicaRouter:
     def probe_once(self) -> int:
         """Probe every backend's /health; returns the live count.  A
         success closes the breaker immediately, a failure counts toward
-        it — so replicas revive without waiting for client traffic."""
+        it — so replicas revive without waiting for client traffic.
+
+        The body distinguishes *draining* from *dead*: a replica
+        answering 200 with ``{"status": "draining"}`` is healthy (no
+        breaker count, in-flight streams keep relaying) but is skipped
+        for new dispatches until it reports ``"ok"`` again."""
         alive = 0
         for b in self.backends:
+            status_field = None
             try:
                 conn = self._open(b, "GET", "/health", None,
                                   timeout=min(self.request_timeout_secs,
                                               5.0))
                 resp = conn.getresponse()
-                resp.read()
+                raw = resp.read()
                 ok = resp.status == 200
                 conn.close()
+                if ok:
+                    try:
+                        status_field = json.loads(raw or b"{}").get(
+                            "status")
+                    except ValueError:
+                        status_field = None
             except (OSError, http.client.HTTPException):
                 ok = False
             if ok:
                 b.last_health_ok = time.monotonic()
+                b.draining = status_field == "draining"
                 self._record_success(b)
                 alive += 1
             else:
+                # an unreachable replica is dead, not draining — the
+                # breaker owns it from here
+                b.draining = False
                 self._record_failure(b)
         return alive
 
@@ -508,8 +557,11 @@ class ReplicaRouter:
         return {
             "backends_total": len(self.backends),
             "backends_alive": self.alive_count(),
+            "backends_draining": sum(int(b.draining)
+                                     for b in self.backends),
             "requests_total": self.requests_total,
             "failovers_total": self.failovers_total,
+            "mid_stream_failures_total": self.mid_stream_failures_total,
             "throttled_total": self.throttled_total,
             "no_backend_total": self.no_backend_total,
             "affinity_hits": self.affinity_hits,
@@ -681,6 +733,8 @@ class RouterServer:
                     self._send_json(code, {
                         "status": "ok" if alive > 0 else "no_backends",
                         "backends_alive": alive,
+                        "backends_draining": sum(
+                            int(b.draining) for b in router.backends),
                         "backends_total": len(router.backends)})
                 elif self.path == "/metrics" \
                         or self.path.startswith("/metrics?"):

@@ -16,8 +16,19 @@ thread runs next:
 
 Admission is capacity-reserving: a request only leaves the queue when a
 slot AND its worst-case block count (prompt + max_new_tokens) are both
-free (kv_blocks.py), so an admitted request can always run to
-completion — no preemption paths.
+free (kv_blocks.py), so an admitted request can normally run to
+completion.  When the pool is deliberately oversubscribed
+(``--serve_num_blocks`` below full backing) the head of the queue can
+still starve behind a long-running reservation; ``select_victim`` /
+``preempt`` give the engine a pool-pressure escape hatch: the victim's
+pages go back to the :class:`BlockManager` (registered in the prefix
+cache so re-admission re-adopts them) and the victim requeues at the
+queue head with its generated tokens intact — re-admission prefills
+over ``Request.context_tokens()`` and the generation continues exactly
+where it stopped.  The victim rule is anti-livelock by construction: a
+victim's worst-case block need must be *strictly greater* than the
+head's, so a requeued victim can never immediately preempt the request
+admitted in its place.
 """
 
 from __future__ import annotations
@@ -46,6 +57,7 @@ class Scheduler:
         self.admitted = 0
         self.rejected_len = 0
         self.deadline_evictions = 0
+        self.preemptions = 0
 
     # -- admission ------------------------------------------------------
 
@@ -83,8 +95,11 @@ class Scheduler:
                 head._finish(FINISH_DEADLINE)
                 continue
             try:
+                # prefix-match over the full context (prompt + anything
+                # generated before a preemption) so a requeued victim
+                # re-adopts its own just-registered pages
                 slot = self.blocks.alloc(self.total_tokens(head),
-                                         prompt_tokens=head.prompt_tokens)
+                                         prompt_tokens=head.context_tokens())
             except (NoCapacity, ValueError):
                 break
             self.queue.pop()
@@ -98,6 +113,52 @@ class Scheduler:
             self.admitted += 1
             admitted.append(head)
         return admitted
+
+    # -- pool-pressure preemption ---------------------------------------
+
+    def select_victim(self, head: Request) -> Optional[Request]:
+        """The running request to evict so ``head`` can be admitted, or
+        None when preemption cannot help.
+
+        Eligibility: the victim's worst-case block need must be strictly
+        greater than the head's (anti-livelock — the need of the request
+        occupying the freed capacity strictly decreases, so a requeued
+        victim can never turn around and preempt its replacement), and
+        releasing it must actually make the head allocatable (shared
+        prefix pages stay pinned by their other owners and free
+        nothing).  Among eligible victims: fewest generated tokens
+        (least work thrown away), tie broken youngest."""
+        stats = self.blocks.stats()
+        avail = stats["blocks_free"] + stats["blocks_cached_reusable"]
+        need_head = self.blocks.blocks_needed(self.total_tokens(head))
+        best: Optional[Request] = None
+        for r in self.active.values():
+            if r.state not in (RequestState.PREFILL, RequestState.DECODE):
+                continue
+            if (self.blocks.blocks_needed(self.total_tokens(r))
+                    <= need_head):
+                continue
+            if r.slot is None or (
+                    avail + self.blocks.slot_releasable_blocks(r.slot)
+                    < need_head):
+                continue
+            if best is None or (
+                    (len(r.out_tokens), -r.t_submit)
+                    < (len(best.out_tokens), -best.t_submit)):
+                best = r
+        return best
+
+    def preempt(self, req: Request, token_ids=None,
+                n_written: int = 0) -> None:
+        """Bookkeeping half of a preemption (the engine clears the
+        per-slot device rows first): release the victim's slot and
+        pages — registering the written history so re-admission hits the
+        prefix cache — and requeue it at the queue head, generated
+        tokens intact."""
+        self.evict(req, token_ids=token_ids, n_written=n_written)
+        req.reset_for_requeue()
+        self.queue.put_front(req)
+        self.preemptions += 1
 
     # -- step selection -------------------------------------------------
 
@@ -169,5 +230,6 @@ class Scheduler:
             "admitted_total": self.admitted,
             "rejected_len_total": self.rejected_len,
             "deadline_evictions_total": self.deadline_evictions,
+            "preemptions": self.preemptions,
         })
         return s

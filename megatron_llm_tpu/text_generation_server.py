@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -73,6 +74,7 @@ class ServerMetrics:
         self.errors = 0
         self.throttled = 0          # 429s (admission control)
         self.streamed = 0           # SSE requests served
+        self.drained = 0            # graceful-drain initiations
         self.tokens_generated = 0
         self.engine_stats_fn = None  # set when an engine is attached
         # SLO histograms over the full serving lifetime (the bounded
@@ -129,6 +131,7 @@ class ServerMetrics:
                 "errors": self.errors,
                 "throttled": self.throttled,
                 "streamed": self.streamed,
+                "drained": self.drained,
                 "tokens_generated": self.tokens_generated,
             }
         out["latency_p50_secs"] = self._percentile(lat, 0.50) if lat else None
@@ -404,6 +407,13 @@ class MegatronGenerate:
             if r.finish_reason == "deadline":
                 return 503, {"message": "request deadline exceeded "
                                         "before completion"}
+            if r.finish_reason == "nonfinite":
+                # slot-level fault isolation (engine non-finite
+                # sentinel): this request's slot produced NaN/inf logits
+                # and was evicted; its batch-mates were untouched
+                return 500, {"message": r.error or "non-finite logits "
+                                                   "detected; slot evicted",
+                             "finish_reason": "nonfinite"}
             row = r.tokens
             tokens.append(row)
             texts.append(self.tokenizer.detokenize(row))
@@ -463,7 +473,8 @@ class MegatronServer:
 
     def __init__(self, model, params, tokenizer, int8_kv_cache=False,
                  engine=None, log_requests=False,
-                 max_prompts=None, max_tokens=None):
+                 max_prompts=None, max_tokens=None,
+                 drain_timeout_secs: float = 600.0):
         self.generator = MegatronGenerate(
             model, params, tokenizer, int8_kv_cache=int8_kv_cache,
             engine=engine, log_requests=log_requests,
@@ -474,10 +485,67 @@ class MegatronServer:
             # every retired request feeds the SLO histograms, whether it
             # arrived over HTTP or was submitted in-process
             engine.request_done_hook = self.metrics.observe_request_done
+        # graceful drain (SIGTERM / POST /drain): admission answers 503,
+        # /health reports "draining" (the router stops dispatching
+        # WITHOUT tripping its breaker), in-flight work finishes, then
+        # the process exits cleanly
+        self.draining = False
+        self.drain_timeout_secs = float(drain_timeout_secs)
+        self._drain_lock = threading.Lock()
+        self._in_flight = 0
+        self._in_flight_lock = threading.Lock()
+        self.httpd = None
+
+    def _track(self, delta: int) -> None:
+        with self._in_flight_lock:
+            self._in_flight += delta
+
+    def begin_drain(self, reason: str = "signal") -> bool:
+        """Flip into draining mode and hand off to the waiter thread.
+        Idempotent: the first call wins, later ones return False.  Safe
+        to call from a signal handler (nothing here blocks)."""
+        with self._drain_lock:
+            if self.draining:
+                return False
+            self.draining = True
+        self.metrics.drained += 1
+        try:
+            from megatron_llm_tpu.telemetry import get_stream
+            stream = get_stream()
+            if stream is not None:
+                stream.emit({"kind": "serve", "event": "drain",
+                             "reason": reason})
+        except Exception:
+            pass
+        print(f" * draining ({reason}): admission closed, finishing "
+              f"in-flight work", flush=True)
+        threading.Thread(target=self._drain_and_exit, name="drain-waiter",
+                         daemon=True).start()
+        return True
+
+    def _drain_and_exit(self) -> None:
+        engine = self.generator.engine
+        deadline = time.monotonic() + self.drain_timeout_secs
+        while time.monotonic() < deadline:
+            with self._in_flight_lock:
+                busy = self._in_flight > 0
+            if engine is not None and not busy:
+                busy = engine.scheduler.has_work()
+            if not busy:
+                break
+            time.sleep(0.05)
+        if engine is not None:
+            try:
+                engine.stop()
+            except Exception:
+                pass
+        if self.httpd is not None:
+            self.httpd.shutdown()   # run() returns; process exits cleanly
 
     def run(self, host: str = "0.0.0.0", port: int = 5000):
         generator = self.generator
         metrics = self.metrics
+        outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def _send_json(self, code: int, body: dict,
@@ -488,11 +556,21 @@ class MegatronServer:
                 self.send_header("Content-Length", str(len(data)))
                 if trace_id:
                     self.send_header(TRACE_HEADER, trace_id)
-                if code == 429:
+                if code == 429 or (code == 503
+                                   and "retry_after_secs" in body):
                     self.send_header("Retry-After", str(max(int(
                         body.get("retry_after_secs", 1)), 1)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _reject_draining(self, trace_id=None) -> bool:
+                if not outer.draining:
+                    return False
+                self._send_json(503, {
+                    "message": "server draining; retry another replica",
+                    "draining": True,
+                    "retry_after_secs": 1}, trace_id=trace_id)
+                return True
 
             def _read_payload(self):
                 n = int(self.headers.get("Content-Length", 0))
@@ -505,6 +583,13 @@ class MegatronServer:
                 return self.headers.get(TRACE_HEADER) or new_trace_id()
 
             def do_PUT(self):
+                if self.path == "/drain":
+                    # operator-initiated graceful drain (the runbook
+                    # alternative to SIGTERM, works through port-forwards)
+                    started = outer.begin_drain("http")
+                    self._send_json(200, {"status": "draining",
+                                          "started": bool(started)})
+                    return
                 if self.path in ("/api/stream", "/generate/stream"):
                     self._do_stream()
                     return
@@ -513,13 +598,21 @@ class MegatronServer:
                     return
                 t0 = time.perf_counter()
                 trace_id = self._trace_id()
+                if self._reject_draining(trace_id=trace_id):
+                    metrics.observe(time.perf_counter() - t0, 503)
+                    return
                 try:
                     payload = self._read_payload()
                 except (ValueError, json.JSONDecodeError):
                     metrics.observe(time.perf_counter() - t0, 400)
                     self.send_error(400, "invalid JSON")
                     return
-                code, body = generator.handle(payload, trace_id=trace_id)
+                outer._track(+1)
+                try:
+                    code, body = generator.handle(payload,
+                                                  trace_id=trace_id)
+                finally:
+                    outer._track(-1)
                 metrics.observe(time.perf_counter() - t0, code,
                                 tokens=(_count_tokens(body)
                                         if code == 200 else 0))
@@ -528,6 +621,9 @@ class MegatronServer:
             def _do_stream(self):
                 t0 = time.perf_counter()
                 trace_id = self._trace_id()
+                if self._reject_draining(trace_id=trace_id):
+                    metrics.observe(time.perf_counter() - t0, 503)
+                    return
                 try:
                     payload = self._read_payload()
                 except (ValueError, json.JSONDecodeError):
@@ -547,6 +643,7 @@ class MegatronServer:
                 self.send_header(TRACE_HEADER, trace_id)
                 self.end_headers()
                 n_tokens = 0
+                outer._track(+1)
                 try:
                     for ev in events:
                         if "token" in ev:
@@ -557,6 +654,8 @@ class MegatronServer:
                         self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
                     pass        # client went away mid-stream
+                finally:
+                    outer._track(-1)
                 metrics.observe(time.perf_counter() - t0, 200,
                                 tokens=n_tokens, streamed=True)
 
@@ -582,10 +681,16 @@ class MegatronServer:
                     self.wfile.write(data)
                 elif self.path == "/health":
                     # liveness: the server thread answers => alive (a
-                    # generation may still hold the model lock)
-                    self._send_json(200, {"status": "ok",
-                                          "uptime_secs": time.time()
-                                          - metrics.started_unix})
+                    # generation may still hold the model lock).  While
+                    # draining the answer stays 200 — the replica is
+                    # healthy, just finishing up — and the router reads
+                    # the status body to stop dispatching here without
+                    # tripping its circuit breaker.
+                    self._send_json(200, {
+                        "status": ("draining" if outer.draining
+                                   else "ok"),
+                        "uptime_secs": time.time()
+                        - metrics.started_unix})
                 elif self.path == "/metrics" \
                         or self.path.startswith("/metrics?"):
                     snap = metrics.snapshot()
@@ -610,6 +715,15 @@ class MegatronServer:
         server = ThreadingHTTPServer((host, port), Handler)
         # exposed for tests / embedding (port may be ephemeral: port=0)
         self.httpd = server
+        # SIGTERM -> graceful drain (orchestrators send SIGTERM before
+        # SIGKILL; signal handlers only install from the main thread —
+        # embedded/test servers run() from a worker and rely on /drain)
+        if threading.current_thread() is threading.main_thread():
+            try:
+                signal.signal(signal.SIGTERM,
+                              lambda *_: self.begin_drain("SIGTERM"))
+            except (ValueError, OSError):
+                pass
         print(f" * serving on http://{host}:{server.server_address[1]}/"
               f" (demo page) and /api", flush=True)
         server.serve_forever()

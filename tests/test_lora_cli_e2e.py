@@ -37,7 +37,11 @@ def test_lora_cli_train_and_merged_checkpoint(tmp_path):
     assert "LoRA rank 2" in r.stdout
     losses = [float(m) for m in re.findall(r"lm loss: ([0-9.E+-]+)",
                                            r.stdout)]
-    assert len(losses) >= 8 and losses[-1] < losses[0], losses
+    assert len(losses) >= 8, losses
+    # 8 iters of a rank-2 adapter moves the loss by ~1e-2 — comparable to
+    # per-step noise, so last-vs-first flakes.  Compare window means: the
+    # trend survives the noise.
+    assert (sum(losses[-4:]) / 4) < (sum(losses[:4]) / 4), losses
 
     # the exported checkpoint is MERGED: a plain non-LoRA run loads it
     r2 = _run(["--train_iters=2", f"--load={ck}", "--finetune",

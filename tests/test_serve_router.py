@@ -9,6 +9,7 @@ engine subprocesses behind the router — aggregate throughput vs one
 replica, and SIGKILL failover with zero dropped in-flight requests.
 """
 
+import io
 import json
 import os
 import signal
@@ -47,14 +48,16 @@ class _Stub:
 
     def __init__(self, name: str, sleep: float = 0.0,
                  throttle_body=None, serial: bool = False,
-                 metrics_extra=None):
+                 metrics_extra=None, stream_die: bool = False):
         self.name = name
         self.sleep = sleep
         self.throttle_body = throttle_body
         self.metrics_extra = metrics_extra or {}
+        self.stream_die = stream_die
         self.hits = []
         self.trace_headers = []
         self.healthy = True
+        self.draining = False
         lock = threading.Lock()
         stub = self
 
@@ -79,6 +82,34 @@ class _Stub:
                     self._json(429, stub.throttle_body)
                     return
                 if self.path == "/api/stream":
+                    if stub.stream_die:
+                        # chunked framing so the client can tell an abrupt
+                        # close from a normal end-of-body: first event goes
+                        # out, then the socket dies without the terminating
+                        # 0-length chunk (models a replica crashing after
+                        # the first byte of a stream)
+                        self.protocol_version = "HTTP/1.1"
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/event-stream")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        ev = {"token": 1, "segment": "1"}
+                        payload = (b"data: " + json.dumps(ev).encode()
+                                   + b"\n\n")
+                        self.wfile.write(b"%x\r\n" % len(payload)
+                                         + payload + b"\r\n")
+                        self.wfile.flush()
+                        try:
+                            self.connection.shutdown(socket.SHUT_RDWR)
+                            self.connection.close()
+                        except OSError:
+                            pass
+                        # let finish() flush/close harmlessly
+                        self.wfile = io.BytesIO()
+                        self.rfile = io.BytesIO()
+                        self.close_connection = True
+                        return
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.end_headers()
@@ -101,7 +132,8 @@ class _Stub:
             def do_GET(self):
                 if self.path == "/health":
                     self._json(200 if stub.healthy else 503,
-                               {"status": "ok"})
+                               {"status": "draining" if stub.draining
+                                else "ok"})
                 elif self.path.startswith("/metrics"):
                     engine = {"tokens_generated": 10, "queue_depth": 1}
                     body = {"requests": len(stub.hits), "engine": engine}
@@ -432,6 +464,64 @@ def test_stream_failover_before_first_byte_keeps_trace_id(stubs):
     rs = next(attrs for ph, name, attrs in tracer.events
               if name == "route_stream")
     assert rs["trace"] == tid and rs["attempts"] == 2
+
+
+def test_health_probe_distinguishes_draining_from_dead(stubs):
+    """Resilience satellite: a replica answering /health with
+    ``{"status": "draining"}`` is alive (no breaker involvement) but
+    receives no new dispatches until it reports ``ok`` again."""
+    a, b = stubs("a"), stubs("b")
+    router = ReplicaRouter([a.url, b.url], fail_threshold=2,
+                           health_interval_secs=999)
+    a.draining = True
+    assert router.probe_once() == 2            # draining is NOT dead
+    ba = router.backends[0]
+    assert ba.draining and not router.backends[1].draining
+    assert ba.consecutive_failures == 0        # breaker untouched
+    assert ba.available(router.fail_threshold)
+    # new work all lands on the non-draining replica
+    for i in range(3):
+        status, _, data = router.dispatch("PUT", "/api",
+                                          _payload(f"{i} 1"))
+        assert status == 200
+        assert json.loads(data)["backend"] == "b"
+    assert not a.hits
+    snap = router.snapshot()
+    assert snap["backends_draining"] == 1
+    assert snap["backends"]["backend_0"]["draining"] == 1
+    # drain finished (replica restarted, reports ok): back in rotation
+    a.draining = False
+    router.probe_once()
+    assert not router.backends[0].draining
+
+
+def test_mid_stream_replica_death_yields_sse_error_event(stubs):
+    """Resilience satellite: a replica dying AFTER the first streamed
+    byte cannot be failed over (a replay could diverge) — the client
+    must see a well-formed SSE ``event: error`` frame, and the failure
+    must feed the breaker + mid-stream counter."""
+    dying = stubs("dying", stream_die=True)
+    tracer = _RecordingTracer()
+    router = ReplicaRouter([dying.url], fail_threshold=2,
+                           health_interval_secs=999, tracer=tracer)
+    tid = "0123456789abcdef"
+    status, headers, body_iter = router.dispatch_stream(
+        "PUT", "/api/stream", _payload("4 5"), trace_id=tid)
+    assert status == 200
+    body = b"".join(body_iter)                 # never raises to client
+    assert body.startswith(b"data: ")          # first byte got out
+    assert b"event: error\ndata: " in body
+    err = json.loads(body.split(b"event: error\ndata: ")[1]
+                     .split(b"\n\n")[0])
+    assert err["trace_id"] == tid
+    assert err["backend"].endswith(dying.url)   # normalized w/ scheme
+    assert "died mid-stream" in err["message"]
+    assert router.mid_stream_failures_total == 1
+    assert router.snapshot()["mid_stream_failures_total"] == 1
+    # the failure attempt is recorded against the backend
+    assert router.backends[0].consecutive_failures >= 1
+    assert any(name == "mid_stream_failure"
+               for _, name, _ in tracer.events)
 
 
 def test_aggregated_metrics_passes_through_non_numeric(stubs):

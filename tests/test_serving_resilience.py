@@ -1,0 +1,619 @@
+"""Serving resilience (serving/resilience.py + engine/server/router
+wiring): deterministic fault injection, slot-level non-finite isolation,
+watchdog restart + requeue, pool-pressure preemption, graceful drain,
+and the extended zero-recompile guard with the whole stack armed.
+
+Fast tier (``chaos`` marker, tier-1): injector grammar, watchdog unit,
+and single-engine chaos against the tiny llama.
+
+Slow tier (``chaos`` + ``slow``): 2-replica fleet e2e — NaN injection +
+watchdog restart on one replica behind the router, every request
+finishing exactly once, then an HTTP-driven graceful drain to a clean
+process exit.
+"""
+
+import json
+import os
+import queue
+import re
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from megatron_llm_tpu import tracing
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+from megatron_llm_tpu.serving import (
+    EngineConfig,
+    EngineWatchdog,
+    InferenceEngine,
+    SamplingParams,
+    ServingFaultInjector,
+)
+from megatron_llm_tpu.serving.request import EngineError
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# fault-spec grammar + one-shot hook semantics (pure host-side)
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parse_grammar():
+    assert ServingFaultInjector.from_spec("") is None
+    assert ServingFaultInjector.from_spec("   ") is None
+    inj = ServingFaultInjector.from_spec("nan@12,hang@30:5,slow@7:250,oom@3")
+    assert inj.nan_at == 12
+    assert inj.hang_at == 30 and inj.hang_secs == 5.0
+    assert inj.slow_at == 7 and inj.slow_ms == 250.0
+    assert inj.oom_at == 3
+    # defaults when the optional suffix is omitted
+    assert ServingFaultInjector.from_spec("hang@9").hang_secs == 30.0
+    with pytest.raises(ValueError, match="grammar"):
+        ServingFaultInjector.from_spec("nuke@5")
+
+
+def test_fault_hooks_fire_exactly_once():
+    inj = ServingFaultInjector.from_spec("nan@3,oom@2,slow@1:1")
+    assert not inj.poison_nonfinite(2)       # before the armed index
+    assert inj.poison_nonfinite(5)           # first check at-or-after
+    assert not inj.poison_nonfinite(5)       # disarmed after firing
+    assert not inj.maybe_oom(1)
+    assert inj.maybe_oom(2)
+    assert not inj.maybe_oom(99)
+    inj.before_dispatch(1)                   # 1ms slow window, consumed
+    assert inj.slow_at is None
+
+
+def test_watchdog_fires_rearms_and_gates_on_idle():
+    fires = []
+    lines = []
+    busy = {"v": True}
+    wd = EngineWatchdog(0.15, has_work=lambda: busy["v"],
+                        on_fire=lambda: fires.append(time.monotonic()),
+                        printer=lines.append)
+    wd.start()
+    try:
+        deadline = time.monotonic() + 20.0
+        while len(fires) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # re-armable: after a fire (and the engine "restart") it keeps
+        # watching and fires again on the next stall
+        assert wd.fires >= 2
+        assert any("restarting the engine" in ln for ln in lines)
+        # idle gate: an engine with no work makes no progress by design
+        busy["v"] = False
+        time.sleep(0.1)                      # let the poller see idle
+        n = wd.fires
+        time.sleep(0.5)
+        assert wd.fires == n
+    finally:
+        wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine-level chaos (tiny model)
+# ---------------------------------------------------------------------------
+
+class _FakeTokenizer:
+    vocab_size = 64
+    eod = 63
+    pad = 0
+
+    def tokenize(self, text):
+        return [int(t) % 64 for t in text.split()]
+
+    def detokenize(self, ids):
+        return " ".join(str(i) for i in ids)
+
+
+GREEDY = dict(temperature=0.0, eod_id=63)
+PROMPT_A = [5, 6, 7, 8, 9]
+PROMPT_B = [1, 2, 3]
+PROMPT_LONG = [9, 8, 7, 6]
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = llama_config("tiny", num_layers=2, seq_length=64,
+                       max_position_embeddings=64, padded_vocab_size=64,
+                       use_flash_attn=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _make_engine(model_and_params, **overrides):
+    model, params = model_and_params
+    kw = dict(num_slots=4, block_size=8, prefill_chunk=16,
+              max_model_len=64, max_queue_depth=32,
+              default_deadline_secs=0.0)
+    kw.update(overrides)
+    eng = InferenceEngine(model, params, EngineConfig(**kw))
+    eng.warmup()
+    eng.start()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def baselines(model_and_params):
+    """Greedy tokens from a clean engine (no faults, full backing) — the
+    identity reference for every chaos run below."""
+    eng = _make_engine(model_and_params)
+    try:
+        out = {}
+        for key, prompt, n in (("a", PROMPT_A, 12), ("b", PROMPT_B, 12),
+                               ("long", PROMPT_LONG, 40)):
+            r = eng.submit(prompt, SamplingParams(max_new_tokens=n,
+                                                  **GREEDY))
+            out[key] = r.result(timeout=180).tokens
+    finally:
+        eng.stop()
+    return out
+
+
+def test_nonfinite_sentinel_isolates_poisoned_slot(model_and_params,
+                                                   baselines):
+    """Acceptance: the poisoned slot alone is evicted with a structured
+    ``nonfinite`` failure; its batch-mate decodes token-identically to
+    an uninjected run (the injection flips only the fetched host flag,
+    so identity holds by construction — this guards the eviction path
+    against collateral damage)."""
+    eng = _make_engine(model_and_params)
+    try:
+        # armed post-warmup: indices land mid-batch deterministically
+        eng.fault_injector = ServingFaultInjector(
+            nan_at=eng._dispatches + 4)
+        sp = SamplingParams(max_new_tokens=12, **GREEDY)
+        ra, rb = eng.submit_many([PROMPT_A, PROMPT_B], [sp, sp])
+        ra.result(timeout=180)
+        rb.result(timeout=180)
+        poisoned = [r for r in (ra, rb) if r.finish_reason == "nonfinite"]
+        assert len(poisoned) == 1, (ra.finish_reason, rb.finish_reason)
+        assert "non-finite" in poisoned[0].error
+        survivor = rb if poisoned[0] is ra else ra
+        assert survivor.finish_reason in ("stop", "length")
+        assert survivor.tokens == \
+            baselines["b" if survivor is rb else "a"]
+        assert eng.slots_evicted_nonfinite == 1
+        assert eng.stats()["slots_evicted_nonfinite"] == 1
+        eng.blocks.check_invariants()
+    finally:
+        eng.stop()
+
+
+def test_watchdog_restart_requeues_and_completes(model_and_params,
+                                                 baselines):
+    """A hang trips the watchdog; the engine restarts in-process and the
+    interrupted (pre-first-byte) requests requeue at the queue head and
+    finish token-identically — re-admission prefills over the full
+    context, so a greedy continuation cannot diverge."""
+    eng = _make_engine(model_and_params, watchdog_secs=0.4,
+                       restart_backoff_secs=0.0)
+    try:
+        eng.fault_injector = ServingFaultInjector(
+            hang_at=eng._dispatches + 3, hang_secs=4.0)
+        sp = SamplingParams(max_new_tokens=12, **GREEDY)
+        ra, rb = eng.submit_many([PROMPT_A, PROMPT_B], [sp, sp])
+        ra.result(timeout=180)
+        rb.result(timeout=180)
+        assert eng.engine_restarts >= 1
+        assert eng.stats()["engine_restarts"] >= 1
+        assert ra.finish_reason in ("stop", "length")
+        assert rb.finish_reason in ("stop", "length")
+        assert ra.tokens == baselines["a"]
+        assert rb.tokens == baselines["b"]
+        eng.blocks.check_invariants()
+    finally:
+        eng.stop()
+
+
+def test_restart_fails_midstream_requests_cleanly(model_and_params):
+    """A streamed request that already produced bytes cannot be silently
+    replayed (the client would see duplicate tokens) — a restart fails
+    it with a structured error instead."""
+    eng = _make_engine(model_and_params, restart_backoff_secs=0.0)
+    try:
+        # wedge the engine right after the stream's first tokens so the
+        # request is deterministically mid-flight when restart() runs
+        inj = ServingFaultInjector(hang_at=eng._dispatches + 5,
+                                   hang_secs=8.0)
+        eng.fault_injector = inj
+        r = eng.submit(PROMPT_A,
+                       SamplingParams(max_new_tokens=24, **GREEDY),
+                       stream=True)
+        deadline = time.monotonic() + 60.0
+        while ((inj.hang_at is not None or r.t_first_token is None)
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert r.t_first_token is not None
+        eng.restart("test")
+        with pytest.raises(EngineError, match="restarted mid-stream"):
+            r.result(timeout=60)
+        assert r.finish_reason == "error"
+        assert eng.engine_restarts == 1
+        # the restarted engine serves fresh traffic normally
+        r2 = eng.submit(PROMPT_B,
+                        SamplingParams(max_new_tokens=4, **GREEDY))
+        assert r2.result(timeout=120).finish_reason in ("stop", "length")
+    finally:
+        eng.stop()
+
+
+def test_oom_injection_via_config_spec(model_and_params):
+    """``fault_spec`` plumbs from EngineConfig; an injected pool-OOM
+    skips one admission round and the head retries next step."""
+    eng = _make_engine(model_and_params, fault_spec="oom@1")
+    try:
+        assert eng.fault_injector is not None
+        r = eng.submit(PROMPT_B, SamplingParams(max_new_tokens=6, **GREEDY))
+        assert r.result(timeout=120).finish_reason in ("stop", "length")
+        deadline = time.monotonic() + 10.0
+        while (eng.fault_injector.oom_at is not None
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert eng.fault_injector.oom_at is None    # fired and disarmed
+    finally:
+        eng.stop()
+
+
+def test_preemption_relieves_pool_pressure(model_and_params, baselines):
+    """Acceptance: on a deliberately oversubscribed pool (6 usable pages;
+    the long request's worst-case reservation takes all of them) a small
+    request starves behind the running reservation even though slots are
+    free.  With preemption the victim releases its pages, the small
+    request runs to completion first, and the victim resumes exactly
+    where it stopped — greedy continuation token-identical to an
+    uninterrupted run."""
+    model_params = model_and_params
+
+    def run(preemption):
+        eng = _make_engine(model_params, num_blocks=7,
+                           preemption=preemption)
+        try:
+            long_r = eng.submit(PROMPT_LONG,
+                                SamplingParams(max_new_tokens=40, **GREEDY))
+            deadline = time.monotonic() + 120.0
+            while (len(long_r.out_tokens) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert len(long_r.out_tokens) >= 2
+            small = eng.submit([1, 2],
+                               SamplingParams(max_new_tokens=4, **GREEDY))
+            small.result(timeout=180)
+            t_small_done = time.monotonic()
+            long_done_first = long_r.finish_reason is not None
+            long_r.result(timeout=180)
+            eng.blocks.check_invariants()
+            return (eng.scheduler.preemptions, long_r, small,
+                    long_done_first, t_small_done)
+        finally:
+            eng.stop()
+
+    # seed behavior (preemption off): the small request is stuck behind
+    # the long reservation until the long request fully finishes
+    n_pre, long_r, small, long_first, _ = run(preemption=False)
+    assert n_pre == 0
+    assert long_first, "small admitted despite an exhausted pool?"
+    assert long_r.tokens == baselines["long"]
+
+    # preemption on: the victim yields, the small request finishes first,
+    # and the victim's continuation is token-identical
+    n_pre, long_r, small, long_first, _ = run(preemption=True)
+    assert n_pre >= 1
+    assert not long_first, "preemption never let the small request ahead"
+    assert long_r.preempt_count >= 1
+    assert small.finish_reason in ("stop", "length")
+    assert long_r.finish_reason in ("stop", "length")
+    assert long_r.tokens == baselines["long"]
+
+
+def test_resilience_stack_zero_recompiles(model_and_params):
+    """Acceptance: sentinel + armed watchdog + preemption + fault
+    injection together add ZERO steady-state compiles — the whole
+    resilience layer is host-side bookkeeping riding the already-jitted
+    programs."""
+    eng = _make_engine(model_and_params, num_blocks=7, watchdog_secs=30.0,
+                       preemption=True)
+    tracer = tracing.SpanTracer()
+    det = tracing.RecompileDetector(tracer)
+    tracing.install_tracing(tracing.Tracing(tracer=tracer, recompile=det))
+    try:
+        det.mark_steady()
+        long_r = eng.submit(PROMPT_LONG,
+                            SamplingParams(max_new_tokens=40, **GREEDY))
+        deadline = time.monotonic() + 120.0
+        while len(long_r.out_tokens) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        small = eng.submit([1, 2],
+                           SamplingParams(max_new_tokens=4, **GREEDY))
+        small.result(timeout=180)
+        eng.fault_injector = ServingFaultInjector(
+            nan_at=eng._dispatches + 2)
+        long_r.result(timeout=180)
+        # fresh traffic after the chaos (guarantees the armed NaN fires)
+        r2 = eng.submit(PROMPT_B, SamplingParams(max_new_tokens=8, **GREEDY))
+        r2.result(timeout=180)
+        assert det.recompiles == 0, \
+            f"{det.recompiles} recompiles: {list(det.events)}"
+        assert eng.scheduler.preemptions >= 1
+        assert eng.slots_evicted_nonfinite >= 1
+        eng.blocks.check_invariants()
+    finally:
+        tracing.install_tracing(None)
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain over HTTP (in-process server, real engine)
+# ---------------------------------------------------------------------------
+
+def test_graceful_drain_http_lifecycle(model_and_params):
+    """POST /drain: /health flips to ``draining`` (still 200 — the
+    replica is alive), admission answers 503 + Retry-After, in-flight
+    work finishes, and the server thread exits cleanly."""
+    from megatron_llm_tpu.text_generation_server import MegatronServer
+
+    model, params = model_and_params
+    eng = _make_engine(model_and_params)
+    server = MegatronServer(model, params, _FakeTokenizer(), engine=eng,
+                            max_prompts=4, max_tokens=32)
+    t = threading.Thread(target=server.run,
+                         kwargs={"host": "127.0.0.1", "port": 0},
+                         daemon=True)
+    t.start()
+    for _ in range(200):
+        if server.httpd is not None:
+            break
+        time.sleep(0.05)
+    assert server.httpd is not None
+    url = f"http://127.0.0.1:{server.httpd.server_address[1]}"
+    try:
+        # a backlog of in-flight engine work keeps the drain waiter busy
+        # long enough to observe the draining surface
+        sp = SamplingParams(max_new_tokens=32, **GREEDY)
+        backlog = eng.submit_many([[2, 3, 4, 1 + i] for i in range(8)],
+                                  [sp] * 8)
+        req = urllib.request.Request(url + "/drain", data=b"{}",
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+            assert resp.status == 200
+            assert body["status"] == "draining" and body["started"] is True
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert json.loads(resp.read())["started"] is False  # idempotent
+        with urllib.request.urlopen(url + "/health", timeout=30) as resp:
+            assert resp.status == 200                 # alive, not dead
+            assert json.loads(resp.read())["status"] == "draining"
+        api = urllib.request.Request(
+            url + "/api",
+            data=json.dumps({"prompts": ["1 2"],
+                             "tokens_to_generate": 2}).encode(),
+            method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(api, timeout=30)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After")
+        body = json.loads(ei.value.read())
+        assert body["draining"] is True
+        # in-flight work finishes, then the server shuts itself down
+        for r in backlog:
+            assert r.result(timeout=180).finish_reason in ("stop", "length")
+        t.join(timeout=120)
+        assert not t.is_alive(), "server did not exit after draining"
+        assert server.metrics.drained == 1
+        assert server.metrics.snapshot()["drained"] == 1
+    finally:
+        eng.stop()
+        if t.is_alive() and server.httpd is not None:
+            server.httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# slow tier: 2-replica chaos fleet e2e
+# ---------------------------------------------------------------------------
+
+def _spawn_replica(extra=(), timeout=240.0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONFAULTHANDLER="1")
+    env.pop("XLA_FLAGS", None)      # single-device child, no 8-dev mesh
+    errlog = tempfile.NamedTemporaryFile(
+        mode="w+", prefix="replica_err_", suffix=".log", delete=False)
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "_serve_replica.py"),
+         *extra],
+        stdout=subprocess.PIPE, stderr=errlog, env=env,
+        text=True, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    # readline() in the main thread would block past the deadline on a
+    # silent-but-alive child (and select() on the raw fd misses lines the
+    # TextIOWrapper already buffered), so a reader thread scans stdout and
+    # hands the port over a queue the main thread waits on with a timeout
+    portq = queue.Queue()
+
+    def _scan():
+        for line in proc.stdout:
+            # search, don't startswith: the replica's banner print can
+            # interleave with the PORT line when both threads write at once
+            m = re.search(r"PORT (\d+)", line)
+            if m:
+                portq.put(int(m.group(1)))
+                # keep draining so the child never blocks on a full pipe
+        portq.put(None)
+
+    threading.Thread(target=_scan, daemon=True).start()
+    try:
+        port = portq.get(timeout=timeout)
+    except queue.Empty:
+        port = None
+    if port is None:
+        proc.kill()
+        errlog.flush()
+        errlog.seek(0)
+        tail = errlog.read()[-3000:]
+        raise AssertionError(
+            "replica did not report a port in time; stderr tail:\n" + tail)
+    return proc, port
+
+
+@pytest.mark.slow
+def test_chaos_fleet_every_request_finishes_exactly_once():
+    """Acceptance e2e: replica A runs with NaN injection and a hang that
+    trips its watchdog; behind the router every request finishes exactly
+    once (the single injected NaN surfaces as one structured 500, the
+    watchdog restart requeues the rest to success), the fleet /metrics
+    aggregate reports ``engine_restarts >= 1`` and
+    ``slots_evicted_nonfinite >= 1``, and an HTTP-driven drain of A
+    finishes its in-flight work and exits the process cleanly while the
+    router keeps the breaker closed."""
+    from megatron_llm_tpu.serving.router import ReplicaRouter, RouterServer
+
+    pa, port_a = _spawn_replica(["--serve_fault_inject", "nan@20,hang@60:6",
+                                 "--serve_watchdog_secs", "1.0"])
+    pb, port_b = _spawn_replica()
+    srv = None
+    try:
+        router = ReplicaRouter(
+            [f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}"],
+            fail_threshold=2, cooldown_secs=5.0,
+            health_interval_secs=999,       # probed explicitly below
+            request_timeout_secs=120.0)
+        srv = RouterServer(router)
+        threading.Thread(target=srv.run,
+                         kwargs={"host": "127.0.0.1", "port": 0},
+                         daemon=True).start()
+        for _ in range(100):
+            if srv.httpd is not None:
+                break
+            time.sleep(0.05)
+        assert srv.httpd is not None
+        url = f"http://127.0.0.1:{srv.httpd.server_address[1]}"
+
+        # -- chaos burst ------------------------------------------------
+        n = 48
+        results = []
+        lock = threading.Lock()
+        tail = " ".join(["2"] * 13) + " 3"
+
+        def client(i):
+            req = urllib.request.Request(
+                url + "/api",
+                data=json.dumps({"prompts": [f"{i} {tail}"],
+                                 "tokens_to_generate": 24,
+                                 "temperature": 0.0,
+                                 "no_log": True}).encode(),
+                method="PUT")
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    r = (resp.status, json.loads(resp.read()))
+            except urllib.error.HTTPError as e:
+                r = (e.code, json.loads(e.read() or b"{}"))
+            with lock:
+                results.append(r)
+
+        threads = []
+        for i in range(n):
+            th = threading.Thread(target=client, args=(i,))
+            th.start()
+            threads.append(th)
+            if len(threads) >= 12:
+                threads.pop(0).join()
+        for th in threads:
+            th.join()
+
+        # exactly one response per request; the injected NaN is the only
+        # permitted failure and it is a structured 500
+        assert len(results) == n
+        bad = [(s, b) for s, b in results if s != 200]
+        assert len(bad) <= 1, f"unexpected failures: {bad}"
+        for s, b in bad:
+            assert s == 500 and b.get("finish_reason") == "nonfinite", b
+
+        # -- fleet-aggregated resilience counters -----------------------
+        m = router.aggregated_metrics()
+        agg_engine = m["aggregate"]["engine"]
+        assert agg_engine["engine_restarts"] >= 1, agg_engine
+        assert agg_engine["slots_evicted_nonfinite"] >= 1, agg_engine
+
+        # -- graceful drain of replica A, mid-traffic -------------------
+        # a second burst keeps the fleet busy; /drain lands while A has
+        # in-flight work.  Requests A rejects with 503+draining are
+        # retried by the client (the Retry-After contract) — a rejected
+        # admission never executed, so exactly-once still holds.
+        a_url = f"http://127.0.0.1:{port_a}"
+        drain_results = []
+
+        def retry_client(i):
+            req = urllib.request.Request(
+                url + "/api",
+                data=json.dumps({"prompts": [f"7 {i} 5 1"],
+                                 "tokens_to_generate": 16,
+                                 "temperature": 0.0,
+                                 "no_log": True}).encode(),
+                method="PUT")
+            for _ in range(40):
+                try:
+                    with urllib.request.urlopen(req, timeout=120) as resp:
+                        r = (resp.status, json.loads(resp.read()))
+                        break
+                except urllib.error.HTTPError as e:
+                    body = json.loads(e.read() or b"{}")
+                    r = (e.code, body)
+                    if e.code == 503 and body.get("draining"):
+                        time.sleep(0.25)
+                        continue
+                    break
+            with lock:
+                drain_results.append(r)
+
+        d_threads = [threading.Thread(target=retry_client, args=(i,))
+                     for i in range(24)]
+        for th in d_threads:
+            th.start()
+        # wait until the burst is demonstrably mid-flight, then drain A
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            with lock:
+                if len(drain_results) >= 4:
+                    break
+            time.sleep(0.01)
+        drain = urllib.request.Request(a_url + "/drain", data=b"{}",
+                                       method="POST")
+        with urllib.request.urlopen(drain, timeout=30) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["status"] == "draining"
+        # probe immediately, while A is still finishing in-flight work:
+        # draining is NOT dead — excluded from dispatch, breaker closed
+        router.probe_once()
+        ba = router.backends[0]
+        assert ba.draining
+        assert ba.available(router.fail_threshold)
+        for th in d_threads:
+            th.join(timeout=150)
+        assert len(drain_results) == 24
+        assert all(s == 200 for s, _ in drain_results), drain_results
+        assert pa.wait(timeout=150) == 0            # clean process exit
+
+        # post-drain traffic all lands on the survivor
+        router.probe_once()                 # A now unreachable -> dead
+        for i in range(4):
+            status, _, _ = router.dispatch(
+                "PUT", "/api",
+                json.dumps({"prompts": [f"9 {i} 1"],
+                            "tokens_to_generate": 4,
+                            "temperature": 0.0,
+                            "no_log": True}).encode())
+            assert status == 200
+    finally:
+        if srv is not None and srv.httpd is not None:
+            srv.httpd.shutdown()
+        for p in (pa, pb):
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=30)

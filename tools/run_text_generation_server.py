@@ -88,6 +88,10 @@ def main():
             int8_kv_cache=args.int8_kv_cache,
             prefix_cache=bool(args.serve_prefix_cache),
             paged_kernel=args.serve_paged_kernel,
+            watchdog_secs=args.serve_watchdog_secs,
+            preemption=bool(args.serve_preemption),
+            fault_spec=args.serve_fault_inject,
+            restart_backoff_secs=args.serve_restart_backoff_secs,
         ))
         print(" * warming up serving engine (compiling prefill/decode "
               "programs)...", flush=True)

@@ -61,6 +61,9 @@ JSON_SCHEMA_KEYS = (
     "prefill_tokens_computed", "prefill_tokens_cached",
     "prefill_computed_frac", "prefix_cache_hits", "prefix_cache_misses",
     "prefix_cache_evictions", "paged_kernel",
+    # resilience counters (engine/server /metrics deltas over the run)
+    "engine_restarts", "slots_evicted_nonfinite", "preemptions",
+    "drained",
 )
 
 
@@ -257,6 +260,12 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         # which attention path served the run ('pallas'|'xla', from the
         # engine /metrics block) — makes bench rows attributable
         "paged_kernel": None,
+        # resilience activity during the run (engine restarts, sentinel
+        # slot evictions, pool-pressure preemptions, drain initiations)
+        "engine_restarts": None,
+        "slots_evicted_nonfinite": None,
+        "preemptions": None,
+        "drained": None,
     }
     if m0 is not None and m1 is not None:
         # a router /metrics nests the fleet-summed engine counters (and
@@ -269,6 +278,9 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
             "errors": m1.get("errors", 0) - m0.get("errors", 0),
             "throttled": m1.get("throttled", 0) - m0.get("throttled", 0),
         }
+        if isinstance(m0.get("drained"), (int, float)) \
+                and isinstance(m1.get("drained"), (int, float)):
+            out["drained"] = m1["drained"] - m0["drained"]
         e0, e1 = m0.get("engine"), m1.get("engine")
         if isinstance(e1, dict):
             out["server_engine"] = e1
@@ -284,7 +296,10 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                             "prefill_tokens_computed",
                             "prefill_tokens_cached",
                             "prefix_cache_hits", "prefix_cache_misses",
-                            "prefix_cache_evictions"):
+                            "prefix_cache_evictions",
+                            "engine_restarts",
+                            "slots_evicted_nonfinite",
+                            "preemptions"):
                     out[key] = delta(key)
                 sub, comp = (out["prefill_tokens_submitted"],
                              out["prefill_tokens_computed"])

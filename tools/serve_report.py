@@ -42,23 +42,38 @@ PHASE_KEYS = ("queue_secs", "admission_secs", "prefill_secs",
               "decode_secs", "stream_write_secs")
 
 
+RESILIENCE_EVENTS = ("engine_restart", "preemption", "drain")
+
+
 def load_records(path: str) -> List[Dict]:
     """request_done records from a telemetry.jsonl (or its dir)."""
+    return _load(path)[0]
+
+
+def load_resilience_events(path: str) -> List[Dict]:
+    """engine_restart / preemption / drain events from a serve log."""
+    return _load(path)[1]
+
+
+def _load(path: str):
     if os.path.isdir(path):
         path = os.path.join(path, STREAM_FILENAME)
     if not os.path.exists(path):
         raise FileNotFoundError(f"no serve log at {path}")
-    out = []
+    records, events = [], []
     with open(path) as f:
         for line in f:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if rec.get("kind") == "serve" \
-                    and rec.get("event") == "request_done":
-                out.append(rec)
-    return out
+            if rec.get("kind") != "serve":
+                continue
+            if rec.get("event") == "request_done":
+                records.append(rec)
+            elif rec.get("event") in RESILIENCE_EVENTS:
+                events.append(rec)
+    return records, events
 
 
 def _percentile(values: List[float], q: float) -> Optional[float]:
@@ -146,9 +161,11 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
     """Full report over one or more replicas' serve logs."""
     per_replica: Dict[str, Dict] = {}
     all_records: List[Dict] = []
+    all_events: List[Dict] = []
     for p in paths:
-        records = load_records(p)
+        records, events = _load(p)
         all_records.extend(records)
+        all_events.extend(events)
         if len(paths) > 1:
             per_replica[p] = {
                 **latency_summary(records),
@@ -162,6 +179,25 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
         "by_cache": cache_stratified(all_records),
         "finish_reasons": {},
         "traced": sum(1 for r in all_records if r.get("trace_id")),
+        # resilience activity over the same window (engine restarts with
+        # their requeue/fail split, pool-pressure preemptions, drains,
+        # and sentinel slot evictions from the finish_reason stream)
+        "resilience": {
+            "engine_restarts": sum(
+                e.get("event") == "engine_restart" for e in all_events),
+            "restart_requeued": sum(
+                e.get("requeued") or 0 for e in all_events
+                if e.get("event") == "engine_restart"),
+            "restart_failed": sum(
+                e.get("failed") or 0 for e in all_events
+                if e.get("event") == "engine_restart"),
+            "preemptions": sum(
+                e.get("event") == "preemption" for e in all_events),
+            "drains": sum(e.get("event") == "drain" for e in all_events),
+            "nonfinite_evictions": sum(
+                r.get("finish_reason") == "nonfinite"
+                for r in all_records),
+        },
     }
     for r in all_records:
         fr = r.get("finish_reason") or "?"
@@ -229,6 +265,14 @@ def render(report: Dict) -> str:
         lines.append("\nfinish reasons: "
                      + json.dumps(report["finish_reasons"],
                                   sort_keys=True))
+
+    res = report.get("resilience") or {}
+    if any(res.values()):
+        lines.append("\nresilience activity:")
+        for key in ("engine_restarts", "restart_requeued",
+                    "restart_failed", "preemptions", "drains",
+                    "nonfinite_evictions"):
+            lines.append(f"  {key:>20}: {res.get(key, 0)}")
 
     for path, s in (report.get("replicas") or {}).items():
         lines.append(f"\nreplica {path} "

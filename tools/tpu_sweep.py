@@ -88,6 +88,13 @@ MANIFEST: List[Step] = [
          f"python finetune.py {_SMOKE_FLAGS} "
          "--global_batch_size=8 --num_slices=2",
          600, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+    # serving chaos harness: the 2-replica fleet e2e (NaN injection +
+    # watchdog restart + SIGKILL failover + SIGTERM drain behind the
+    # router) — proves every request completes exactly once under faults
+    Step("serve_chaos_smoke",
+         "python -m pytest tests/test_serving_resilience.py "
+         "-m chaos -q -p no:cacheprovider",
+         900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
 ]
 
 
