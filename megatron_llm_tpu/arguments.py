@@ -470,6 +470,14 @@ def _add_inference_args(parser):
                         "for decode steps when the Pallas backend is "
                         "available (prefill chunks and CPU keep the XLA "
                         "gather branch), 'on' forces it, 'off' disables")
+    g.add_argument("--serve_prefill_kernel", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="Pallas ragged paged-attention prefill kernel "
+                        "for [1, C] chunked-prefill calls "
+                        "(ops/pallas/paged_attention.py): 'auto' uses it "
+                        "when the Pallas backend is available, 'on' "
+                        "forces it, 'off' keeps the dense XLA gather "
+                        "branch")
     g.add_argument("--serve_prefix_cache", type=int, default=1,
                    help="share KV pages across requests with equal "
                         "prompt prefixes (refcounted copy-on-write "

@@ -279,11 +279,26 @@ def _paged_scatter(kv_cache: dict, k: jax.Array, v: jax.Array,
     return out
 
 
-def _paged_gather(pages: dict, bt: jax.Array, cdt) -> tuple:
-    """Dense read view: gather every slot's full block table into
+def _paged_gather(pages: dict, bt: jax.Array, cdt,
+                  live_lens: Optional[jax.Array] = None) -> tuple:
+    """Dense read view: gather every slot's block table into
     ``[b, M*bs, g, d]`` K/V (dequantizing int8 pages) — the XLA
-    fallback; the Pallas decode kernel reads pages ragged instead."""
+    fallback; the Pallas kernels read pages ragged instead.
+
+    ``live_lens`` ([b] tokens live per slot) bounds the gather to each
+    slot's live page range: table entries whose page starts at or beyond
+    the live range are redirected to the reserved garbage block 0, so
+    the fallback's distinct-page HBM traffic is ``ceil(live/bs)`` pages
+    per slot instead of the full worst-case table (the shapes stay
+    static — only the gathered indices collapse).  Correctness is
+    untouched: every key position the causal mask admits lies below
+    ``live_lens``, and garbage-block reads were already masked."""
     b, M = bt.shape
+    if live_lens is not None:
+        bs0 = (pages["k_pages_q"] if "k_pages_q" in pages
+               else pages["k_pages"]).shape[1]
+        page_start = jnp.arange(M)[None, :] * bs0
+        bt = jnp.where(page_start < live_lens[:, None], bt, 0)
     if "k_pages_q" in pages:
         bs, g, d = pages["k_pages_q"].shape[1:]
 
@@ -301,24 +316,44 @@ def _paged_gather(pages: dict, bt: jax.Array, cdt) -> tuple:
             pages["v_pages"][bt].reshape(b, M * bs, g, d))
 
 
-def _paged_kernel_enabled(cfg: TransformerConfig, n: int) -> bool:
-    """``--serve_paged_kernel`` dispatch: 'off' never; 'on' for any
-    decode-shaped (one query token) call; 'auto' additionally requires
-    the Pallas backend and a single device — so prefill chunks, CPU,
-    and meshed runs keep the XLA gather branch."""
-    mode = getattr(cfg, "paged_attention_kernel", "auto")
-    if mode == "off" or n != 1:
-        return False
+def _paged_attention_path(cfg: TransformerConfig, n: int) -> str:
+    """Query-length-aware paged-attention dispatch — the widened
+    ``_paged_kernel_enabled`` seam.  Returns which read path the paged
+    branch takes for an n-query-token call:
+
+    * ``'decode'`` — n == 1 and ``paged_attention_kernel``
+      (``--serve_paged_kernel``) allows the Pallas decode kernel;
+    * ``'prefill'`` — 1 < n <= ``paged_prefill_max_q`` and
+      ``paged_prefill_kernel`` (``--serve_prefill_kernel``) allows the
+      Pallas chunked-prefill kernel;
+    * ``'xla'`` — everything else (mode 'off', oversized query blocks,
+      CPU without interpret mode, meshed runs under 'auto').
+
+    The same n-aware seam is the forward door for a speculative
+    K+1-token verify step: it is just another small-n 'prefill' call.
+    """
+    if n == 1:
+        mode = getattr(cfg, "paged_attention_kernel", "auto")
+        avail_name = "decode_kernel_available"
+        path = "decode"
+    else:
+        mode = getattr(cfg, "paged_prefill_kernel", "auto")
+        avail_name = "prefill_kernel_available"
+        path = "prefill"
+        if n > getattr(cfg, "paged_prefill_max_q", 512):
+            return "xla"
+    if mode == "off":
+        return "xla"
     if mode == "on":
-        return True
-    from megatron_llm_tpu.ops.pallas.paged_attention import (
-        decode_kernel_available,
-    )
+        return path
+    from megatron_llm_tpu.ops.pallas import paged_attention
 
     # under a multi-device mesh the Mosaic call would need an explicit
-    # shard_map (GSPMD cannot auto-partition it); serving decode is
+    # shard_map (GSPMD cannot auto-partition it); serving is
     # single-device today, so 'auto' simply bails
-    return decode_kernel_available() and jax.device_count() == 1
+    if getattr(paged_attention, avail_name)() and jax.device_count() == 1:
+        return path
+    return "xla"
 
 
 def attention(
@@ -393,23 +428,32 @@ def attention(
         dest = jnp.where(real, blk * bs + pos % bs, pos % bs)
         dest = jnp.clip(dest, 0, P * bs - 1)
         new_cache = _paged_scatter(kv_cache, k, v, dest)
-        if _paged_kernel_enabled(cfg, n):
-            from megatron_llm_tpu.ops.pallas.paged_attention import (
-                paged_attention_decode,
-            )
+        path = _paged_attention_path(cfg, n)
+        if path != "xla":
+            from megatron_llm_tpu.ops.pallas import paged_attention as _pa
 
-            paged_ctx = paged_attention_decode(
-                q[:, 0],                                     # [b, nh, d]
-                new_cache["k_pages_q" if quantized else "k_pages"],
-                new_cache["v_pages_q" if quantized else "v_pages"],
-                bt, ctx_lens,
+            kernel_kw = dict(
                 k_scales=new_cache.get("k_pages_scale"),
                 v_scales=new_cache.get("v_pages_scale"),
                 softmax_scale=1.0 / math.sqrt(d),
                 sliding_window=cfg.sliding_window_size,
-            )[:, None]                                       # [b, 1, nh, d]
+            )
+            kp = new_cache["k_pages_q" if quantized else "k_pages"]
+            vp = new_cache["v_pages_q" if quantized else "v_pages"]
+            if path == "decode":
+                paged_ctx = _pa.paged_attention_decode(
+                    q[:, 0], kp, vp, bt, ctx_lens,   # [b, nh, d] query
+                    **kernel_kw)[:, None]            # -> [b, 1, nh, d]
+            else:
+                # chunked prefill: the chunk's own K/V just scattered at
+                # ctx_lens..ctx_lens+n-1, so the kernel's causal walk
+                # covers history AND the in-flight chunk; padded tail
+                # rows (j >= valid_lens) are garbage either way
+                paged_ctx = _pa.paged_attention_prefill(
+                    q, kp, vp, bt, ctx_lens, **kernel_kw)
         else:
-            k, v = _paged_gather(new_cache, bt, k.dtype)
+            k, v = _paged_gather(new_cache, bt, k.dtype,
+                                 live_lens=ctx_lens + vlen)
             key_pos = jnp.arange(M * bs)
             valid = key_pos[None, None, :] <= pos[:, :, None]  # [b, sq, sk]
             if cfg.sliding_window_size is not None:

@@ -107,6 +107,12 @@ class EngineConfig:
     # mode in tests), 'on' forces it, 'off' keeps the XLA gather branch.
     # The resolved path is reported as stats()['paged_kernel'].
     paged_kernel: str = "auto"
+    # Pallas ragged paged-attention prefill kernel for the [1, C]
+    # chunked-prefill program (--serve_prefill_kernel): same auto/on/off
+    # semantics as paged_kernel.  Resolved once at __init__ into a static
+    # prefill config override (so the jitted prefill program never
+    # recompiles) and reported as stats()['prefill_kernel'].
+    prefill_kernel: str = "auto"
     # resilience (--serve_watchdog_secs / --serve_preemption /
     # --serve_fault_inject; serving/resilience.py)
     watchdog_secs: float = 0.0      # 0 = no engine watchdog
@@ -179,8 +185,11 @@ class InferenceEngine:
         if cfg.paged_kernel not in ("auto", "on", "off"):
             raise ValueError(f"paged_kernel must be auto|on|off, got "
                              f"{cfg.paged_kernel!r}")
+        if cfg.prefill_kernel not in ("auto", "on", "off"):
+            raise ValueError(f"prefill_kernel must be auto|on|off, got "
+                             f"{cfg.prefill_kernel!r}")
         from megatron_llm_tpu.ops.pallas.paged_attention import (
-            decode_kernel_available,
+            decode_kernel_available, prefill_kernel_available,
         )
         self.paged_kernel = (
             "pallas" if cfg.paged_kernel != "off"
@@ -189,7 +198,23 @@ class InferenceEngine:
             else "xla")
         self._decode_cfg = mcfg.replace(
             paged_attention_kernel=(
-                "on" if self.paged_kernel == "pallas" else "off"))
+                "on" if self.paged_kernel == "pallas" else "off"),
+            paged_prefill_kernel="off")     # decode program is n == 1
+        # same resolve-once pattern for the chunked-prefill program: the
+        # override pins both kernel modes (the [1, C] call is n == C, so
+        # the decode field is moot, but static is static) and widens
+        # paged_prefill_max_q to this engine's chunk so the n-aware
+        # dispatch in the transformer routes it
+        self.prefill_kernel = (
+            "pallas" if cfg.prefill_kernel != "off"
+            and prefill_kernel_available()
+            and (cfg.prefill_kernel == "on" or jax.device_count() == 1)
+            else "xla")
+        self._prefill_cfg = mcfg.replace(
+            paged_attention_kernel="off",
+            paged_prefill_kernel=(
+                "on" if self.prefill_kernel == "pallas" else "off"),
+            paged_prefill_max_q=max(cfg.prefill_chunk, 2))
 
         self._st = self._new_state(gen=0)
 
@@ -291,8 +316,8 @@ class InferenceEngine:
                      block_tables, active, temps, top_ks, top_ps,
                      ban_a, ban_b, keys):
         # decode-only config override routes the paged branch to the
-        # resolved attention path (prefill chunks keep model.cfg and
-        # always take the XLA branch)
+        # resolved attention path (prefill chunks carry their own
+        # override — see _prefill_impl)
         cfg = self._decode_cfg
         tokens = last_tokens[:, None]                       # [S, 1]
         positions = context_lens[:, None]                   # [S, 1]
@@ -320,7 +345,10 @@ class InferenceEngine:
 
     def _prefill_impl(self, params, pages, tokens, start_pos, valid_len,
                       block_table):
-        cfg = self.model.cfg
+        # prefill-only config override routes the [1, C] chunk to the
+        # resolved prefill path (Pallas ragged prefill kernel or the
+        # bounded XLA gather) — static, so one compile covers every chunk
+        cfg = self._prefill_cfg
         C = tokens.shape[1]
         positions = (start_pos + jnp.arange(C))[None, :]    # [1, C]
         caches = self._layer_caches(
@@ -867,6 +895,7 @@ class InferenceEngine:
             "tpot_secs": round(tpot, 6) if tpot is not None else None,
             "phases": req.phases(),
             "paged_kernel": self.paged_kernel,
+            "prefill_kernel": self.prefill_kernel,
             "queue_depth": self.queue.depth(),
             "blocks_free": bstats["blocks_free"],
             "blocks_in_use": bstats["blocks_in_use"],
@@ -893,9 +922,10 @@ class InferenceEngine:
     def warmup(self) -> None:
         """Compile the steady-state programs (prefill chunk, first-token
         sampler, decode step) with one dummy greedy request.  The decode
-        step bakes in the resolved paged-attention path (Pallas ragged
-        kernel or XLA gather — a static config field), so the kernel
-        compiles here exactly once.  Call before
+        step and the prefill chunk each bake in their resolved
+        paged-attention path (Pallas ragged kernel or XLA gather — static
+        config fields), so each kernel compiles here exactly once.  Call
+        before
         ``tracing.RecompileDetector.mark_steady()`` — after this, serving
         arbitrary requests triggers zero compiles."""
         assert self._thread is None, "warm up before start()"
@@ -946,6 +976,7 @@ class InferenceEngine:
             "finished": dict(self.finished),
             "warmed_up": self.warmed_up,
             "paged_kernel": self.paged_kernel,
+            "prefill_kernel": self.prefill_kernel,
             "engine_restarts": self.engine_restarts,
             "slots_evicted_nonfinite": self.slots_evicted_nonfinite,
         })

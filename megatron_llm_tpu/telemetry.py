@@ -417,7 +417,10 @@ def _wants_prometheus(path: str, accept: str) -> bool:
 #    tpot_secs (amortized per-output-token decode latency), decode_tokens
 #    and prefill_computed_tokens — see serving/engine.py and
 #    tools/serve_report.py
-TELEMETRY_SCHEMA_VERSION = 5
+# 6: serve request_done records gain prefill_kernel (the resolved
+#    chunked-prefill attention path, 'pallas'|'xla', alongside the
+#    existing decode-path paged_kernel) — see serving/engine.py
+TELEMETRY_SCHEMA_VERSION = 6
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 

@@ -11,8 +11,9 @@ as tests/test_serving_http.py, so prompts are space-separated ints and
 greedy outputs are deterministic across replicas.
 
 ``--paged_kernel {auto,on,off}`` selects the paged-attention decode
-path; ``on`` additionally flips the Pallas kernel into interpret mode
-so the kernel-vs-XLA serve_bench A/B runs end-to-end on CPU.
+path and ``--prefill_kernel {auto,on,off}`` the chunked-prefill path;
+``on`` additionally flips the Pallas kernels into interpret mode so the
+kernel-vs-XLA serve_bench A/Bs run end-to-end on CPU.
 """
 
 import argparse
@@ -50,6 +51,8 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--paged_kernel", choices=["auto", "on", "off"],
                    default="auto")
+    p.add_argument("--prefill_kernel", choices=["auto", "on", "off"],
+                   default="auto")
     p.add_argument("--structured_log_dir", default=None,
                    help="stream request_done JSONL (trace-id e2e tests)")
     p.add_argument("--trace_dir", default=None,
@@ -72,9 +75,9 @@ def main():
                                  trace_dir=args.trace_dir)
         tracing.install_tracing(bundle)
         tracing.start_trace_flusher(bundle, interval_secs=0.5)
-    if args.paged_kernel == "on":
-        # no TPU in the test environment: run the Pallas kernel in
-        # interpret mode so decode_kernel_available() is true on CPU
+    if args.paged_kernel == "on" or args.prefill_kernel == "on":
+        # no TPU in the test environment: run the Pallas kernels in
+        # interpret mode so *_kernel_available() is true on CPU
         from megatron_llm_tpu.ops.pallas import paged_attention
         paged_attention._INTERPRET = True
     cfg = llama_config("tiny", num_layers=2, seq_length=64,
@@ -87,6 +90,7 @@ def main():
         num_blocks=args.serve_num_blocks,
         max_queue_depth=32, default_deadline_secs=60.0,
         paged_kernel=args.paged_kernel,
+        prefill_kernel=args.prefill_kernel,
         watchdog_secs=args.serve_watchdog_secs,
         fault_spec=args.serve_fault_inject,
         restart_backoff_secs=0.0))

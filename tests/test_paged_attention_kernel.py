@@ -1,8 +1,11 @@
-"""Ragged paged-attention Pallas decode kernel, run in interpret mode
-on CPU: kernel vs the XLA dense-gather reference vs a per-slot numpy
-oracle, across ragged context lengths, GQA group counts, sliding
-window, and int8 KV quantization — plus model-level parity of the
-transformer's paged branch with the kernel forced on vs off."""
+"""Ragged paged-attention Pallas kernels (decode and chunked prefill),
+run in interpret mode on CPU: kernel vs the XLA dense-gather reference
+vs a per-slot numpy oracle, across ragged context lengths, GQA group
+counts, sliding window, and int8 KV quantization — plus model-level
+parity of the transformer's paged branch with the kernels forced on vs
+off.  Prefill cases cover the ragged edges: chunks straddling page
+boundaries, context 0, cached-prefix tail chunks starting mid-page,
+windows shorter than the chunk, and multi-q-block grids."""
 
 import math
 
@@ -120,10 +123,140 @@ def test_kernel_int8_dequant(window):
 
 def test_availability_tracks_backend(monkeypatch):
     assert pa.decode_kernel_available()   # interpret fixture is on
+    assert pa.prefill_kernel_available()
     monkeypatch.setattr(pa, "_INTERPRET", False)
     monkeypatch.delenv("MLT_FORCE_PALLAS", raising=False)
     if jax.default_backend() != "tpu":
         assert not pa.decode_kernel_available()
+        assert not pa.prefill_kernel_available()
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill: ragged-edge parity
+# ---------------------------------------------------------------------------
+
+# chunk C = 16 on bs = 8 pages; contexts hit the ragged edges: 0 (no
+# history), 3 (chunk straddles the page-0/1 boundary mid-chunk), 8
+# (chunk starts exactly on a page boundary), 17 (cached-prefix tail
+# chunk starting mid-page, spilling into a 5th page)
+CTX = np.asarray([0, 3, 8, 17], np.int32)
+C = 16
+MP = 6                    # pages per table; max live = 5, so dead tails
+
+
+def _build_prefill_case(rng, S, M, bs, g, nh, d, ctx, C):
+    """Engine-shaped prefill state: each slot's history (ctx keys) AND
+    its in-flight chunk (C keys, scatter-before-read) live in the pool;
+    linear positions past ctx+C — including tail positions of live
+    pages — hold amplified garbage so an unmasked read diverges
+    loudly."""
+    L = M * bs
+    q = rng.standard_normal((S, C, nh, d)).astype(np.float32)
+    k_lin = rng.standard_normal((S, L, g, d)).astype(np.float32)
+    v_lin = rng.standard_normal((S, L, g, d)).astype(np.float32)
+    for s in range(S):
+        k_lin[s, int(ctx[s]) + C:] *= 100.0
+        v_lin[s, int(ctx[s]) + C:] *= 100.0
+    P = 1 + S * M
+    k_pages = (rng.standard_normal((P, bs, g, d)) * 100.0).astype(np.float32)
+    v_pages = (rng.standard_normal((P, bs, g, d)) * 100.0).astype(np.float32)
+    bt = np.zeros((S, M), np.int32)
+    nxt = 1
+    for s in range(S):
+        for j in range((int(ctx[s]) + C + bs - 1) // bs):
+            bt[s, j] = nxt
+            k_pages[nxt] = k_lin[s, j * bs:(j + 1) * bs]
+            v_pages[nxt] = v_lin[s, j * bs:(j + 1) * bs]
+            nxt += 1
+    return q, k_lin, v_lin, k_pages, v_pages, bt
+
+
+def _prefill_oracle(q, k_lin, v_lin, ctx, scale, window):
+    """Per-(slot, row, head) dense causal attention: row j of a chunk
+    attends keys 0..ctx+j (window-clipped) — independent of both the
+    kernel and the jnp reference."""
+    S, Cq, nh, d = q.shape
+    L, g = k_lin.shape[1], k_lin.shape[2]
+    qpg = nh // g
+    out = np.zeros((S, Cq, nh, d), np.float32)
+    kpos = np.arange(L)
+    for s in range(S):
+        for j in range(Cq):
+            pos = int(ctx[s]) + j
+            valid = kpos <= pos
+            if window is not None:
+                valid &= kpos > pos - window
+            for h in range(nh):
+                grp = h // qpg
+                sc = (k_lin[s, :, grp] @ q[s, j, h]) * scale
+                sc = np.where(valid, sc, -np.inf)
+                p = np.exp(sc - sc[valid].max())
+                p = np.where(valid, p, 0.0)
+                p /= p.sum()
+                out[s, j, h] = p @ v_lin[s, :, grp]
+    return out
+
+
+@pytest.mark.parametrize("block_q", [None, 8])
+@pytest.mark.parametrize("window", [None, 5])
+@pytest.mark.parametrize("g,nh", [(1, 1), (2, 4), (4, 4)])
+def test_prefill_kernel_matches_oracle_and_reference(g, nh, window,
+                                                     block_q):
+    """window=5 < C exercises windows shorter than the chunk;
+    block_q=8 splits C=16 across two q-grid steps so the online-softmax
+    scratch carries across both page and q-block boundaries."""
+    rng = np.random.default_rng(11 * g + nh + (window or 0)
+                                + (block_q or 0))
+    q, k_lin, v_lin, kp, vp, bt = _build_prefill_case(
+        rng, len(CTX), MP, BS, g, nh, D, CTX, C)
+    scale = 1.0 / math.sqrt(D)
+    got = np.asarray(pa.paged_attention_prefill(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(CTX), sliding_window=window,
+        block_q=block_q))
+    ref = np.asarray(pa._reference_paged_prefill(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(CTX), None, None, scale, window))
+    want = _prefill_oracle(q, k_lin, v_lin, CTX, scale, window)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(ref, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("window", [None, 5])
+def test_prefill_kernel_int8_dequant(window):
+    g, nh = 2, 4
+    rng = np.random.default_rng(99 + (window or 0))
+    q, k_lin, v_lin, kp, vp, bt = _build_prefill_case(
+        rng, len(CTX), MP, BS, g, nh, D, CTX, C)
+    scale = 1.0 / math.sqrt(D)
+    kq, ks = absmax_quantize_int8(jnp.asarray(kp), axis=-1)
+    vq, vs = absmax_quantize_int8(jnp.asarray(vp), axis=-1)
+    got = np.asarray(pa.paged_attention_prefill(
+        jnp.asarray(q), kq, vq, jnp.asarray(bt), jnp.asarray(CTX),
+        k_scales=ks, v_scales=vs, sliding_window=window, block_q=8))
+    ref = np.asarray(pa._reference_paged_prefill(
+        jnp.asarray(q), kq, vq, jnp.asarray(bt), jnp.asarray(CTX),
+        ks, vs, scale, window))
+    np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+    want = _prefill_oracle(q, k_lin, v_lin, CTX, scale, window)
+    drift = np.max(np.abs(got - want)) / (np.std(want) + 1e-6)
+    assert drift < 0.2, drift
+
+
+def test_prefill_decode_consistency():
+    """The decode entry point is literally the C == 1 instance of the
+    ragged prefill: a one-row chunk through paged_attention_prefill
+    equals paged_attention_decode on the same state."""
+    g, nh = 2, 4
+    rng = np.random.default_rng(5)
+    q, _, _, kp, vp, bt = _build_case(rng, S, M, BS, g, nh, D, LENS)
+    dec = np.asarray(pa.paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(LENS)))
+    pre = np.asarray(pa.paged_attention_prefill(
+        jnp.asarray(q)[:, None], jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(LENS)))[:, 0]
+    np.testing.assert_allclose(pre, dec, atol=1e-6, rtol=1e-6)
 
 
 # ---------------------------------------------------------------------------
@@ -181,3 +314,51 @@ def test_transformer_paged_kernel_parity(model_and_params, quantized):
                                            train=False, kv_caches=caches)
         outs.append(np.asarray(logits[:, 0], np.float32))
     np.testing.assert_allclose(outs[1], outs[0], atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_transformer_prefill_kernel_parity(model_and_params, quantized):
+    """Two engine-shaped prefill chunks — a ragged first chunk from
+    empty caches, then a ragged cached-prefix tail chunk — through the
+    paged branch with the Pallas prefill kernel forced on (interpret)
+    match the XLA gather branch per valid row, on plain and int8 pools.
+    Padded tail rows (j >= valid_lens) are garbage in both paths and
+    excluded."""
+    model, params = model_and_params
+    cfg_off = model.cfg.replace(paged_attention_kernel="off",
+                                paged_prefill_kernel="off")
+    cfg_on = model.cfg.replace(paged_attention_kernel="off",
+                               paged_prefill_kernel="on")
+    Sl, Cc = 2, 16
+    bt = jnp.asarray(np.arange(1, 1 + Sl * M).reshape(Sl, M), jnp.int32)
+    v0 = jnp.asarray([5, 16], jnp.int32)     # ragged first chunk
+    v1 = jnp.asarray([9, 7], jnp.int32)      # ragged tail chunk
+    toks0 = jnp.asarray(np.arange(Sl * Cc).reshape(Sl, Cc) % 60 + 1,
+                        jnp.int32)
+    toks1 = jnp.asarray((np.arange(Sl * Cc).reshape(Sl, Cc) * 3) % 60 + 1,
+                        jnp.int32)
+    outs = []
+    for cfg in (cfg_off, cfg_on):
+        pages = init_paged_kv_caches(model.cfg, 1 + int(bt.max()), BS,
+                                     quantized=quantized)
+        caches = [dict(p, block_tables=bt,
+                       context_lens=jnp.zeros((Sl,), jnp.int32),
+                       valid_lens=v0) for p in pages]
+        pos0 = jnp.broadcast_to(jnp.arange(Cc)[None, :], (Sl, Cc))
+        lg0, caches = language_model_forward(params, toks0, pos0, None,
+                                             cfg, rng_key=None,
+                                             train=False,
+                                             kv_caches=caches)
+        caches = [dict(c, valid_lens=v1) for c in caches]
+        pos1 = v0[:, None] + jnp.arange(Cc)[None, :]
+        lg1, _ = language_model_forward(params, toks1, pos1, None, cfg,
+                                        rng_key=None, train=False,
+                                        kv_caches=caches)
+        outs.append((np.asarray(lg0, np.float32),
+                     np.asarray(lg1, np.float32)))
+    (a0, a1), (b0, b1) = outs
+    for s in range(Sl):
+        np.testing.assert_allclose(b0[s, :int(v0[s])], a0[s, :int(v0[s])],
+                                   atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(b1[s, :int(v1[s])], a1[s, :int(v1[s])],
+                                   atol=2e-4, rtol=2e-4)

@@ -17,7 +17,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
 import serve_bench  # noqa: E402
 
 
-def _start_stub(paged_kernel="xla"):
+def _start_stub(paged_kernel="xla", prefill_kernel="xla"):
     """Mimics the /api, /api/stream and /metrics contract with canned
     responses (every request generates 3 tokens on a 2-token prompt)."""
     metrics = {"requests": 0, "errors": 0, "throttled": 0}
@@ -67,6 +67,7 @@ def _start_stub(paged_kernel="xla"):
                     "prefix_cache_misses": n,
                     "prefix_cache_evictions": 0,
                     "paged_kernel": paged_kernel,
+                    "prefill_kernel": prefill_kernel,
                 }
                 self._json(200, body)
             else:
@@ -178,6 +179,10 @@ def test_prefix_workload_reports_engine_deltas(stub_server):
     assert r["prefix_cache_hits"] == 8
     assert r["prefix_cache_misses"] == 4
     assert r["prefix_cache_evictions"] == 0
+    # computed-prefill throughput = computed delta / wall clock
+    assert r["prefill_tokens_per_sec"] > 0
+    assert r["prefill_tokens_per_sec"] == pytest.approx(
+        16 / r["wall_secs"], rel=0.01)
 
 
 def test_percentile_helper():
@@ -189,12 +194,13 @@ def test_percentile_helper():
 
 
 # ---------------------------------------------------------------------------
-# kernel A/B (--ab serve_paged_kernel)
+# kernel A/B (--ab <server_flag>)
 # ---------------------------------------------------------------------------
 
 def test_bench_reports_paged_kernel(stub_server):
     r = serve_bench.run_bench(stub_server, clients=2, requests=3, tokens=3)
     assert r["paged_kernel"] == "xla"     # the stub's engine attribution
+    assert r["prefill_kernel"] == "xla"
 
 
 def test_run_ab_tags_arms():
@@ -243,19 +249,49 @@ def test_cli_ab_json_and_table(capsys):
         off_httpd.shutdown()
 
 
+def test_cli_ab_any_flag_name(capsys):
+    """--ab is a free-form server-flag name, not an enum: the prefill
+    kernel A/B (and any future boolean flag) reuses the same machinery,
+    with the header attributing both attention paths."""
+    on_httpd, on_url = _start_stub("xla", prefill_kernel="pallas")
+    off_httpd, off_url = _start_stub("xla", prefill_kernel="xla")
+    try:
+        rc = serve_bench.main(["--url", on_url, "--ab",
+                               "serve_prefill_kernel", "--ab_url", off_url,
+                               "--clients", "2", "--requests", "3",
+                               "--tokens", "3", "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["ab"] == "serve_prefill_kernel"
+        assert [r["prefill_kernel"] for r in out["rows"]] == \
+            ["pallas", "xla"]
+        rc = serve_bench.main(["--url", on_url, "--ab",
+                               "serve_prefill_kernel", "--ab_url", off_url,
+                               "--clients", "2", "--requests", "3",
+                               "--tokens", "3"])
+        assert rc == 0
+        table = capsys.readouterr().out
+        assert "serve_prefill_kernel=on" in table
+        assert "prefill=pallas" in table and "prefill=xla" in table
+        assert "A/B prefill throughput" in table
+    finally:
+        on_httpd.shutdown()
+        off_httpd.shutdown()
+
+
 def test_cli_ab_requires_ab_url():
     with pytest.raises(SystemExit):
         serve_bench.main(["--url", "http://127.0.0.1:1", "--ab",
                           "serve_paged_kernel", "--requests", "1"])
 
 
-def _spawn_replica(paged_kernel, timeout=240.0):
+def _spawn_replica(paged_kernel, timeout=240.0, extra_args=()):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)      # single-device child, no 8-dev mesh
     here = os.path.dirname(__file__)
     proc = subprocess.Popen(
         [sys.executable, os.path.join(here, "_serve_replica.py"),
-         "--paged_kernel", paged_kernel],
+         "--paged_kernel", paged_kernel, *extra_args],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
         text=True, cwd=os.path.dirname(here))
     deadline = time.monotonic() + timeout
@@ -293,6 +329,42 @@ def test_ab_end_to_end_two_engines(capsys):
         assert rows[1]["paged_kernel"] == "xla"
         for r in rows:
             assert r["errors"] == 0 and r["tokens_per_sec"] > 0
+    finally:
+        for p in (p_on, p_off):
+            p.kill()
+            p.wait()
+
+
+@pytest.mark.slow
+def test_ab_prefill_end_to_end_two_replicas(capsys):
+    """Acceptance: --ab serve_prefill_kernel runs end-to-end on CPU —
+    two real engine subprocesses (Pallas interpret-mode ragged prefill
+    vs XLA dense gather, decode pinned to XLA in both so only prefill
+    differs), one serve_bench invocation, per-arm prefill tokens/sec
+    and TTFT."""
+    p_on, port_on = _spawn_replica(
+        "off", extra_args=("--prefill_kernel", "on"))
+    p_off, port_off = _spawn_replica(
+        "off", extra_args=("--prefill_kernel", "off"))
+    try:
+        rc = serve_bench.main([
+            "--url", f"http://127.0.0.1:{port_on}",
+            "--ab", "serve_prefill_kernel",
+            "--ab_url", f"http://127.0.0.1:{port_off}",
+            "--clients", "2", "--requests", "4", "--tokens", "8",
+            "--prompt", "1 2 3 4 5 6 7 8 9 10 11 12",
+            "--timeout", "180", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        rows = out["rows"]
+        assert [r["ab_arm"] for r in rows] == ["on", "off"]
+        assert rows[0]["prefill_kernel"] == "pallas"
+        assert rows[1]["prefill_kernel"] == "xla"
+        for r in rows:
+            assert r["errors"] == 0 and r["tokens_per_sec"] > 0
+            # the arm's prompt tokens all ran through chunked prefill
+            assert r["prefill_tokens_per_sec"] > 0
+            assert r["ttft_mean_secs"] is None or r["ttft_mean_secs"] >= 0
     finally:
         for p in (p_on, p_off):
             p.kill()

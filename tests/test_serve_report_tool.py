@@ -18,7 +18,7 @@ import serve_report  # noqa: E402
 def _record(i, cached=0, ttft=0.2, e2e=1.0, tpot=0.02,
             finish="length", trace=True):
     return {
-        "schema": 5, "kind": "serve", "event": "request_done",
+        "schema": 6, "kind": "serve", "event": "request_done",
         "time_unix": 1700000000 + i, "request": f"req-{i}",
         "trace_id": f"{i:016x}" if trace else None,
         "prompt_tokens": 16, "cached_prompt_tokens": cached,
@@ -28,7 +28,8 @@ def _record(i, cached=0, ttft=0.2, e2e=1.0, tpot=0.02,
         "phases": {"queue_secs": 0.05, "admission_secs": 0.001,
                    "prefill_secs": 0.1, "decode_secs": tpot * 8,
                    "stream_write_secs": 0.002},
-        "paged_kernel": "xla", "queue_depth": 0, "blocks_free": 10,
+        "paged_kernel": "xla", "prefill_kernel": "xla",
+        "queue_depth": 0, "blocks_free": 10,
         "blocks_in_use": 2, "blocks_cached_reusable": 1,
     }
 
@@ -73,6 +74,12 @@ def test_analyze_summary_phases_and_cache_split(serve_log):
     # deadline record (0.2) = 5 of 9; tpot <= 0.045 -> i in 0..3 + 0.02
     assert r["slo"]["ttft_attained"] == pytest.approx(5 / 9)
     assert r["slo"]["joint_attained"] == pytest.approx(5 / 9)
+    # prefill throughput: computed tokens over prefill compute seconds,
+    # attributed to the serving attention path
+    assert r["prefill"]["computed_tokens"] == 4 * 8 + 5 * 16
+    assert r["prefill"]["compute_secs"] == pytest.approx(0.9)
+    assert r["prefill"]["tokens_per_sec"] == pytest.approx(112 / 0.9)
+    assert r["prefill"]["kernel"] == {"xla": 9}
 
 
 def test_analyze_multi_log_per_replica(tmp_path):
@@ -105,6 +112,7 @@ def test_cli_table_json_and_empty_exit_codes(serve_log, tmp_path):
     assert "phase breakdown" in out.stdout
     assert "SLO attainment" in out.stdout
     assert "cache_hit" in out.stdout
+    assert "prefill compute:" in out.stdout
 
     out = subprocess.run(
         [sys.executable, str(ROOT / "tools" / "serve_report.py"),

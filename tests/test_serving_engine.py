@@ -330,7 +330,7 @@ def test_request_done_schema_golden(engine, tmp_path):
     the schema history comment in telemetry.py)."""
     from megatron_llm_tpu import telemetry
 
-    assert telemetry.TELEMETRY_SCHEMA_VERSION == 5
+    assert telemetry.TELEMETRY_SCHEMA_VERSION == 6
     captured = []
     engine.request_done_hook = captured.append
     stream = telemetry.TelemetryStream(str(tmp_path))
@@ -352,8 +352,9 @@ def test_request_done_schema_golden(engine, tmp_path):
         "kind", "event", "request", "trace_id", "prompt_tokens",
         "cached_prompt_tokens", "prefill_computed_tokens", "new_tokens",
         "decode_tokens", "finish_reason", "ttft_secs", "latency_secs",
-        "tpot_secs", "phases", "paged_kernel", "queue_depth",
-        "blocks_free", "blocks_in_use", "blocks_cached_reusable"))
+        "tpot_secs", "phases", "paged_kernel", "prefill_kernel",
+        "queue_depth", "blocks_free", "blocks_in_use",
+        "blocks_cached_reusable"))
     assert frozenset(rec["phases"]) == frozenset((
         "queue_secs", "admission_secs", "prefill_secs", "decode_secs",
         "stream_write_secs"))
@@ -363,7 +364,7 @@ def test_request_done_schema_golden(engine, tmp_path):
             (tmp_path / "telemetry.jsonl").read_text().splitlines()
             if "request_done" in ln][0]
     assert frozenset(line) == frozenset(rec) | {"schema", "time_unix"}
-    assert line["schema"] == 5
+    assert line["schema"] == 6
 
 
 def test_engine_int8_kv_cache_serves(model_and_params):
@@ -389,11 +390,12 @@ def test_engine_stats_shape(engine):
     for key in ("queue_depth", "mean_batch_occupancy", "decode_steps",
                 "prefill_chunks", "tokens_generated", "prefill_secs",
                 "decode_secs", "blocks_in_use", "finished", "warmed_up",
-                "paged_kernel"):
+                "paged_kernel", "prefill_kernel"):
         assert key in s
     assert s["warmed_up"] is True
-    # resolved attention path, not the requested mode
+    # resolved attention paths, not the requested modes
     assert s["paged_kernel"] in ("pallas", "xla")
+    assert s["prefill_kernel"] in ("pallas", "xla")
 
 
 def test_engine_paged_kernel_token_identity(model_and_params):
@@ -418,6 +420,53 @@ def test_engine_paged_kernel_token_identity(model_and_params):
             eng.start()
             det = None
             if mode == "on":
+                tracer = tracing.SpanTracer()
+                det = tracing.RecompileDetector(tracer)
+                tracing.install_tracing(
+                    tracing.Tracing(tracer=tracer, recompile=det))
+                det.mark_steady()
+            try:
+                rs = [eng.submit(p, SamplingParams(max_new_tokens=8,
+                                                   **GREEDY))
+                      for p in prompts]
+                outs.append([r.result(timeout=180).tokens for r in rs])
+            finally:
+                eng.stop()
+                if det is not None:
+                    tracing.install_tracing(None)
+            if det is not None:
+                assert det.recompiles == 0, \
+                    f"{det.recompiles} recompiles: {list(det.events)}"
+    finally:
+        pa._INTERPRET = old
+    assert outs[0] == outs[1]
+
+
+def test_engine_prefill_kernel_token_identity(model_and_params):
+    """Acceptance: greedy generation with the Pallas ragged *prefill*
+    kernel (interpret mode on CPU) is token-identical to the XLA dense
+    branch, the engine reports the resolved prefill path, and with BOTH
+    kernels enabled the engine stays zero-recompile after warmup —
+    prompts here straddle prefill chunks (len > prefill_chunk) so the
+    cached-prefix tail-chunk shape is exercised, not just chunk 0."""
+    from megatron_llm_tpu.ops.pallas import paged_attention as pa
+    model, params = model_and_params
+    prompts = [list(range(1, 12)), [5, 6, 7], list(range(3, 13))]
+    outs = []
+    old = pa._INTERPRET
+    try:
+        for mode in ("off", "on"):
+            pa._INTERPRET = mode == "on"
+            eng = InferenceEngine(model, params, EngineConfig(
+                num_slots=2, block_size=8, prefill_chunk=8,
+                max_model_len=64, default_deadline_secs=0.0,
+                paged_kernel=mode, prefill_kernel=mode))
+            assert eng.prefill_kernel == \
+                ("pallas" if mode == "on" else "xla")
+            eng.warmup()
+            eng.start()
+            det = None
+            if mode == "on":        # both kernels live: still 0 recompiles
                 tracer = tracing.SpanTracer()
                 det = tracing.RecompileDetector(tracer)
                 tracing.install_tracing(

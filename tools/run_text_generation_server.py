@@ -88,6 +88,7 @@ def main():
             int8_kv_cache=args.int8_kv_cache,
             prefix_cache=bool(args.serve_prefix_cache),
             paged_kernel=args.serve_paged_kernel,
+            prefill_kernel=args.serve_prefill_kernel,
             watchdog_secs=args.serve_watchdog_secs,
             preemption=bool(args.serve_preemption),
             fault_spec=args.serve_fault_inject,
@@ -96,6 +97,8 @@ def main():
         print(" * warming up serving engine (compiling prefill/decode "
               "programs)...", flush=True)
         print(f" * paged-attention decode path: {engine.paged_kernel}",
+              flush=True)
+        print(f" * paged-attention prefill path: {engine.prefill_kernel}",
               flush=True)
         engine.warmup()
         from megatron_llm_tpu import tracing

@@ -19,12 +19,16 @@ N-word prompt header and differ only in a short unique tail, so cache
 hits show up as ``prefill_tokens_computed`` ≪ ``prefill_tokens_
 submitted`` (the ``prefill computed/submitted`` bench column).
 
-Kernel A/B (``--ab serve_paged_kernel``) runs the identical workload
-against two servers — one started with ``--serve_paged_kernel on``
-(``--url``) and one with ``off`` (``--ab_url``) — and emits one result
-row per arm, each tagged with ``ab_arm`` and the server's self-reported
-``paged_kernel`` path, so the Pallas-vs-XLA decode throughput delta
-falls out of a single invocation.
+Flag A/B (``--ab <server_flag>``, e.g. ``--ab serve_paged_kernel`` or
+``--ab serve_prefill_kernel``) runs the identical workload against two
+servers — one started with the named boolean flag ``on`` (``--url``)
+and one with ``off`` (``--ab_url``) — and emits one result row per arm,
+each tagged with ``ab_arm`` and the server's self-reported
+``paged_kernel``/``prefill_kernel`` paths, so a Pallas-vs-XLA
+throughput delta falls out of a single invocation.  Prefill throughput
+(computed-prefill tokens/sec, from the engine's
+``prefill_tokens_computed`` counter delta) is reported next to TTFT so
+a prefill A/B measures the thing it changes.
 
 Examples::
 
@@ -33,7 +37,7 @@ Examples::
     python tools/serve_bench.py --clients 8 --requests 32 \\
         --prefix_tokens 256 --shared_prefix_frac 0.75 --json
     python tools/serve_bench.py --url http://host:5000 \\
-        --ab serve_paged_kernel --ab_url http://host:5001 --json
+        --ab serve_prefill_kernel --ab_url http://host:5001 --json
 """
 
 from __future__ import annotations
@@ -59,8 +63,9 @@ JSON_SCHEMA_KEYS = (
     "tpot_p95_secs", "stream", "rate", "prefix_tokens",
     "shared_prefix_frac", "prefill_tokens_submitted",
     "prefill_tokens_computed", "prefill_tokens_cached",
-    "prefill_computed_frac", "prefix_cache_hits", "prefix_cache_misses",
-    "prefix_cache_evictions", "paged_kernel",
+    "prefill_computed_frac", "prefill_tokens_per_sec",
+    "prefix_cache_hits", "prefix_cache_misses",
+    "prefix_cache_evictions", "paged_kernel", "prefill_kernel",
     # resilience counters (engine/server /metrics deltas over the run)
     "engine_restarts", "slots_evicted_nonfinite", "preemptions",
     "drained",
@@ -254,12 +259,16 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         "prefill_tokens_computed": None,
         "prefill_tokens_cached": None,
         "prefill_computed_frac": None,
+        # computed-prefill tokens/sec over the run wall clock — the
+        # number a prefill-kernel A/B actually changes
+        "prefill_tokens_per_sec": None,
         "prefix_cache_hits": None,
         "prefix_cache_misses": None,
         "prefix_cache_evictions": None,
-        # which attention path served the run ('pallas'|'xla', from the
+        # which attention paths served the run ('pallas'|'xla', from the
         # engine /metrics block) — makes bench rows attributable
         "paged_kernel": None,
+        "prefill_kernel": None,
         # resilience activity during the run (engine restarts, sentinel
         # slot evictions, pool-pressure preemptions, drain initiations)
         "engine_restarts": None,
@@ -285,6 +294,7 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         if isinstance(e1, dict):
             out["server_engine"] = e1
             out["paged_kernel"] = e1.get("paged_kernel")
+            out["prefill_kernel"] = e1.get("prefill_kernel")
             if isinstance(e0, dict):
                 def delta(key):
                     a, b = e0.get(key), e1.get(key)
@@ -305,6 +315,8 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                              out["prefill_tokens_computed"])
                 if sub and comp is not None:
                     out["prefill_computed_frac"] = round(comp / sub, 4)
+                if comp is not None and wall > 0:
+                    out["prefill_tokens_per_sec"] = round(comp / wall, 3)
     return out
 
 
@@ -353,7 +365,11 @@ def print_table(r: dict) -> None:
             ("engine decode steps", _fmt(eng.get("decode_steps"))),
             ("engine prefill chunks", _fmt(eng.get("prefill_chunks"))),
             ("engine paged kernel", _fmt(r.get("paged_kernel"))),
+            ("engine prefill kernel", _fmt(r.get("prefill_kernel"))),
         ]
+    if r.get("prefill_tokens_per_sec") is not None:
+        rows += [("prefill throughput",
+                  _fmt(r["prefill_tokens_per_sec"], " tok/s"))]
     if r.get("prefill_tokens_submitted") is not None:
         rows += [
             ("prefill computed/submitted",
@@ -401,10 +417,12 @@ def main(argv=None):
                         "rest get unique same-length headers")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit one JSON object instead of the table")
-    p.add_argument("--ab", choices=["serve_paged_kernel"], default=None,
-                   help="A/B comparison: run the workload against --url "
-                        "(the flag-ON server) and --ab_url (the flag-OFF "
-                        "server), emitting one row per arm")
+    p.add_argument("--ab", default=None, metavar="SERVER_FLAG",
+                   help="A/B comparison over any boolean server flag "
+                        "(e.g. serve_paged_kernel, serve_prefill_kernel): "
+                        "run the workload against --url (the flag-ON "
+                        "server) and --ab_url (the flag-OFF server), "
+                        "emitting one row per arm")
     p.add_argument("--ab_url", default=None,
                    help="base URL of the second (flag-OFF) server for "
                         "--ab")
@@ -423,8 +441,9 @@ def main(argv=None):
             print(json.dumps({"ab": args.ab, "rows": rows}, indent=2))
         else:
             for r in rows:
-                print(f"--- {args.ab}={r['ab_arm']} "
-                      f"(served by: {r.get('paged_kernel') or 'unknown'})")
+                served = (f"decode={r.get('paged_kernel') or 'unknown'} "
+                          f"prefill={r.get('prefill_kernel') or 'unknown'}")
+                print(f"--- {args.ab}={r['ab_arm']} (served by: {served})")
                 print_table(r)
             on, off = rows
             if on["tokens_per_sec"] and off["tokens_per_sec"]:
@@ -432,6 +451,12 @@ def main(argv=None):
                       f"{on['tokens_per_sec']:.3f} / "
                       f"{off['tokens_per_sec']:.3f} tok/s "
                       f"({on['tokens_per_sec'] / off['tokens_per_sec']:.2f}x)")
+            if on.get("prefill_tokens_per_sec") and \
+                    off.get("prefill_tokens_per_sec"):
+                print(f"A/B prefill throughput on/off: "
+                      f"{on['prefill_tokens_per_sec']:.3f} / "
+                      f"{off['prefill_tokens_per_sec']:.3f} tok/s "
+                      f"({on['prefill_tokens_per_sec'] / off['prefill_tokens_per_sec']:.2f}x)")
         return 0 if all(r["errors"] == 0 for r in rows) else 1
     r = run_bench(base_url, **kw)
     if args.as_json:

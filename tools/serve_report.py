@@ -15,6 +15,9 @@ trace_id, per-request phase attribution, tpot_secs) and prints:
   (``--ttft_slo``) and TPOT (``--tpot_slo``) targets, individually and
   jointly (the Gemma-on-TPU serving framing: "X% of requests within
   TTFT <= a and TPOT <= b")
+* prefill throughput — computed-prefill tokens per second of prefill
+  compute, attributed to the attention path (``prefill_kernel``) that
+  served them, next to the TTFT numbers it drives
 * cache-hit stratification — the same latency table split by whether
   the request adopted prefix-cache pages (``cached_prompt_tokens > 0``),
   quantifying what the PR 6 prefix cache is worth end-to-end
@@ -147,6 +150,29 @@ def slo_attainment(records: List[Dict], ttft_slo: float,
     }
 
 
+def prefill_summary(records: List[Dict]) -> Dict:
+    """Computed-prefill throughput: tokens actually pushed through the
+    chunked-prefill attention path per second of prefill compute (the
+    offline twin of serve_bench's prefill tokens/sec), plus which
+    attention path ('pallas'|'xla') served each request so an A/B over
+    ``--serve_prefill_kernel`` stays attributable after the fact."""
+    toks = sum(r.get("prefill_computed_tokens") or 0 for r in records)
+    secs = sum(p["prefill_secs"]
+               for p in (r.get("phases") or {} for r in records)
+               if isinstance(p.get("prefill_secs"), (int, float)))
+    kernels: Dict[str, int] = {}
+    for r in records:
+        k = r.get("prefill_kernel")
+        if k:
+            kernels[k] = kernels.get(k, 0) + 1
+    return {
+        "computed_tokens": toks,
+        "compute_secs": secs,
+        "tokens_per_sec": (toks / secs) if secs > 0 else None,
+        "kernel": kernels,
+    }
+
+
 def cache_stratified(records: List[Dict]) -> Dict:
     hits = [r for r in records
             if (r.get("cached_prompt_tokens") or 0) > 0]
@@ -176,6 +202,7 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
         "summary": latency_summary(all_records),
         "phases": phase_breakdown(all_records),
         "slo": slo_attainment(all_records, ttft_slo, tpot_slo),
+        "prefill": prefill_summary(all_records),
         "by_cache": cache_stratified(all_records),
         "finish_reasons": {},
         "traced": sum(1 for r in all_records if r.get("trace_id")),
@@ -245,6 +272,15 @@ def render(report: Dict) -> str:
         lines.append(f"  {'unattributed':>18} "
                      f"{_fmt(ph['unattributed_secs']):>10} "
                      f"{frac * 100:5.1f}%")
+
+    pf = report.get("prefill") or {}
+    if pf.get("computed_tokens"):
+        tps = pf.get("tokens_per_sec")
+        kern = json.dumps(pf.get("kernel") or {}, sort_keys=True)
+        lines.append(f"\nprefill compute: {pf['computed_tokens']} tokens "
+                     f"in {_fmt(pf['compute_secs'])} -> "
+                     + (f"{tps:.1f} tok/s" if tps else "-")
+                     + f" (kernel: {kern})")
 
     slo = report["slo"]
     lines.append(f"\nSLO attainment (ttft <= {slo['ttft_slo_secs']}s, "

@@ -95,6 +95,14 @@ MANIFEST: List[Step] = [
          "python -m pytest tests/test_serving_resilience.py "
          "-m chaos -q -p no:cacheprovider",
          900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+    # prefill-kernel A/B smoke: serve_bench --ab serve_prefill_kernel
+    # against two real replica processes (Pallas-interpret vs XLA
+    # chunked prefill), asserting per-arm prefill tokens/sec + TTFT —
+    # proves the whole flag->engine->metrics->bench chain on CPU
+    Step("serve_prefill_ab",
+         "python -m pytest tests/test_serve_bench_tool.py "
+         "-k ab_prefill -q -p no:cacheprovider",
+         900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
 ]
 
 
