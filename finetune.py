@@ -579,6 +579,7 @@ def main():
             log_layer_stats_interval=args.log_layer_stats_interval,
             writer=writer,
             tensorboard_log_interval=args.tensorboard_log_interval,
+            log_timers=args.log_timers_to_tensorboard,
             log_memory=args.log_memory_to_tensorboard,
             log_batch_size=args.log_batch_size_to_tensorboard,
             log_world_size=args.log_world_size_to_tensorboard,

@@ -1,0 +1,88 @@
+"""graft-lint: repo-native, stdlib-only static analysis.
+
+The codebase stakes its serving and training performance on invariants
+that runtime tests can only spot-check: zero steady-state recompiles,
+flags threaded by hand from ``arguments.py`` to consumers, a versioned
+telemetry schema, the stdlib-only contract for the report/bench tools,
+and the serving engine's lock discipline.  This package encodes those
+invariants as AST checkers so drift becomes a lint error at review time
+instead of a production regression (the MegaScale observation: at scale
+these classes of drift are caught by tooling, not review).
+
+Everything here is standard library only (``ast`` + ``json`` + ``os``)
+so ``tools/graft_lint.py`` runs anywhere — no jax, no repo imports at
+analysis time (the *target* files are parsed, never imported).
+
+Checkers (see docs/guide/static_analysis.md for the catalogue):
+
+==========  =====================================================
+name        invariant
+==========  =====================================================
+recompile   no host-sync / retrace hazards reachable from
+            ``jax.jit`` / ``shard_map`` / ``pallas_call`` roots
+flags       every ``arguments.py`` flag is consumed and every
+            ``args.x`` read exists; config dataclass fields are read
+telemetry   request_done writer keys == golden test frozenset ==
+            recorded schema snapshot; key changes require a
+            ``TELEMETRY_SCHEMA_VERSION`` bump
+stdlib      tools documented as stdlib-only import only the stdlib
+locks       no blocking calls while a serving lock is held; writes
+            to ``_lock_protected_`` fields hold the declared lock
+markers     every ``pytest.mark.<m>`` under tests/ is registered
+==========  =====================================================
+
+Suppressions live in ``.graftlint.json`` at the repo root; every entry
+must carry a one-line justification (enforced at load time).
+"""
+
+from __future__ import annotations
+
+from megatron_llm_tpu.analysis.core import (  # noqa: F401
+    Baseline,
+    BaselineError,
+    Repo,
+    Violation,
+)
+from megatron_llm_tpu.analysis import (  # noqa: F401
+    flags,
+    locks,
+    markers,
+    recompile,
+    stdlib_gate,
+    telemetry_schema,
+)
+
+#: checker name -> callable(Repo, Baseline) -> list[Violation].
+#: Ordered: output and --checkers selection follow this order.
+CHECKERS = {
+    "recompile": recompile.check,
+    "flags": flags.check,
+    "telemetry": telemetry_schema.check,
+    "stdlib": stdlib_gate.check,
+    "locks": locks.check,
+    "markers": markers.check,
+}
+
+
+def run_checkers(repo, baseline, names=None):
+    """Run the named checkers (all when ``names`` is None).
+
+    Returns ``(unsuppressed, suppressed, stale_suppressions)`` — the
+    violations not covered by the baseline, the ones that were, and the
+    baseline fingerprints that matched nothing (ratchet candidates).
+    """
+    names = list(CHECKERS) if names is None else list(names)
+    unknown = [n for n in names if n not in CHECKERS]
+    if unknown:
+        raise ValueError(
+            f"unknown checker(s) {unknown}; available: {list(CHECKERS)}")
+    found = []
+    for name in names:
+        found.extend(CHECKERS[name](repo, baseline))
+    found.sort(key=lambda v: (v.path, v.line, v.code))
+    unsuppressed = [v for v in found if not baseline.suppresses(v)]
+    suppressed = [v for v in found if baseline.suppresses(v)]
+    matched = {v.fingerprint for v in suppressed}
+    stale = [fp for fp in baseline.fingerprints()
+             if fp not in matched and baseline.checker_of(fp) in names]
+    return unsuppressed, suppressed, stale

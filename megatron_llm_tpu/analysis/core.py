@@ -1,0 +1,257 @@
+"""graft-lint framework: violations, the repo AST cache, the baseline.
+
+Checkers never import the code they analyze — they parse it with
+``ast`` through :class:`Repo`, so the linter runs without jax installed
+and can't be perturbed by import-time side effects.
+
+Suppression model (ratchet, not allowlist): a :class:`Violation`'s
+fingerprint is ``checker:CODE:path:symbol`` — deliberately line-number
+free so a suppression survives unrelated edits to the same file but dies
+with the symbol it excuses.  ``.graftlint.json`` entries MUST carry a
+non-empty ``justification``; :class:`Baseline` refuses to load entries
+without one, so "why is this exempt" is answered in the diff that adds
+the exemption, not in archaeology.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+BASELINE_FILENAME = ".graftlint.json"
+
+#: directories never scanned (generated/vendored/VCS state)
+SKIP_DIRS = frozenset((
+    ".git", "__pycache__", ".pytest_cache", "build", "dist",
+    ".graft_scratch", "node_modules",
+))
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One finding.  ``symbol`` is the stable anchor (function name,
+    flag dest, record key...) used for the suppression fingerprint, so
+    keep it free of line numbers and transient detail."""
+
+    checker: str
+    code: str          # e.g. "RC001"
+    path: str          # repo-relative, forward slashes
+    line: int
+    symbol: str
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.checker}:{self.code}:{self.path}:{self.symbol}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.code} [{self.checker}] "
+                f"{self.message}")
+
+
+class BaselineError(Exception):
+    """Malformed ``.graftlint.json`` (bad JSON, entry without a
+    justification, unknown top-level keys)."""
+
+
+class Baseline:
+    """The checked-in suppression + schema-snapshot file.
+
+    Shape::
+
+        {
+          "version": 1,
+          "telemetry_schema": {"version": 6, "request_done_keys": [...]},
+          "suppressions": [
+            {"id": "<checker>:<CODE>:<path>:<symbol>",
+             "justification": "one line on why this is exempt"}
+          ]
+        }
+    """
+
+    def __init__(self, suppressions: Optional[Dict[str, str]] = None,
+                 telemetry_schema: Optional[dict] = None,
+                 path: Optional[str] = None):
+        self._supp: Dict[str, str] = dict(suppressions or {})
+        self.telemetry_schema = telemetry_schema
+        self.path = path
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path=path)
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError) as e:
+            raise BaselineError(f"{path}: unreadable baseline: {e}")
+        if not isinstance(raw, dict):
+            raise BaselineError(f"{path}: baseline must be a JSON object")
+        unknown = set(raw) - {"version", "telemetry_schema", "suppressions"}
+        if unknown:
+            raise BaselineError(f"{path}: unknown keys {sorted(unknown)}")
+        supp: Dict[str, str] = {}
+        for i, entry in enumerate(raw.get("suppressions", ())):
+            if not isinstance(entry, dict) or "id" not in entry:
+                raise BaselineError(
+                    f"{path}: suppression #{i} must be an object with "
+                    f"'id' and 'justification'")
+            just = str(entry.get("justification", "")).strip()
+            if not just:
+                raise BaselineError(
+                    f"{path}: suppression {entry['id']!r} has no "
+                    f"justification — every exemption must say why")
+            supp[str(entry["id"])] = just
+        return cls(supp, raw.get("telemetry_schema"), path=path)
+
+    def save(self, path: Optional[str] = None) -> None:
+        path = path or self.path
+        out = {"version": 1}
+        if self.telemetry_schema is not None:
+            out["telemetry_schema"] = self.telemetry_schema
+        out["suppressions"] = [
+            {"id": fp, "justification": just}
+            for fp, just in sorted(self._supp.items())
+        ]
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    # -- queries --------------------------------------------------------
+
+    def suppresses(self, v: Violation) -> bool:
+        return v.fingerprint in self._supp
+
+    def add(self, fingerprint: str, justification: str) -> None:
+        if not justification.strip():
+            raise BaselineError(
+                f"refusing to add {fingerprint!r} without a justification")
+        self._supp[fingerprint] = justification
+
+    def fingerprints(self) -> List[str]:
+        return sorted(self._supp)
+
+    @staticmethod
+    def checker_of(fingerprint: str) -> str:
+        return fingerprint.split(":", 1)[0]
+
+
+class Repo:
+    """Filesystem + AST cache over one repo checkout.
+
+    Paths in and out are repo-relative with forward slashes; trees are
+    parsed once and shared across checkers.  Files that fail to parse
+    are surfaced as a synthetic ``GL000`` violation rather than crashing
+    the run (the linter must degrade on a broken worktree)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._trees: Dict[str, Optional[ast.AST]] = {}
+        self._sources: Dict[str, str] = {}
+        self.parse_errors: List[Violation] = []
+
+    def abspath(self, rel: str) -> str:
+        return os.path.join(self.root, *rel.split("/"))
+
+    def exists(self, rel: str) -> bool:
+        return os.path.exists(self.abspath(rel))
+
+    def py_files(self, *subdirs: str) -> List[str]:
+        """Repo-relative paths of .py files under the given
+        subdirectories (the whole repo when none are given), sorted."""
+        roots = [self.abspath(s) for s in subdirs] if subdirs else [self.root]
+        out: List[str] = []
+        for top in roots:
+            if os.path.isfile(top) and top.endswith(".py"):
+                out.append(os.path.relpath(top, self.root).replace(os.sep, "/"))
+                continue
+            for dirpath, dirnames, filenames in os.walk(top):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(os.path.join(dirpath, fn),
+                                              self.root)
+                        out.append(rel.replace(os.sep, "/"))
+        return sorted(set(out))
+
+    def source(self, rel: str) -> str:
+        if rel not in self._sources:
+            with open(self.abspath(rel), encoding="utf-8") as f:
+                self._sources[rel] = f.read()
+        return self._sources[rel]
+
+    def tree(self, rel: str) -> Optional[ast.AST]:
+        """Parsed AST, or None when the file is missing/unparseable (a
+        GL000 violation is recorded once for the latter)."""
+        if rel not in self._trees:
+            if not self.exists(rel):
+                self._trees[rel] = None
+            else:
+                try:
+                    self._trees[rel] = ast.parse(self.source(rel),
+                                                 filename=rel)
+                except SyntaxError as e:
+                    self._trees[rel] = None
+                    self.parse_errors.append(Violation(
+                        "core", "GL000", rel, e.lineno or 0, "syntax",
+                        f"file does not parse: {e.msg}"))
+        return self._trees[rel]
+
+
+# -- small AST helpers shared by checkers -------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Constant-string tuple/list literal -> tuple of strings."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            s = const_str(el)
+            if s is None:
+                return None
+            out.append(s)
+        return tuple(out)
+    return None
+
+
+def dict_str_keys(node: ast.AST) -> List[Tuple[str, int]]:
+    """(key, lineno) for every constant-string key of a dict literal
+    (``**spread`` entries are ignored — callers decide if that's ok)."""
+    out: List[Tuple[str, int]] = []
+    if isinstance(node, ast.Dict):
+        for k in node.keys:
+            if k is not None:
+                s = const_str(k)
+                if s is not None:
+                    out.append((s, k.lineno))
+    return out
+
+
+def walk_functions(tree: ast.AST):
+    """Yield every FunctionDef/AsyncFunctionDef in the tree."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

@@ -1,0 +1,246 @@
+"""Checker ``flags``: CLI flag wiring and config-field liveness.
+
+~40 flags are threaded by hand from ``arguments.py`` through
+``validate_args`` into the config dataclasses and the serving engine.
+Two drift modes, both silent today:
+
+* a flag is added (or its consumer deleted) and nothing reads
+  ``args.x`` any more — dead configuration that users still set and
+  reasonably expect to work;
+* code reads ``args.y`` for a ``y`` no parser defines — a typo that
+  only explodes as ``AttributeError`` on the one code path that
+  reaches it.
+
+Codes:
+
+* ``FW001`` — flag defined in ``arguments.py`` with no ``args.<dest>``
+  read anywhere in non-test code.  Flags in the documented noop groups
+  (``_add_compat_noop_args`` — accepted-and-ignored CUDA-compat
+  surface; ``_add_unimplemented_compat_args`` — unimplemented
+  reference features that warn when set) are exempt by design.
+* ``FW002`` — ``args.<x>`` read (or 2-arg ``getattr(args, "x")``) for
+  an ``x`` no parser defines and no code derives (``args.x = ...``).
+  3-arg ``getattr`` carries its own default and is never an error.
+* ``FW003`` — ``EngineConfig`` / ``TransformerConfig`` field never
+  read anywhere in the repo (dead knob).
+
+Namespace attribution: any file may build its own local
+``ArgumentParser`` (tools, entry scripts, extra-args providers), so
+the "known attrs" universe is the union of every ``add_argument``
+dest, every ``set_defaults`` key, and every ``args.x = ...`` /
+``setattr(args, 'x', ...)`` derivation in non-test code.  FW001 only
+fires for ``arguments.py`` dests (the shared surface); FW002 fires
+when a read matches *no* definition anywhere — true typo detection
+with no cross-file namespace guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from megatron_llm_tpu.analysis.core import (
+    Repo, Violation, const_str, dotted_name,
+)
+
+CHECKER = "flags"
+
+ARGUMENTS = "megatron_llm_tpu/arguments.py"
+ENGINE = "megatron_llm_tpu/serving/engine.py"
+CONFIG = "megatron_llm_tpu/config.py"
+
+#: flag-group functions whose dests are accepted-and-ignored by
+#: documented contract: CUDA-reference compatibility details, and
+#: unimplemented reference features that warn loudly in validate_args
+NOOP_GROUPS = frozenset(("_add_compat_noop_args",
+                         "_add_unimplemented_compat_args"))
+
+#: names treated as argparse-namespace variables when attributing reads
+_ARGS_NAMES = frozenset(("args", "margs", "ns", "cli_args"))
+
+#: argparse.Namespace own attributes — never flag reads of these
+_NAMESPACE_BUILTINS = frozenset(("__dict__",))
+
+
+def _dest_of(call: ast.Call) -> Optional[Tuple[str, int]]:
+    """(dest, lineno) for an ``add_argument`` call, None for
+    positionals/non-flag calls."""
+    for kw in call.keywords:
+        if kw.arg == "dest":
+            s = const_str(kw.value)
+            if s:
+                return s, call.lineno
+    first_long = None
+    for a in call.args:
+        s = const_str(a)
+        if s is None:
+            return None
+        if not s.startswith("-"):
+            # positional: the name itself is the dest
+            return s.replace("-", "_"), call.lineno
+        if s.startswith("--") and first_long is None:
+            first_long = s
+    if first_long is None:
+        return None
+    return first_long.lstrip("-").replace("-", "_"), call.lineno
+
+
+def _enclosing_function_name(tree: ast.AST, call: ast.Call) -> Optional[str]:
+    line = call.lineno
+    best = None
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(n, "end_lineno", n.lineno)
+            if n.lineno <= line <= end and (
+                    best is None or n.lineno >= best.lineno):
+                best = n
+    return best.name if best else None
+
+
+def _collect_defined(repo: Repo, rel: str, tree: ast.AST,
+                     global_dests: Dict[str, Tuple[int, str]],
+                     any_defined: Set[str]) -> None:
+    """Harvest add_argument dests, set_defaults keys, and derived
+    ``args.x = ...`` / ``setattr(args, ...)`` assignments."""
+    is_arguments = rel == ARGUMENTS
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "add_argument":
+                hit = _dest_of(node)
+                if hit:
+                    dest, line = hit
+                    any_defined.add(dest)
+                    if is_arguments:
+                        group = _enclosing_function_name(tree, node) or ""
+                        global_dests.setdefault(dest, (line, group))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "set_defaults":
+                for kw in node.keywords:
+                    if kw.arg:
+                        any_defined.add(kw.arg)
+            elif d == "setattr" and len(node.args) >= 2 \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in _ARGS_NAMES:
+                s = const_str(node.args[1])
+                if s:
+                    any_defined.add(s)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in _ARGS_NAMES:
+                    any_defined.add(t.attr)
+
+
+def _collect_reads(rel: str, tree: ast.AST,
+                   reads: Dict[str, List[Tuple[str, int]]],
+                   guarded: Set[str]) -> None:
+    """``args.x`` loads and ``getattr(args, 'x'[, default])`` calls.
+    3-arg getattr / hasattr are recorded as guarded (consume the flag
+    but can never be a typo error)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in _ARGS_NAMES \
+                and node.attr not in _NAMESPACE_BUILTINS:
+            reads.setdefault(node.attr, []).append((rel, node.lineno))
+        elif isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d in ("getattr", "hasattr") and len(node.args) >= 2 \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in _ARGS_NAMES:
+                s = const_str(node.args[1])
+                if s:
+                    if d == "hasattr" or len(node.args) >= 3:
+                        guarded.add(s)
+                    else:
+                        reads.setdefault(s, []).append((rel, node.lineno))
+
+
+def _dataclass_fields(tree: ast.AST, cls_name: str) -> Dict[str, int]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            out = {}
+            for sub in node.body:
+                if isinstance(sub, ast.AnnAssign) \
+                        and isinstance(sub.target, ast.Name):
+                    out[sub.target.id] = sub.lineno
+            return out
+    return {}
+
+
+def _non_test_files(repo: Repo) -> List[str]:
+    return [p for p in repo.py_files()
+            if not p.startswith("tests/") and "/tests/" not in p]
+
+
+def check(repo: Repo, baseline=None) -> List[Violation]:
+    out: List[Violation] = []
+    files = _non_test_files(repo)
+    trees = [(rel, repo.tree(rel)) for rel in files]
+    trees = [(rel, t) for rel, t in trees if t is not None]
+
+    global_dests: Dict[str, Tuple[int, str]] = {}
+    any_defined: Set[str] = set()
+    reads: Dict[str, List[Tuple[str, int]]] = {}
+    guarded: Set[str] = set()
+    for rel, tree in trees:
+        _collect_defined(repo, rel, tree, global_dests, any_defined)
+        _collect_reads(rel, tree, reads, guarded)
+
+    # FW001: dead global flags (no read anywhere in non-test code)
+    consumed = set(reads) | guarded
+    for dest, (line, group) in sorted(global_dests.items()):
+        if group in NOOP_GROUPS:
+            continue
+        if dest not in consumed:
+            out.append(Violation(
+                CHECKER, "FW001", ARGUMENTS, line, dest,
+                f"flag dest '{dest}' (group {group or '<module>'}) has "
+                f"no args.{dest} consumer in non-test code — dead flag; "
+                f"wire it or delete it"))
+
+    # FW002: reads of attrs nothing defines (typo'd args.y)
+    for attr, sites in sorted(reads.items()):
+        if attr in any_defined:
+            continue
+        rel, line = sites[0]
+        # reads inside arguments.py of a dest being built in the same
+        # pass are already covered by any_defined; anything left is a
+        # genuine phantom
+        out.append(Violation(
+            CHECKER, "FW002", rel, line, attr,
+            f"args.{attr} read but no parser defines dest '{attr}' and "
+            f"no code derives it — runtime AttributeError waiting "
+            f"({len(sites)} read site(s))"))
+
+    # FW003: dead config-dataclass fields
+    attr_reads: Set[str] = set()
+    kw_uses: Set[str] = set()
+    for rel, tree in trees + [(p, repo.tree(p)) for p in repo.py_files()
+                              if p.startswith("tests/")
+                              and repo.tree(p) is not None]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                attr_reads.add(node.attr)
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg:
+                        kw_uses.add(kw.arg)
+    for rel, cls in ((ENGINE, "EngineConfig"), (CONFIG, "TransformerConfig")):
+        tree = repo.tree(rel)
+        if tree is None:
+            continue
+        for name, line in sorted(_dataclass_fields(tree, cls).items()):
+            if name not in attr_reads:
+                out.append(Violation(
+                    CHECKER, "FW003", rel, line, f"{cls}.{name}",
+                    f"{cls}.{name} is never read anywhere in the repo "
+                    f"(constructed-but-dead knob)"))
+    return out

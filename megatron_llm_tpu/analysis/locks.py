@@ -1,0 +1,217 @@
+"""Checker ``locks``: serving-engine lock discipline.
+
+The serving stack is three threads (HTTP front-end, engine loop,
+watchdog) sharing the block manager, the router's backend table, and
+the engine's restart state.  The discipline that keeps p99s flat is
+(a) never block while holding a lock — a ``time.sleep`` or HTTP round
+trip under ``BlockManager._lock`` stalls every admission on the box —
+and (b) every write to shared state holds the owning lock.  Chaos
+tests exercise (a)/(b) probabilistically; this checker makes them
+structural:
+
+* ``LD001`` — blocking call (``time.sleep``, ``subprocess.*``,
+  ``socket.*``/``urllib``/``http.client`` IO, ``open()``,
+  ``.result()``, ``.getresponse()``, ``.join()``) lexically inside a
+  ``with self.<...lock...>:`` block in ``serving/*.py``.
+* ``LD002`` — a class declares its shared fields with a
+  ``_lock_protected_`` class attribute (tuple ⇒ guarded by
+  ``self._lock``; dict ⇒ field → lock attribute name).  Writing such
+  a field — assignment, augmented assignment, ``x[k] = v`` stores, or
+  a mutating method call (``append``/``pop``/``update``/...) —
+  outside a ``with self.<lock>:`` block is an error.  ``__init__``
+  and methods named ``*_locked`` (the "caller holds the lock"
+  convention) are exempt.
+
+The annotation is deliberately in the code, next to the fields it
+protects, so the contract travels with refactors instead of living in
+the linter.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from megatron_llm_tpu.analysis.core import Repo, Violation, dotted_name
+
+CHECKER = "locks"
+
+SERVING_DIR = "megatron_llm_tpu/serving"
+
+ANNOTATION = "_lock_protected_"
+DEFAULT_LOCK = "_lock"
+
+#: dotted-call prefixes that block the calling thread
+_BLOCKING_PREFIXES = (
+    "time.sleep", "subprocess.", "socket.", "urllib.", "http.client.",
+    "os.fsync", "select.", "shutil.",
+)
+#: bare calls that do file IO
+_BLOCKING_NAMES = frozenset(("open",))
+#: attribute-call names that mutate a container in place
+_MUTATORS = frozenset((
+    "append", "extend", "insert", "pop", "popitem", "popleft", "clear",
+    "remove", "discard", "add", "update", "setdefault", "appendleft",
+    "move_to_end", "sort", "fill",
+))
+
+
+def _is_lock_attr(name: str) -> bool:
+    return "lock" in name.lower()
+
+
+def _with_lock_names(node: ast.With) -> Set[str]:
+    """Names of self.<lock> attributes this with-statement acquires."""
+    out: Set[str] = set()
+    for item in node.items:
+        d = dotted_name(item.context_expr)
+        if d and d.startswith("self.") and _is_lock_attr(d[5:]):
+            out.add(d[5:])
+    return out
+
+
+def _protected_fields(cls: ast.ClassDef) -> Dict[str, str]:
+    """field -> required lock name, from the ``_lock_protected_``
+    class attribute (tuple of names, or dict name -> lock attr)."""
+    for node in cls.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == ANNOTATION:
+                    v = node.value
+                    if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                        return {el.value: DEFAULT_LOCK
+                                for el in v.elts
+                                if isinstance(el, ast.Constant)
+                                and isinstance(el.value, str)}
+                    if isinstance(v, ast.Dict):
+                        out = {}
+                        for k, lv in zip(v.keys, v.values):
+                            if isinstance(k, ast.Constant) \
+                                    and isinstance(lv, ast.Constant):
+                                out[k.value] = lv.value
+                        return out
+    return {}
+
+
+def _self_field(expr: ast.AST) -> Optional[str]:
+    """'x' for an expression rooted at ``self.x`` (through any chain of
+    subscripts/attributes), else None."""
+    node = expr
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        node = node.value
+    return None
+
+
+class _FunctionScanner:
+    """One pass over a method body tracking the set of locks held at
+    each node (lexically, via enclosing ``with self.<lock>:``)."""
+
+    def __init__(self, rel: str, cls_name: str, fn: ast.AST,
+                 protected: Dict[str, str], out: List[Violation]):
+        self.rel = rel
+        self.cls_name = cls_name
+        self.fn = fn
+        self.protected = protected
+        self.out = out
+        self.check_writes = bool(protected) \
+            and fn.name != "__init__" \
+            and not fn.name.endswith("_locked")
+
+    def scan(self) -> None:
+        for stmt in self.fn.body:
+            self._visit(stmt, frozenset())
+
+    def _visit(self, node: ast.AST, held: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return      # nested defs run later, outside this lock scope
+        if isinstance(node, ast.With):
+            inner = held | _with_lock_names(node)
+            for item in node.items:
+                self._visit(item.context_expr, held)
+            for sub in node.body:
+                self._visit(sub, inner)
+            return
+        self._check(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _check(self, node: ast.AST, held: frozenset) -> None:
+        label = f"{self.cls_name}.{self.fn.name}"
+        if isinstance(node, ast.Call) and held:
+            d = dotted_name(node.func)
+            blocking = None
+            if d is not None:
+                if d in _BLOCKING_NAMES:
+                    blocking = d
+                else:
+                    for p in _BLOCKING_PREFIXES:
+                        if d == p.rstrip(".") or d.startswith(p):
+                            blocking = d
+                            break
+            if blocking is None and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                recv = dotted_name(node.func.value)
+                if attr in ("result", "getresponse"):
+                    blocking = f".{attr}()"
+                elif attr in ("join", "wait", "acquire") \
+                        and recv is not None \
+                        and recv.startswith("self.") \
+                        and not _is_lock_attr(recv):
+                    # thread/event waits held on self (str.join and
+                    # local-variable receivers are out of scope)
+                    blocking = f"{recv}.{attr}()"
+            if blocking is not None:
+                locks = "/".join(sorted(held))
+                self.out.append(Violation(
+                    CHECKER, "LD001", self.rel, node.lineno,
+                    f"{label}/{blocking}",
+                    f"blocking call {blocking} while holding "
+                    f"self.{locks} in {label} — do the slow work "
+                    f"outside the critical section"))
+        if self.check_writes:
+            fields = []
+            if isinstance(node, ast.Assign):
+                fields = [(_self_field(t), t) for t in node.targets]
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                fields = [(_self_field(node.target), node.target)]
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                fields = [(_self_field(node.func.value), node.func)]
+            elif isinstance(node, ast.Delete):
+                fields = [(_self_field(t), t) for t in node.targets]
+            for field, tnode in fields:
+                # plain `self.x = ...` rebinding is only a protected
+                # write when x itself is protected; `self.x[k] = v`
+                # and mutator calls count too (same object mutated)
+                if field is None or field not in self.protected:
+                    continue
+                need = self.protected[field]
+                if need not in held:
+                    self.out.append(Violation(
+                        CHECKER, "LD002", self.rel, tnode.lineno,
+                        f"{label}/{field}",
+                        f"write to lock-protected field self.{field} "
+                        f"in {label} without holding self.{need} "
+                        f"(declared in {self.cls_name}.{ANNOTATION})"))
+
+
+def check(repo: Repo, baseline=None) -> List[Violation]:
+    out: List[Violation] = []
+    for rel in repo.py_files(SERVING_DIR):
+        tree = repo.tree(rel)
+        if tree is None:
+            continue
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            protected = _protected_fields(cls)
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    _FunctionScanner(rel, cls.name, fn, protected,
+                                     out).scan()
+    return out

@@ -1,0 +1,78 @@
+"""Checker ``markers``: every pytest marker used is registered.
+
+There is no ``pytest.ini``/``pyproject.toml`` in this repo — marker
+registration lives solely in ``tests/conftest.py``'s
+``pytest_configure`` (``config.addinivalue_line("markers", ...)``),
+and pytest treats unknown markers as a *warning*, so a typo'd
+``@pytest.mark.solw`` silently stops deselecting under
+``-m 'not slow'`` and a slow test sneaks into tier-1.  ``PM001`` makes
+that a lint error: every ``pytest.mark.<m>`` under ``tests/`` must be
+registered or a pytest builtin.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from megatron_llm_tpu.analysis.core import (
+    Repo, Violation, const_str, dotted_name,
+)
+
+CHECKER = "markers"
+
+CONFTEST = "tests/conftest.py"
+
+#: markers pytest itself defines — always allowed
+BUILTIN_MARKERS = frozenset((
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast", "timeout",
+))
+
+
+def registered_markers(repo: Repo) -> Set[str]:
+    tree = repo.tree(CONFTEST)
+    out: Set[str] = set()
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "addinivalue_line" \
+                and len(node.args) >= 2 \
+                and const_str(node.args[0]) == "markers":
+            line = const_str(node.args[1])
+            if line:
+                out.add(line.split(":", 1)[0].split("(", 1)[0].strip())
+    return out
+
+
+def used_markers(repo: Repo) -> Dict[str, List[Tuple[str, int]]]:
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for rel in repo.py_files("tests"):
+        tree = repo.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            d = dotted_name(node) if isinstance(node, ast.Attribute) \
+                else None
+            if d and d.startswith("pytest.mark."):
+                m = d.split(".")[2]
+                out.setdefault(m, []).append((rel, node.lineno))
+    return out
+
+
+def check(repo: Repo, baseline=None) -> List[Violation]:
+    registered = registered_markers(repo)
+    out: List[Violation] = []
+    for marker, sites in sorted(used_markers(repo).items()):
+        if marker in BUILTIN_MARKERS or marker in registered:
+            continue
+        rel, line = sites[0]
+        out.append(Violation(
+            CHECKER, "PM001", rel, line, marker,
+            f"pytest.mark.{marker} is not registered in {CONFTEST} "
+            f"(unknown markers never deselect — a typo here silently "
+            f"changes which tests tier-1 runs; {len(sites)} use "
+            f"site(s))"))
+    return out

@@ -1,0 +1,243 @@
+"""Checker ``telemetry``: the versioned JSONL schema contract.
+
+``TELEMETRY_SCHEMA_VERSION`` has been bumped six times by hand across
+PRs 2–11; the invariant that keeps downstream consumers
+(``tools/serve_report.py``, ``tools/serve_bench.py``, dashboards) sane
+is three-way agreement between writers, the golden test, and the bench
+schema — plus "changing the record shape bumps the version".  Each leg
+is enforced statically:
+
+* ``TS001`` — the ``request_done`` record literal in
+  ``engine._retire`` must carry exactly the keys in the golden
+  frozenset of ``test_request_done_schema_golden``.
+* ``TS002`` — the ``phases`` sub-record (``Request.phases``) must
+  match its golden frozenset.
+* ``TS003`` — the summary dict in ``tools/serve_bench.py`` must carry
+  exactly ``JSON_SCHEMA_KEYS`` (conditionally-added extras like
+  ``server_metrics_delta`` are documented as optional and not part of
+  the guaranteed schema).
+* ``TS004`` — ratchet: the baseline records a
+  ``(version, request_done_keys)`` snapshot.  Changing the writer's
+  keys while ``TELEMETRY_SCHEMA_VERSION`` is unchanged is an error —
+  bump the version, then re-record with
+  ``tools/graft_lint.py --record-schema``.
+* ``TS005`` — stale snapshot: the version moved but the snapshot
+  wasn't re-recorded (run ``--record-schema``).
+* ``TS006`` — the golden test's pinned version literal must equal
+  ``telemetry.TELEMETRY_SCHEMA_VERSION`` (the test and the module
+  drifting apart means the "conscious act" guard is dead).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from megatron_llm_tpu.analysis.core import (
+    Repo, Violation, dict_str_keys, dotted_name, str_tuple,
+)
+
+CHECKER = "telemetry"
+
+ENGINE = "megatron_llm_tpu/serving/engine.py"
+REQUEST = "megatron_llm_tpu/serving/request.py"
+TELEMETRY = "megatron_llm_tpu/telemetry.py"
+GOLDEN_TEST = "tests/test_serving_engine.py"
+BENCH = "tools/serve_bench.py"
+
+
+def _function(tree: ast.AST, name: str) -> Optional[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _record_literal_keys(fn: ast.AST, var: str) -> Tuple[Set[str], int]:
+    """Keys of ``var = {...}`` plus later ``var["k"] = ...`` writes."""
+    keys: Set[str] = set()
+    line = fn.lineno
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == var \
+                        and isinstance(node.value, ast.Dict):
+                    keys.update(k for k, _ in dict_str_keys(node.value))
+                    line = node.lineno
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == var \
+                        and isinstance(t.slice, ast.Constant) \
+                        and isinstance(t.slice.value, str):
+                    keys.add(t.slice.value)
+    return keys, line
+
+
+def _return_dict_keys(fn: ast.AST) -> Set[str]:
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Dict):
+            keys.update(k for k, _ in dict_str_keys(node.value))
+    return keys
+
+
+def writer_request_done_keys(repo: Repo) -> Tuple[Set[str], int]:
+    tree = repo.tree(ENGINE)
+    if tree is None:
+        return set(), 0
+    fn = _function(tree, "_retire")
+    if fn is None:
+        return set(), 0
+    return _record_literal_keys(fn, "record")
+
+
+def _golden_sets(repo: Repo):
+    """(record_golden, phases_golden, pinned_version, line) from the
+    golden test, each None when not found."""
+    tree = repo.tree(GOLDEN_TEST)
+    if tree is None:
+        return None, None, None, 0
+    fn = _function(tree, "test_request_done_schema_golden")
+    if fn is None:
+        return None, None, None, 0
+    record = phases = version = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare) and len(node.comparators) == 1:
+            left, right = node.left, node.comparators[0]
+            ld = dotted_name(left)
+            if ld and ld.endswith("TELEMETRY_SCHEMA_VERSION") \
+                    and isinstance(right, ast.Constant) \
+                    and isinstance(right.value, int):
+                version = right.value
+            if isinstance(left, ast.Call) \
+                    and dotted_name(left.func) == "frozenset" \
+                    and isinstance(right, ast.Call) \
+                    and dotted_name(right.func) == "frozenset" \
+                    and right.args:
+                keys = str_tuple(right.args[0])
+                if keys is None:
+                    continue
+                arg = left.args[0] if left.args else None
+                if isinstance(arg, ast.Name):
+                    record = set(keys)
+                elif isinstance(arg, ast.Subscript):
+                    phases = set(keys)
+    return record, phases, version, fn.lineno
+
+
+def _module_version(repo: Repo) -> Tuple[Optional[int], int]:
+    tree = repo.tree(TELEMETRY)
+    if tree is None:
+        return None, 0
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) \
+                        and t.id == "TELEMETRY_SCHEMA_VERSION" \
+                        and isinstance(node.value, ast.Constant):
+                    return node.value.value, node.lineno
+    return None, 0
+
+
+def _bench_schema(repo: Repo):
+    """(JSON_SCHEMA_KEYS set, summary-dict-literal key set, line)."""
+    tree = repo.tree(BENCH)
+    if tree is None:
+        return None, None, 0
+    schema = None
+    line = 0
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "JSON_SCHEMA_KEYS":
+                    keys = str_tuple(node.value)
+                    if keys is not None:
+                        schema, line = set(keys), node.lineno
+    # the guaranteed summary record: the largest dict literal bound to
+    # a name (the optional extras are subscript-assigned and excluded)
+    best: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            keys = {k for k, _ in dict_str_keys(node.value)}
+            if len(keys) > len(best):
+                best = keys
+    return schema, best or None, line
+
+
+def _fmt(keys) -> str:
+    return ", ".join(sorted(keys))
+
+
+def check(repo: Repo, baseline=None) -> List[Violation]:
+    out: List[Violation] = []
+    writer, wline = writer_request_done_keys(repo)
+    golden, phases_golden, pinned, gline = _golden_sets(repo)
+    version, vline = _module_version(repo)
+
+    if writer and golden is not None and writer != golden:
+        missing = golden - writer
+        extra = writer - golden
+        out.append(Violation(
+            CHECKER, "TS001", ENGINE, wline, "request_done",
+            f"request_done writer keys != golden frozenset in "
+            f"{GOLDEN_TEST} (writer-only: [{_fmt(extra)}]; "
+            f"golden-only: [{_fmt(missing)}]) — update both together"))
+
+    if phases_golden is not None:
+        rtree = repo.tree(REQUEST)
+        fn = _function(rtree, "phases") if rtree is not None else None
+        if fn is not None:
+            pkeys = _return_dict_keys(fn)
+            if pkeys and pkeys != phases_golden:
+                out.append(Violation(
+                    CHECKER, "TS002", REQUEST, fn.lineno, "phases",
+                    f"Request.phases() keys != phases golden frozenset "
+                    f"(writer: [{_fmt(pkeys)}]; golden: "
+                    f"[{_fmt(phases_golden)}])"))
+
+    schema, summary, sline = _bench_schema(repo)
+    if schema is not None and summary is not None and schema != summary:
+        out.append(Violation(
+            CHECKER, "TS003", BENCH, sline, "JSON_SCHEMA_KEYS",
+            f"serve_bench summary dict != JSON_SCHEMA_KEYS "
+            f"(summary-only: [{_fmt(summary - schema)}]; schema-only: "
+            f"[{_fmt(schema - summary)}])"))
+
+    snap = baseline.telemetry_schema if baseline is not None else None
+    if writer and isinstance(snap, dict):
+        snap_keys = set(snap.get("request_done_keys", ()))
+        snap_version = snap.get("version")
+        if version is not None and version != snap_version:
+            out.append(Violation(
+                CHECKER, "TS005", TELEMETRY, vline, "schema_snapshot",
+                f"TELEMETRY_SCHEMA_VERSION is {version} but the "
+                f"baseline snapshot records {snap_version} — re-record "
+                f"with tools/graft_lint.py --record-schema"))
+        elif snap_keys and writer != snap_keys:
+            out.append(Violation(
+                CHECKER, "TS004", ENGINE, wline, "request_done",
+                f"request_done keys changed without a "
+                f"TELEMETRY_SCHEMA_VERSION bump (still {version}): "
+                f"added [{_fmt(writer - snap_keys)}], removed "
+                f"[{_fmt(snap_keys - writer)}] — bump the version, "
+                f"update the history comment, then --record-schema"))
+
+    if pinned is not None and version is not None and pinned != version:
+        out.append(Violation(
+            CHECKER, "TS006", GOLDEN_TEST, gline, "pinned_version",
+            f"golden test pins schema version {pinned} but "
+            f"telemetry.TELEMETRY_SCHEMA_VERSION is {version}"))
+    return out
+
+
+def record_snapshot(repo: Repo, baseline) -> dict:
+    """Refresh the baseline's (version, request_done_keys) snapshot —
+    the conscious act after a schema bump."""
+    writer, _ = writer_request_done_keys(repo)
+    version, _ = _module_version(repo)
+    baseline.telemetry_schema = {
+        "version": version,
+        "request_done_keys": sorted(writer),
+    }
+    return baseline.telemetry_schema

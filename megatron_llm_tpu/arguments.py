@@ -39,6 +39,7 @@ def build_base_parser() -> argparse.ArgumentParser:
     _add_inference_args(parser)
     _add_resilience_args(parser)
     _add_compat_noop_args(parser)
+    _add_unimplemented_compat_args(parser)
     return parser
 
 
@@ -74,7 +75,6 @@ def _add_network_size_args(parser):
     # encoder/decoder split names (reference: arguments.py encoder_num_layers
     # et al.; num_layers/seq_length fall back to the encoder_* values)
     g.add_argument("--encoder_num_layers", type=int, default=None)
-    g.add_argument("--decoder_num_layers", type=int, default=None)
     g.add_argument("--encoder_seq_length", type=int, default=None)
     g.add_argument("--hidden_size", type=int, default=None)
     g.add_argument("--ffn_hidden_size", type=int, default=None)
@@ -131,7 +131,6 @@ def _add_network_size_args(parser):
                         "exact erf (Falcon/NeoX)")
     g.add_argument("--no_tie_embed_logits", action="store_false",
                    dest="tie_embed_logits")
-    g.add_argument("--onnx_safe", action="store_true")  # compat
 
 
 def _add_regularization_args(parser):
@@ -162,7 +161,6 @@ def _add_training_args(parser):
     g.add_argument("--global_batch_size", type=int, default=None)
     g.add_argument("--rampup_batch_size", nargs=3, type=int, default=None)
     g.add_argument("--train_iters", type=int, default=None)
-    g.add_argument("--train_samples", type=int, default=None)
     g.add_argument("--exit_interval", type=int, default=None)
     g.add_argument("--exit_duration_in_mins", type=int, default=None)
     g.add_argument("--exit_signal_handler", action="store_true")
@@ -209,13 +207,9 @@ def _add_learning_rate_args(parser):
                    choices=["constant", "linear", "cosine",
                             "inverse-square-root"])
     g.add_argument("--lr_decay_iters", type=int, default=None)
-    g.add_argument("--lr_decay_samples", type=int, default=None)
     g.add_argument("--lr_warmup_fraction", type=float, default=None)
     g.add_argument("--lr_warmup_iters", type=int, default=0)
-    g.add_argument("--lr_warmup_samples", type=int, default=0)
     g.add_argument("--min_lr", type=float, default=0.0)
-    g.add_argument("--override_opt_param_scheduler", action="store_true")
-    g.add_argument("--use_checkpoint_opt_param_scheduler", action="store_true")
 
 
 def _add_checkpointing_args(parser):
@@ -225,13 +219,9 @@ def _add_checkpointing_args(parser):
     g.add_argument("--async_save", action="store_true",
                    help="background tensorstore writes; the tracker file "
                         "lands only once the data is durable")
-    g.add_argument("--no_save_optim", action="store_true")
-    g.add_argument("--no_save_rng", action="store_true")
     g.add_argument("--load", type=str, default=None)
     g.add_argument("--load_iters", type=int, default=None,
                    help="load this iteration instead of the tracker's latest")
-    g.add_argument("--no_load_optim", action="store_true")
-    g.add_argument("--no_load_rng", action="store_true")
     g.add_argument("--finetune", action="store_true")
     g.add_argument("--use_checkpoint_args", action="store_true")
 
@@ -245,8 +235,6 @@ def _add_mixed_precision_args(parser):
     g.add_argument("--min_loss_scale", type=float, default=1.0)
     g.add_argument("--loss_scale_window", type=int, default=1000)
     g.add_argument("--hysteresis", type=int, default=2)
-    g.add_argument("--accumulate_allreduce_grads_in_fp32",
-                   action="store_true", default=True)
     g.add_argument("--attention_softmax_in_fp32", action="store_true",
                    default=True)
     g.add_argument("--no_attention_softmax_in_fp32", action="store_false",
@@ -288,28 +276,20 @@ def _add_distributed_args(parser):
                         "rescue save (default: 17 when --num_slices > 1 so "
                         "the fleet supervisor restarts the job, else 0 for "
                         "single-job backward compatibility)")
-    g.add_argument("--distributed_backend", default="xla",
-                   choices=["xla", "nccl", "gloo"])  # nccl/gloo accepted, mapped to xla
     g.add_argument("--device", default="tpu", choices=["tpu", "cpu"])
-    g.add_argument("--local_rank", type=int, default=None)  # compat
 
 
 def _add_validation_args(parser):
     g = parser.add_argument_group("validation")
     g.add_argument("--eval_iters", type=int, default=100)
     g.add_argument("--eval_interval", type=int, default=1000)
-    g.add_argument("--metrics", nargs="*", default=[])
 
 
 def _add_data_args(parser):
     g = parser.add_argument_group("data")
     g.add_argument("--data_path", nargs="*", default=None)
     g.add_argument("--split", type=str, default="969,30,1")
-    g.add_argument("--train_data_path", nargs="*", default=None)
-    g.add_argument("--valid_data_path", nargs="*", default=None)
-    g.add_argument("--test_data_path", nargs="*", default=None)
     g.add_argument("--data_impl", default="mmap")
-    g.add_argument("--mmap_warmup", action="store_true")
     g.add_argument("--num_workers", type=int, default=2)
     g.add_argument("--tokenizer_type", type=str, default=None)
     g.add_argument("--vocab_file", type=str, default=None)
@@ -327,15 +307,16 @@ def _add_data_args(parser):
     g.add_argument("--variable_seq_lengths", action="store_true")
     g.add_argument("--scalar_loss_mask", type=float, default=0.0)
     g.add_argument("--data_type", default="gpt", choices=["gpt", "instruction"])
-    g.add_argument("--reset_position_ids", action="store_true")
-    g.add_argument("--reset_attention_mask", action="store_true")
-    g.add_argument("--eod_mask_loss", action="store_true")
 
 
 def _add_logging_args(parser):
     g = parser.add_argument_group("logging")
     g.add_argument("--log_interval", type=int, default=100)
-    g.add_argument("--log_timers_to_tensorboard", action="store_true")
+    g.add_argument("--log_timers_to_tensorboard", action="store_true",
+                   help="write per-phase timer scalars (train-step-time "
+                        "et al.) to the metrics writer at log boundaries "
+                        "(reference training.py:509-525 semantics; console "
+                        "timer logging is always on)")
     g.add_argument("--timing_log_level", type=int, default=2,
                    choices=[0, 1, 2],
                    help="default 2 (reference: 0) — per-phase timers are "
@@ -358,7 +339,6 @@ def _add_logging_args(parser):
     g.add_argument("--log_validation_ppl_to_tensorboard",
                    action="store_true")
     g.add_argument("--tensorboard_log_interval", type=int, default=1)
-    g.add_argument("--tensorboard_queue_size", type=int, default=1000)
     g.add_argument("--wandb_resume", action="store_true")
     g.add_argument("--tensorboard_dir", type=str, default=None)
     g.add_argument("--wandb_logger", action="store_true")
@@ -425,9 +405,6 @@ def _add_telemetry_args(parser):
 
 def _add_inference_args(parser):
     g = parser.add_argument_group("inference")
-    g.add_argument("--inference_batch_times_seqlen_threshold", type=int,
-                   default=512)
-    g.add_argument("--max_tokens_to_oom", type=int, default=12000)
     # REST server limits (text_generation_server.py; previously the
     # hardcoded MAX_PROMPTS / MAX_TOKENS module constants)
     g.add_argument("--serve_max_prompts", type=int, default=128,
@@ -614,6 +591,85 @@ def _add_compat_noop_args(parser):
     # mathematically neutral); softmax here always accumulates in fp32
     # unless --no_attention_softmax_in_fp32, so the trick has nothing to fix
     g.add_argument("--no_query_key_layer_scaling", action="store_true")
+    g.add_argument("--onnx_safe", action="store_true")
+    # grad-buffer dtype / DDP backend / torchrun rank plumbing: XLA owns
+    # the reduction dtype and program order on TPU; jax.distributed owns
+    # process bootstrap (nccl/gloo map to xla)
+    g.add_argument("--accumulate_allreduce_grads_in_fp32",
+                   action="store_true", default=True)
+    g.add_argument("--distributed_backend", default="xla",
+                   choices=["xla", "nccl", "gloo"])
+    g.add_argument("--local_rank", type=int, default=None)
+    # mmap page-prewarm and the tensorboardX writer queue are host-side
+    # implementation details of the reference's loaders/writers
+    g.add_argument("--mmap_warmup", action="store_true")
+    g.add_argument("--tensorboard_queue_size", type=int, default=1000)
+
+
+#: dest -> parser default for every flag in _add_unimplemented_compat_args;
+#: validate_args warns loudly when one is set away from its default
+_UNIMPLEMENTED_DEFAULTS = {
+    "decoder_num_layers": None,
+    "train_samples": None,
+    "lr_decay_samples": None,
+    "lr_warmup_samples": 0,
+    "override_opt_param_scheduler": False,
+    "use_checkpoint_opt_param_scheduler": False,
+    "no_save_optim": False,
+    "no_save_rng": False,
+    "no_load_optim": False,
+    "no_load_rng": False,
+    "metrics": [],
+    "train_data_path": None,
+    "valid_data_path": None,
+    "test_data_path": None,
+    "reset_position_ids": False,
+    "reset_attention_mask": False,
+    "eod_mask_loss": False,
+    "inference_batch_times_seqlen_threshold": 512,
+    "max_tokens_to_oom": 12000,
+}
+
+
+def _add_unimplemented_compat_args(parser):
+    """Reference features this stack does not implement (yet): the flags
+    are accepted so A100 launch scripts parse unchanged, but setting one
+    away from its default draws a loud validate_args warning instead of
+    being silently ignored.  Implementing one means moving its
+    ``add_argument`` back into a real group, deleting its
+    ``_UNIMPLEMENTED_DEFAULTS`` entry, and reading ``args.<dest>``
+    somewhere (the graft-lint ``flags`` checker enforces the read)."""
+    g = parser.add_argument_group("unimplemented (accepted with a warning)")
+    # T5 asymmetric-depth decoder
+    g.add_argument("--decoder_num_layers", type=int, default=None)
+    # sample-based (vs iteration-based) run length + lr schedule
+    g.add_argument("--train_samples", type=int, default=None)
+    g.add_argument("--lr_decay_samples", type=int, default=None)
+    g.add_argument("--lr_warmup_samples", type=int, default=0)
+    # scheduler-state checkpoint override policy
+    g.add_argument("--override_opt_param_scheduler", action="store_true")
+    g.add_argument("--use_checkpoint_opt_param_scheduler",
+                   action="store_true")
+    # partial checkpoint save/load (optimizer/rng exclusion)
+    g.add_argument("--no_save_optim", action="store_true")
+    g.add_argument("--no_save_rng", action="store_true")
+    g.add_argument("--no_load_optim", action="store_true")
+    g.add_argument("--no_load_rng", action="store_true")
+    # extra validation metrics beyond loss/ppl
+    g.add_argument("--metrics", nargs="*", default=[])
+    # per-split dataset paths (use --data_path + --split)
+    g.add_argument("--train_data_path", nargs="*", default=None)
+    g.add_argument("--valid_data_path", nargs="*", default=None)
+    g.add_argument("--test_data_path", nargs="*", default=None)
+    # document-boundary resets inside packed sequences
+    g.add_argument("--reset_position_ids", action="store_true")
+    g.add_argument("--reset_attention_mask", action="store_true")
+    g.add_argument("--eod_mask_loss", action="store_true")
+    # reference text-generation heuristics (the serving engine's
+    # admission control replaces them: --serve_max_tokens et al.)
+    g.add_argument("--inference_batch_times_seqlen_threshold", type=int,
+                   default=512)
+    g.add_argument("--max_tokens_to_oom", type=int, default=12000)
 
 
 # ---------------------------------------------------------------------------
@@ -668,6 +724,16 @@ def apply_fused_ce_policy(args, vocab=None):
 def validate_args(args, world_size: Optional[int] = None):
     """Cross-derivations (reference: arguments.py:53-345)."""
     import jax
+
+    # loud accept-and-ignore: unimplemented reference features parse fine
+    # (launch scripts carry over) but never silently no-op when set
+    if getattr(args, "rank", 0) == 0:
+        for dest in sorted(_UNIMPLEMENTED_DEFAULTS):
+            default = _UNIMPLEMENTED_DEFAULTS[dest]
+            if getattr(args, dest, default) != default:
+                print(f" > WARNING: --{dest} is accepted for launch-script "
+                      f"compatibility but NOT implemented on this stack — "
+                      f"ignoring it", flush=True)
 
     if world_size is None:
         world_size = int(os.environ.get("MEGATRON_TPU_WORLD_SIZE", 0)) or \

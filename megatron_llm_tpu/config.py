@@ -161,14 +161,11 @@ class TransformerConfig:
     # --- dtypes ---
     params_dtype: str = "fp32"          # storage dtype of the trained params
     compute_dtype: str = "fp32"         # activation/computation dtype
-    softmax_in_fp32: bool = True        # attention-softmax accumulation dtype
     # upcast LN/RMSNorm compute to fp32 (reference rmsnorm does fp32 compute,
     # fused_layer_norm.py:125-139)
     norm_in_fp32: bool = True
 
     # --- attention numerics ---
-    attn_mask_type: AttnMaskType = AttnMaskType.causal
-    apply_query_key_layer_scaling: bool = False
     attention_softmax_in_fp32: bool = True
     # divide qk^T by sqrt(head_dim) (standard)
     use_flash_attn: bool = True         # Pallas flash-attention kernel

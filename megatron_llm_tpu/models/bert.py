@@ -21,7 +21,6 @@ import jax
 import jax.numpy as jnp
 
 from megatron_llm_tpu.config import (
-    AttnMaskType,
     PositionEmbeddingType,
     TransformerConfig,
 )
@@ -49,7 +48,6 @@ from megatron_llm_tpu.quantization import dequantize_kernel
 # forwarding generic CLI args — single source of truth.
 BERT_ARCH_FLAGS = dict(
     position_embedding_type=PositionEmbeddingType.learned_absolute,
-    attn_mask_type=AttnMaskType.padding,
     normalization="layernorm",
     glu_activation=None,
     add_bias_linear=True,

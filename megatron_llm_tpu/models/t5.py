@@ -22,7 +22,6 @@ import jax
 import jax.numpy as jnp
 
 from megatron_llm_tpu.config import (
-    AttnMaskType,
     PositionEmbeddingType,
     TransformerConfig,
 )
@@ -41,10 +40,10 @@ from megatron_llm_tpu.parallel.layers import (
 
 
 # Architecture flags T5 forces (reference: pretrain_t5.py defaults +
-# t5_model asserts; encoder is bidirectional => padding mask).
+# t5_model asserts; the encoder is bidirectional, so padding masks are
+# built explicitly and passed through core attention).
 T5_ARCH_FLAGS = dict(
     position_embedding_type=PositionEmbeddingType.learned_absolute,
-    attn_mask_type=AttnMaskType.padding,
     normalization="layernorm",
     glu_activation=None,
     add_bias_linear=True,

@@ -162,6 +162,11 @@ class InferenceEngine:
     prefill -> decode -> completion.  Tokenization stays with the
     caller — the engine speaks token ids only."""
 
+    # lint-enforced (graft-lint locks/LD002): the state-object swap is
+    # the restart path's linearization point — only restart() (under
+    # _restart_lock) may publish a new _EngineState
+    _lock_protected_ = {"_st": "_restart_lock"}
+
     def __init__(self, model, params, config: Optional[EngineConfig] = None):
         self.model = model
         self.params = params

@@ -86,6 +86,16 @@ class BlockManager:
     """Allocates slots and pool blocks; owns the block-table array and
     (optionally) the refcounted prefix cache over the pool."""
 
+    # lint-enforced (graft-lint locks/LD002): the engine thread and the
+    # HTTP front-end both allocate/free; all pool state mutates under
+    # self._lock (``*_locked`` helpers run with the caller's lock held)
+    _lock_protected_ = (
+        "_free_blocks", "_free_slots", "_slot_blocks", "tables",
+        "_refcounts", "_cache", "_block_hash", "_lru", "_slot_cached",
+        "prefix_cache_hits", "prefix_cache_misses",
+        "prefix_cache_evictions", "prefix_cache_hit_tokens", "cow_copies",
+    )
+
     def __init__(self, num_blocks: int, block_size: int, num_slots: int,
                  max_blocks_per_slot: int, prefix_cache: bool = False):
         assert num_blocks >= 2, "need at least one block beyond the garbage"

@@ -184,6 +184,15 @@ class ReplicaRouter:
     """Routing core, independent of the HTTP front-end (unit-testable
     against stub backends)."""
 
+    # lint-enforced (graft-lint locks/LD002): the HTTP worker threads,
+    # the relay generators and the health prober all touch these; every
+    # mutation must hold self._lock
+    _lock_protected_ = (
+        "requests_total", "failovers_total", "mid_stream_failures_total",
+        "throttled_total", "no_backend_total", "affinity_hits",
+        "_affinity",
+    )
+
     def __init__(self, backend_urls: Sequence[str],
                  fail_threshold: int = 3,
                  cooldown_secs: float = 1.0,
@@ -351,10 +360,12 @@ class ReplicaRouter:
                     backend=b.url, status=status, attempts=attempts)
             return status, headers, data
         if throttle_bodies:
-            self.throttled_total += 1
+            with self._lock:
+                self.throttled_total += 1
             raise AllBackendsThrottled(
                 self._merge_throttle(throttle_bodies))
-        self.no_backend_total += 1
+        with self._lock:
+            self.no_backend_total += 1
         raise NoBackendAvailable(
             f"no live backend ({len(self.backends)} configured)")
 
@@ -473,10 +484,12 @@ class ReplicaRouter:
 
             return resp.status, headers, relay()
         if throttle_bodies:
-            self.throttled_total += 1
+            with self._lock:
+                self.throttled_total += 1
             raise AllBackendsThrottled(
                 self._merge_throttle(throttle_bodies))
-        self.no_backend_total += 1
+        with self._lock:
+            self.no_backend_total += 1
         raise NoBackendAvailable(
             f"no live backend ({len(self.backends)} configured)")
 

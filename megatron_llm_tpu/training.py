@@ -305,6 +305,7 @@ def pretrain(
     log_layer_stats_interval: int = 0,
     writer=None,
     tensorboard_log_interval: int = 1,
+    log_timers: bool = True,
     async_save: bool = False,
     log_memory: bool = False,
     log_batch_size: bool = False,
@@ -738,8 +739,12 @@ def pretrain(
                 # snapshot doubles as the straggler detector's input and
                 # the per-slice attribution source — the allgather
                 # already happened at this boundary.
-                gathered = timers.report(use_writer, iteration,
-                                         normalizer=log_interval)
+                # --log_timers_to_tensorboard gates the writer sink only;
+                # the console line and the straggler-detector snapshot
+                # are always produced
+                gathered = timers.report(
+                    use_writer if log_timers else None, iteration,
+                    normalizer=log_interval)
                 if straggler is not None and gathered:
                     straggler.check(gathered, iteration)
                 if stream is not None:

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""graft-lint: repo-native static analysis (stdlib-only).
+
+Runs the AST checkers in ``megatron_llm_tpu/analysis/`` over the repo
+and exits non-zero on any violation not suppressed by the checked-in
+baseline (``.graftlint.json`` — every suppression must carry a one-line
+justification).  Green at HEAD by construction; new violations ratchet.
+
+    python tools/graft_lint.py                     # all checkers
+    python tools/graft_lint.py --checkers locks,flags
+    python tools/graft_lint.py --list              # checker catalogue
+    python tools/graft_lint.py --record-schema     # after a schema bump
+
+Checkers: recompile (host-sync/retrace hazards reachable from
+jax.jit/shard_map), flags (arguments.py wiring + dead config fields),
+telemetry (request_done/JSON_SCHEMA_KEYS/golden-test agreement +
+version-bump ratchet), stdlib (stdlib-only gate for tools/), locks
+(serving lock discipline), markers (pytest marker registration).
+See docs/guide/static_analysis.md.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_llm_tpu.analysis import CHECKERS, run_checkers
+from megatron_llm_tpu.analysis.core import (
+    BASELINE_FILENAME, Baseline, BaselineError, Repo,
+)
+from megatron_llm_tpu.analysis import telemetry_schema
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--root", default=None,
+                   help="repo root (default: this file's parent repo)")
+    p.add_argument("--checkers", default=None,
+                   help="comma-separated subset (default: all): "
+                        + ",".join(CHECKERS))
+    p.add_argument("--baseline", default=None,
+                   help=f"suppression file (default: <root>/"
+                        f"{BASELINE_FILENAME})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report everything "
+                        "(ratchet review mode)")
+    p.add_argument("--list", action="store_true",
+                   help="list checkers and exit")
+    p.add_argument("--record-schema", action="store_true",
+                   help="re-record the telemetry (version, keys) "
+                        "snapshot into the baseline after a conscious "
+                        "TELEMETRY_SCHEMA_VERSION bump, then lint")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="violations only, no summary")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.list:
+        for name, fn in CHECKERS.items():
+            doc = (fn.__module__ or "").rsplit(".", 1)[-1]
+            head = (sys.modules[fn.__module__].__doc__ or doc)
+            print(f"{name:10s} {head.strip().splitlines()[0]}")
+        return 0
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    repo = Repo(root)
+    baseline_path = args.baseline or os.path.join(root, BASELINE_FILENAME)
+    try:
+        baseline = Baseline.load(baseline_path)
+    except BaselineError as e:
+        print(f"graft-lint: baseline error: {e}", file=sys.stderr)
+        return 2
+
+    if args.record_schema:
+        snap = telemetry_schema.record_snapshot(repo, baseline)
+        baseline.save(baseline_path)
+        print(f"recorded telemetry schema snapshot: version "
+              f"{snap['version']}, {len(snap['request_done_keys'])} "
+              f"request_done keys -> {baseline_path}")
+
+    if args.no_baseline:
+        baseline = Baseline(telemetry_schema=baseline.telemetry_schema)
+
+    names = args.checkers.split(",") if args.checkers else None
+    try:
+        unsuppressed, suppressed, stale = run_checkers(
+            repo, baseline, names)
+    except ValueError as e:
+        print(f"graft-lint: {e}", file=sys.stderr)
+        return 2
+
+    for v in repo.parse_errors:
+        print(v.render())
+    for v in unsuppressed:
+        print(v.render())
+    if not args.quiet:
+        for fp in stale:
+            print(f"note: stale suppression (matched nothing): {fp}")
+        n = len(unsuppressed) + len(repo.parse_errors)
+        print(f"graft-lint: {n} violation(s), {len(suppressed)} "
+              f"suppressed, {len(stale)} stale suppression(s) "
+              f"[{','.join(names) if names else 'all checkers'}]")
+    return 1 if (unsuppressed or repo.parse_errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

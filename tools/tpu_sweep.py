@@ -60,9 +60,9 @@ _SMOKE_FLAGS = (
 
 @dataclass(frozen=True)
 class Step:
-    """One sweep entry.  ``wave`` orders the run (1 = the VERDICT
-    playbook must-haves, 2 = gravy measurements); ``env`` is merged over
-    the inherited environment."""
+    """One sweep entry.  ``wave`` orders the run (0 = static gates,
+    CPU-only, no model; 1 = the VERDICT playbook must-haves, 2 = gravy
+    measurements); ``env`` is merged over the inherited environment."""
 
     name: str
     cmd: str
@@ -73,6 +73,11 @@ class Step:
 
 
 MANIFEST: List[Step] = [
+    # wave 0: static gates — no model, no accelerator, seconds not
+    # minutes; a red lint fails the sweep before any compile budget
+    # is spent
+    Step("graft_lint", "python tools/graft_lint.py", 120,
+         wave=0, needs_tpu=False),
     Step("fusedbwd", "python tools/mfu_sweep.py fusedbwd", 1500, wave=1),
     Step("seq4096", "python tools/mfu_sweep.py seq4096", 1800, wave=1),
     Step("bigvocab", "python tools/mfu_sweep.py bigvocab", 2100, wave=1),
@@ -114,8 +119,12 @@ def validate_manifest(manifest: List[Step] = MANIFEST) -> None:
         seen.add(s.name)
         if s.timeout <= 0:
             raise ValueError(f"step {s.name}: timeout must be positive")
-        if s.wave not in (1, 2):
-            raise ValueError(f"step {s.name}: wave must be 1 or 2")
+        if s.wave not in (0, 1, 2):
+            raise ValueError(f"step {s.name}: wave must be 0, 1 or 2")
+        if s.wave == 0 and s.needs_tpu:
+            raise ValueError(
+                f"step {s.name}: wave 0 is the static-gate wave and "
+                f"must not need a TPU")
         if not s.cmd.strip():
             raise ValueError(f"step {s.name}: empty command")
 
