@@ -35,6 +35,18 @@ from megatron_llm_tpu.serving.router import (
     RouterServer,
 )
 from megatron_llm_tpu.serving.scheduler import Scheduler
+from megatron_llm_tpu.serving.supervisor import (
+    FleetSnapshot,
+    FleetSupervisor,
+    LocalProcessBackend,
+    PolicyConfig,
+    ReplicaBackend,
+    ReplicaInfo,
+    Respawn,
+    ScaleDown,
+    ScaleUp,
+    ScalingPolicy,
+)
 
 __all__ = [
     "AllBackendsThrottled",
@@ -44,15 +56,25 @@ __all__ = [
     "EngineError",
     "EngineWatchdog",
     "FINISH_NONFINITE",
+    "FleetSnapshot",
+    "FleetSupervisor",
     "InferenceEngine",
+    "LocalProcessBackend",
     "NoBackendAvailable",
     "NoCapacity",
+    "PolicyConfig",
     "QueueFull",
+    "ReplicaBackend",
+    "ReplicaInfo",
     "ReplicaRouter",
     "Request",
     "RequestQueue",
+    "Respawn",
     "RouterServer",
     "SamplingParams",
+    "ScaleDown",
+    "ScaleUp",
+    "ScalingPolicy",
     "Scheduler",
     "ServingFaultInjector",
     "chain_block_digests",

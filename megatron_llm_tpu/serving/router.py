@@ -185,12 +185,12 @@ class ReplicaRouter:
     against stub backends)."""
 
     # lint-enforced (graft-lint locks/LD002): the HTTP worker threads,
-    # the relay generators and the health prober all touch these; every
-    # mutation must hold self._lock
+    # the relay generators, the health prober and the fleet supervisor
+    # all touch these; every mutation must hold self._lock
     _lock_protected_ = (
         "requests_total", "failovers_total", "mid_stream_failures_total",
         "throttled_total", "no_backend_total", "affinity_hits",
-        "_affinity",
+        "_affinity", "backends", "_brownout_until", "brownout_429s_total",
     )
 
     def __init__(self, backend_urls: Sequence[str],
@@ -202,8 +202,9 @@ class ReplicaRouter:
                  health_interval_secs: float = 2.0,
                  request_timeout_secs: float = 600.0,
                  tracer=None):
-        if not backend_urls:
-            raise ValueError("router needs at least one backend")
+        # an empty initial list is legal: a fleet supervisor registers
+        # replicas at runtime via add_backend (tools/serve_router.py
+        # still requires --backends for the static-fleet deployment)
         self.backends = [Backend(u) for u in backend_urls]
         # duck-typed span recorder (tracing.SpanTracer when the process
         # runs with --trace_dir; anything with completed()/instant()):
@@ -224,8 +225,83 @@ class ReplicaRouter:
         self.throttled_total = 0
         self.no_backend_total = 0
         self.affinity_hits = 0
+        # brownout: while a scale-up is in flight and every replica is
+        # throttled, 429s carry an honest retry_after derived from the
+        # spawn ETA instead of the replicas' (saturated) own estimates
+        self._brownout_until = 0.0      # monotonic; 0 = inactive
+        self.brownout_429s_total = 0
+        # optional supervisor stats hook: a callable returning a dict
+        # merged into snapshot()["fleet"], so supervisor counters ride
+        # the router's /metrics (JSON and Prometheus) for free
+        self._fleet_stats_fn = None
         self._health_thread: Optional[threading.Thread] = None
         self._health_stop = threading.Event()
+
+    # -- dynamic membership ---------------------------------------------
+
+    def add_backend(self, url: str) -> Backend:
+        """Register a replica at runtime (fleet supervisor scale-up /
+        respawn).  Idempotent on URL: re-adding an existing address
+        returns the live Backend untouched (its breaker state is the
+        truth about that address)."""
+        nb = Backend(url)
+        with self._lock:
+            for b in self.backends:
+                if b.url == nb.url:
+                    return b
+            self.backends.append(nb)
+        return nb
+
+    def remove_backend(self, url: str) -> bool:
+        """Deregister a replica (scale-down after drain, or a dead
+        process reaped by the supervisor).  In-flight relays holding the
+        Backend object finish against it harmlessly; affinity entries
+        pointing at it are purged so sticky routing never resurrects a
+        removed address.  Returns False when the URL is unknown."""
+        nb = Backend(url)
+        with self._lock:
+            victim = None
+            for b in self.backends:
+                if b.url == nb.url:
+                    victim = b
+                    break
+            if victim is None:
+                return False
+            self.backends.remove(victim)
+            for key in [k for k, v in self._affinity.items()
+                        if v is victim]:
+                del self._affinity[key]
+        return True
+
+    def backends_list(self) -> List[Backend]:
+        """Membership snapshot: iterate this, never self.backends, from
+        probe/metrics paths — add/remove may reshape the list mid-walk."""
+        with self._lock:
+            return list(self.backends)
+
+    # -- brownout --------------------------------------------------------
+
+    def begin_brownout(self, eta_secs: float) -> None:
+        """Enter brownout until ``eta_secs`` from now (the supervisor's
+        spawn ETA).  Extends, never shortens, an active brownout."""
+        until = time.monotonic() + max(float(eta_secs), 0.0)
+        with self._lock:
+            self._brownout_until = max(self._brownout_until, until)
+
+    def end_brownout(self) -> None:
+        """Leave brownout (the spawned replica registered, or the spawn
+        was abandoned)."""
+        with self._lock:
+            self._brownout_until = 0.0
+
+    def brownout_remaining(self) -> float:
+        with self._lock:
+            return max(self._brownout_until - time.monotonic(), 0.0)
+
+    def set_fleet_stats(self, fn) -> None:
+        """Attach a supervisor stats callable (() -> dict); its counters
+        appear under ``snapshot()["fleet"]`` on /metrics."""
+        self._fleet_stats_fn = fn
 
     # -- candidate selection --------------------------------------------
 
@@ -360,10 +436,8 @@ class ReplicaRouter:
                     backend=b.url, status=status, attempts=attempts)
             return status, headers, data
         if throttle_bodies:
-            with self._lock:
-                self.throttled_total += 1
             raise AllBackendsThrottled(
-                self._merge_throttle(throttle_bodies))
+                self._throttled_body(throttle_bodies))
         with self._lock:
             self.no_backend_total += 1
         raise NoBackendAvailable(
@@ -384,6 +458,25 @@ class ReplicaRouter:
             "queue_depth": best("queue_depth", None),
             "estimated_wait_secs": best("estimated_wait_secs", None),
         }
+
+    def _throttled_body(self, bodies: List[dict]) -> Dict[str, object]:
+        """Merge throttle bodies, counting the shed; under brownout the
+        retry_after is raised to the remaining spawn ETA — the saturated
+        replicas' own (optimistic) estimates are dishonest while the
+        capacity the client is waiting for is still booting."""
+        merged = self._merge_throttle(bodies)
+        now = time.monotonic()
+        with self._lock:
+            self.throttled_total += 1
+            remaining = self._brownout_until - now
+            if remaining > 0:
+                self.brownout_429s_total += 1
+        if remaining > 0:
+            merged["brownout"] = True
+            merged["retry_after_secs"] = max(
+                float(merged.get("retry_after_secs") or 0.0),
+                round(remaining, 3), 0.1)
+        return merged
 
     def dispatch_stream(self, method: str, path: str, body: Optional[bytes],
                         trace_id: Optional[str] = None
@@ -484,10 +577,8 @@ class ReplicaRouter:
 
             return resp.status, headers, relay()
         if throttle_bodies:
-            with self._lock:
-                self.throttled_total += 1
             raise AllBackendsThrottled(
-                self._merge_throttle(throttle_bodies))
+                self._throttled_body(throttle_bodies))
         with self._lock:
             self.no_backend_total += 1
         raise NoBackendAvailable(
@@ -505,7 +596,7 @@ class ReplicaRouter:
         breaker count, in-flight streams keep relaying) but is skipped
         for new dispatches until it reports ``"ok"`` again."""
         alive = 0
-        for b in self.backends:
+        for b in self.backends_list():
             status_field = None
             try:
                 conn = self._open(b, "GET", "/health", None,
@@ -564,14 +655,27 @@ class ReplicaRouter:
             return sum(b.available(self.fail_threshold, now)
                        for b in self.backends)
 
+    def affinity_counts(self) -> Dict[str, int]:
+        """Sticky-prefix entries per backend URL — the supervisor's
+        coldness signal (fewest entries = coldest, cheapest to drain)."""
+        with self._lock:
+            counts: Dict[str, int] = {b.url: 0 for b in self.backends}
+            for bk in self._affinity.values():
+                if bk.url in counts:
+                    counts[bk.url] += 1
+        return counts
+
     def snapshot(self) -> Dict[str, object]:
+        backends = self.backends_list()
+        counts = self.affinity_counts()
         with self._lock:
             affinity_entries = len(self._affinity)
-        return {
-            "backends_total": len(self.backends),
+            brownout_remaining = max(
+                self._brownout_until - time.monotonic(), 0.0)
+        snap = {
+            "backends_total": len(backends),
             "backends_alive": self.alive_count(),
-            "backends_draining": sum(int(b.draining)
-                                     for b in self.backends),
+            "backends_draining": sum(int(b.draining) for b in backends),
             "requests_total": self.requests_total,
             "failovers_total": self.failovers_total,
             "mid_stream_failures_total": self.mid_stream_failures_total,
@@ -579,10 +683,24 @@ class ReplicaRouter:
             "no_backend_total": self.no_backend_total,
             "affinity_hits": self.affinity_hits,
             "affinity_entries": affinity_entries,
+            "brownout_active": int(brownout_remaining > 0),
+            "brownout_remaining_secs": round(brownout_remaining, 3),
+            "brownout_429s_total": self.brownout_429s_total,
             "backends": {
-                f"backend_{i}": b.snapshot(self.fail_threshold)
-                for i, b in enumerate(self.backends)},
+                f"backend_{i}": dict(
+                    b.snapshot(self.fail_threshold),
+                    affinity_entries=counts.get(b.url, 0))
+                for i, b in enumerate(backends)},
         }
+        fn = self._fleet_stats_fn
+        if fn is not None:
+            try:
+                fleet = fn()
+            except Exception:   # noqa: BLE001 - metrics must not 500
+                fleet = None
+            if isinstance(fleet, dict):
+                snap["fleet"] = fleet
+        return snap
 
     def aggregated_metrics(self) -> Dict[str, object]:
         """Router snapshot + per-backend /metrics + a numeric sum over
@@ -595,7 +713,7 @@ class ReplicaRouter:
         per_backend: Dict[str, object] = {}
         aggregate: Dict[str, object] = {}
         per_replica: Dict[str, Dict[str, object]] = {}
-        for i, b in enumerate(self.backends):
+        for i, b in enumerate(self.backends_list()):
             snap = None
             try:
                 conn = self._open(b, "GET", "/metrics", None,
@@ -644,6 +762,16 @@ class RouterServer:
     def __init__(self, router: ReplicaRouter):
         self.router = router
         self.httpd = None
+
+    def shutdown(self) -> None:
+        """Deterministic teardown: stop the health prober, then break
+        ``serve_forever``.  Safe from a signal handler — ``shutdown()``
+        deadlocks when called from the serving thread itself, so it runs
+        on a helper thread."""
+        self.router.stop()
+        httpd = self.httpd
+        if httpd is not None:
+            threading.Thread(target=httpd.shutdown, daemon=True).start()
 
     def run(self, host: str = "0.0.0.0", port: int = 8000) -> None:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -741,14 +869,15 @@ class RouterServer:
 
             def do_GET(self):
                 if self.path == "/health":
+                    backends = router.backends_list()
                     alive = router.alive_count()
                     code = 200 if alive > 0 else 503
                     self._send_json(code, {
                         "status": "ok" if alive > 0 else "no_backends",
                         "backends_alive": alive,
                         "backends_draining": sum(
-                            int(b.draining) for b in router.backends),
-                        "backends_total": len(router.backends)})
+                            int(b.draining) for b in backends),
+                        "backends_total": len(backends)})
                 elif self.path == "/metrics" \
                         or self.path.startswith("/metrics?"):
                     snap = router.aggregated_metrics()

@@ -420,7 +420,11 @@ def _wants_prometheus(path: str, accept: str) -> bool:
 # 6: serve request_done records gain prefill_kernel (the resolved
 #    chunked-prefill attention path, 'pallas'|'xla', alongside the
 #    existing decode-path paged_kernel) — see serving/engine.py
-TELEMETRY_SCHEMA_VERSION = 6
+# 7: + kind="fleet" supervisor events (replica_spawned / replica_died /
+#    replica_respawned / scale_up / scale_down / brownout, each with
+#    slot/url/reason fields) — see serving/supervisor.py and
+#    tools/serve_report.py's fleet-event timeline
+TELEMETRY_SCHEMA_VERSION = 7
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 

@@ -64,6 +64,11 @@ def main():
                    help="engine watchdog timeout; 0 disables")
     p.add_argument("--serve_num_blocks", type=int, default=0,
                    help="KV pool pages; 0 = full per-slot backing")
+    p.add_argument("--serve_max_queue_depth", type=int, default=32,
+                   help="admission queue bound (fleet-autoscale tests "
+                        "raise it so a spike backlogs instead of 429s)")
+    p.add_argument("--serve_deadline_secs", type=float, default=60.0,
+                   help="default per-request deadline")
     args = p.parse_args()
     if args.structured_log_dir:
         from megatron_llm_tpu import telemetry
@@ -88,7 +93,8 @@ def main():
     engine = InferenceEngine(model, params, EngineConfig(
         num_slots=4, block_size=8, prefill_chunk=16, max_model_len=64,
         num_blocks=args.serve_num_blocks,
-        max_queue_depth=32, default_deadline_secs=60.0,
+        max_queue_depth=args.serve_max_queue_depth,
+        default_deadline_secs=args.serve_deadline_secs,
         paged_kernel=args.paged_kernel,
         prefill_kernel=args.prefill_kernel,
         watchdog_secs=args.serve_watchdog_secs,

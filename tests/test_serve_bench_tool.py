@@ -194,6 +194,73 @@ def test_percentile_helper():
 
 
 # ---------------------------------------------------------------------------
+# piecewise-rate workloads (--rate_schedule)
+# ---------------------------------------------------------------------------
+
+def test_parse_rate_schedule():
+    assert serve_bench.parse_rate_schedule("2:1.5, 0:2 ,10:0.5") == \
+        [(2.0, 1.5), (0.0, 2.0), (10.0, 0.5)]
+    for bad in ("2", "-1:2", "2:0", "2:-1", " , ", "a:b"):
+        with pytest.raises(ValueError):
+            serve_bench.parse_rate_schedule(bad)
+
+
+def test_build_arrivals_deterministic_and_segmented():
+    sched = serve_bench.parse_rate_schedule("50:1,0:1,200:0.5")
+    a = serve_bench.build_arrivals(sched, seed=3)
+    assert a == serve_bench.build_arrivals(sched, seed=3)
+    assert a != serve_bench.build_arrivals(sched, seed=4)
+    ts = [t for t, _ in a]
+    assert ts == sorted(ts)
+    # arrivals land inside their segment's window; the 0-rate segment
+    # is a silent pause (no arrivals at all in [1, 2))
+    for t, seg in a:
+        assert seg in (0, 2)
+        if seg == 0:
+            assert 0.0 <= t < 1.0
+        else:
+            assert 2.0 <= t < 2.5
+    assert any(seg == 2 for _, seg in a)
+
+
+def test_run_bench_rate_schedule_reports_segments(stub_server):
+    r = serve_bench.run_bench(stub_server, clients=4, requests=999,
+                              tokens=3, seed=5,
+                              rate_schedule="30:0.4,0:0.2,80:0.3")
+    assert r["rate_schedule"] == "30:0.4,0:0.2,80:0.3"
+    segs = r["segments"]
+    assert [s["segment"] for s in segs] == [0, 1, 2]
+    assert [s["rate"] for s in segs] == [30.0, 0.0, 80.0]
+    # request count comes from the schedule, not --requests
+    assert r["requests"] == sum(s["requests"] for s in segs)
+    assert segs[1]["requests"] == 0          # the silent pause
+    for s in segs:
+        assert s["ok"] == s["requests"] and s["errors"] == 0
+        if s["requests"]:
+            assert s["requests_per_sec"] > 0
+            assert s["latency_p95_secs"] is not None
+    # unscheduled runs keep the keys, valued None (schema stability)
+    r2 = serve_bench.run_bench(stub_server, clients=2, requests=3,
+                               tokens=3)
+    assert r2["rate_schedule"] is None and r2["segments"] is None
+
+
+def test_cli_rate_schedule_json_and_table(stub_server, capsys):
+    rc = serve_bench.main(["--url", stub_server, "--clients", "4",
+                           "--tokens", "3", "--rate_schedule",
+                           "40:0.3,80:0.2", "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert len(out["segments"]) == 2
+    rc = serve_bench.main(["--url", stub_server, "--clients", "4",
+                           "--tokens", "3", "--rate_schedule",
+                           "40:0.3,80:0.2"])
+    assert rc == 0
+    table = capsys.readouterr().out
+    assert "rate schedule" in table
+
+
+# ---------------------------------------------------------------------------
 # kernel A/B (--ab <server_flag>)
 # ---------------------------------------------------------------------------
 

@@ -134,6 +134,91 @@ def test_cli_table_json_and_empty_exit_codes(serve_log, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# fleet-event timeline (kind "fleet", supervisor / serve_fleet.py)
+# ---------------------------------------------------------------------------
+
+def _fleet_event(event, t, **fields):
+    return {"schema": 7, "kind": "fleet", "event": event,
+            "time_unix": 1700000100.0 + t, **fields}
+
+
+def _fleet_fixture_events():
+    return [
+        _fleet_event("replica_spawned", 0.0, slot="replica-0",
+                     url="http://127.0.0.1:5000", spawn_secs=2.5),
+        _fleet_event("scale_up", 10.0, slot="replica-1",
+                     reason="ttft_p95", ttft_p95_secs=2.1,
+                     queue_depth=30),
+        _fleet_event("brownout", 10.5, slot="replica-1", eta_secs=12.0),
+        _fleet_event("replica_spawned", 21.0, slot="replica-1",
+                     url="http://127.0.0.1:5001", spawn_secs=11.0),
+        _fleet_event("replica_died", 30.0, slot="replica-0",
+                     url="http://127.0.0.1:5000", exited_while="ready"),
+        _fleet_event("replica_respawned", 34.0, slot="replica-0",
+                     url="http://127.0.0.1:5002", spawn_secs=4.0),
+        _fleet_event("scale_down", 80.0, slot="replica-1",
+                     url="http://127.0.0.1:5001"),
+    ]
+
+
+def test_fleet_summary_counters_and_timeline(tmp_path):
+    log = tmp_path / "fleet.jsonl"
+    with open(log, "w") as f:
+        f.write("not json\n")
+        # out of order on disk: the timeline must sort by time_unix
+        for e in reversed(_fleet_fixture_events()):
+            f.write(json.dumps(e) + "\n")
+    assert len(serve_report.load_fleet_events(str(log))) == 7
+    r = serve_report.analyze([str(log)])
+    fs = r["fleet"]
+    assert fs["events"] == {
+        "replica_spawned": 2, "replica_died": 1,
+        "replica_respawned": 1, "scale_up": 1, "scale_down": 1,
+        "brownout": 1}
+    tl = fs["timeline"]
+    assert [e["event"] for e in tl] == [
+        "replica_spawned", "scale_up", "brownout", "replica_spawned",
+        "replica_died", "replica_respawned", "scale_down"]
+    # offsets relative to the first fleet event
+    assert tl[0]["t_secs"] == pytest.approx(0.0)
+    assert tl[1]["t_secs"] == pytest.approx(10.0)
+    assert tl[-1]["t_secs"] == pytest.approx(80.0)
+    # per-event detail fields survive when present
+    assert tl[1]["reason"] == "ttft_p95"
+    assert tl[2]["eta_secs"] == 12.0
+    assert tl[4]["exited_while"] == "ready"
+
+
+def test_fleet_events_coexist_with_request_records(tmp_path):
+    log_dir = tmp_path / "replica0"
+    _write_log(str(log_dir), [_record(i) for i in range(3)])
+    with open(log_dir / serve_report.STREAM_FILENAME, "a") as f:
+        for e in _fleet_fixture_events():
+            f.write(json.dumps(e) + "\n")
+    r = serve_report.analyze([str(log_dir)])
+    assert r["summary"]["requests"] == 3
+    assert r["fleet"]["events"]["scale_up"] == 1
+
+
+def test_cli_fleet_only_log_renders_timeline(tmp_path):
+    """A --fleet_event_log JSONL with zero request_done records is a
+    valid input: exit 0, counters plus the chronological timeline."""
+    log = tmp_path / "fleet.jsonl"
+    with open(log, "w") as f:
+        for e in _fleet_fixture_events():
+            f.write(json.dumps(e) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "serve_report.py"),
+         str(log)],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
+    assert "fleet events:" in out.stdout
+    assert "scale_up=1" in out.stdout
+    assert "reason=ttft_p95" in out.stdout
+    assert "exited_while=ready" in out.stdout
+
+
+# ---------------------------------------------------------------------------
 # trace_report.py --merge on synthetic router + replica traces
 # ---------------------------------------------------------------------------
 

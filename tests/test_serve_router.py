@@ -562,6 +562,64 @@ def test_fleet_histogram_merge_and_slo_recompute(stubs):
 
 
 # ---------------------------------------------------------------------------
+# tools/serve_router.py CLI: breaker/probe flags + SIGTERM teardown
+# ---------------------------------------------------------------------------
+
+def test_router_tool_flags_and_aliases():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import serve_router as tool
+    a = tool.parse_args(["--backends", "x:1", "--fail_threshold", "5",
+                         "--breaker_backoff_secs", "2.5",
+                         "--probe_interval_secs", "0.7"])
+    assert a.fail_threshold == 5
+    assert a.breaker_backoff_secs == 2.5
+    assert a.probe_interval_secs == 0.7
+    # pre-PR-13 spellings keep working
+    legacy = tool.parse_args(["--backends", "x:1",
+                              "--cooldown_secs", "1.5",
+                              "--health_interval_secs", "0.3"])
+    assert legacy.breaker_backoff_secs == 1.5
+    assert legacy.probe_interval_secs == 0.3
+    # a static empty fleet is a usage error (serve_fleet.py owns the
+    # dynamic-membership case)
+    with pytest.raises(SystemExit):
+        tool.main(["--backends", " ", "--port", "0"])
+
+
+def test_router_tool_sigterm_clean_exit():
+    """SIGTERM stops the probe thread and breaks serve_forever: the
+    tool exits 0 instead of dying mid-daemon-thread."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(root, "tools", "serve_router.py"),
+         "--backends", "127.0.0.1:1", "--host", "127.0.0.1",
+         "--port", "0", "--probe_interval_secs", "0.2"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True, cwd=root)
+    try:
+        deadline = time.monotonic() + 120.0
+        up = False
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if " * routing" in line:
+                up = True
+                break
+            if proc.poll() is not None:
+                raise RuntimeError("router tool died during startup")
+        assert up, "router tool did not start in time"
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
 # slow tier: real engine subprocesses
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,522 @@
+"""Fleet supervisor (serving/supervisor.py).
+
+Fast tier only — two layers, zero subprocesses:
+
+* **Pure policy** — :class:`ScalingPolicy` decisions are functions of a
+  :class:`FleetSnapshot` whose ``now`` the test injects, so the breach /
+  cooldown / hysteresis / respawn-backoff timelines are driven with a
+  fake clock and asserted exactly.
+* **Supervisor + router** — :class:`FleetSupervisor` over an in-process
+  fake :class:`ReplicaBackend` (stub HTTP replicas standing in for
+  engines) covers lifecycle registration, death->respawn healing,
+  scale-up brownout wiring, coldest-replica drain, the fleet-stats hook
+  on the router snapshot, and the JSONL event log.
+
+The chaos end-to-end (real engine subprocesses, SIGKILL mid-burst) is
+tests/test_serve_fleet.py, slow tier.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from megatron_llm_tpu.serving.router import (
+    AllBackendsThrottled,
+    NoBackendAvailable,
+    ReplicaRouter,
+)
+from megatron_llm_tpu.serving.supervisor import (
+    FleetSnapshot,
+    FleetSupervisor,
+    PolicyConfig,
+    ReplicaBackend,
+    ReplicaInfo,
+    Respawn,
+    ScaleDown,
+    ScaleUp,
+    ScalingPolicy,
+    _hist_delta,
+    _histogram_percentile,
+)
+
+
+# ---------------------------------------------------------------------------
+# pure policy: injectable clock, no IO
+# ---------------------------------------------------------------------------
+
+def _cfg(**kw):
+    base = dict(ttft_p95_slo_secs=1.0, queue_depth_high=10,
+                breach_secs=2.0, scale_cooldown_secs=30.0,
+                scale_down_idle_secs=60.0, scale_down_ttft_frac=0.5,
+                min_replicas=1, max_replicas=3,
+                respawn_backoff_secs=1.0, respawn_backoff_max_secs=8.0,
+                respawn_storm_window_secs=60.0,
+                dead_confirmation_secs=3.0)
+    base.update(kw)
+    return PolicyConfig(**base)
+
+
+def _ready(slot, affinity=0, in_flight=0):
+    return ReplicaInfo(slot=slot, url=f"http://x/{slot}", state="ready",
+                       in_flight=in_flight, affinity_entries=affinity)
+
+
+def _snap(now, replicas, p95=None, queue=0, spawns=0):
+    return FleetSnapshot(now=now, replicas=replicas, ttft_p95_secs=p95,
+                         queue_depth=queue, spawns_in_flight=spawns)
+
+
+def test_scale_up_requires_sustained_breach():
+    pol = ScalingPolicy(_cfg())
+    reps = [_ready("replica-0")]
+    assert pol.decide(_snap(0.0, reps, p95=2.0)) == []
+    assert pol.decide(_snap(1.0, reps, p95=2.0)) == []
+    assert pol.decide(_snap(2.0, reps, p95=2.0)) == \
+        [ScaleUp(reason="ttft_p95")]
+
+
+def test_breach_blip_resets_timer():
+    pol = ScalingPolicy(_cfg())
+    reps = [_ready("replica-0")]
+    pol.decide(_snap(0.0, reps, p95=2.0))
+    pol.decide(_snap(1.0, reps, p95=0.8))     # back in band: reset
+    assert pol.decide(_snap(2.0, reps, p95=2.0)) == []
+    assert pol.decide(_snap(3.0, reps, p95=2.0)) == []
+    assert pol.decide(_snap(4.0, reps, p95=2.0)) == \
+        [ScaleUp(reason="ttft_p95")]
+
+
+def test_queue_depth_breach_reason():
+    pol = ScalingPolicy(_cfg())
+    reps = [_ready("replica-0")]
+    pol.decide(_snap(0.0, reps, queue=50))
+    assert pol.decide(_snap(2.0, reps, queue=50)) == \
+        [ScaleUp(reason="queue_depth")]
+
+
+def test_scale_up_suppressed_while_spawn_in_flight():
+    pol = ScalingPolicy(_cfg())
+    reps = [_ready("replica-0")]
+    pol.decide(_snap(0.0, reps, p95=2.0, spawns=1))
+    assert pol.decide(_snap(5.0, reps, p95=2.0, spawns=1)) == []
+    # spawn landed: the (still-running) breach timer fires at once
+    assert pol.decide(_snap(6.0, reps + [_ready("replica-1")],
+                            p95=2.0)) == [ScaleUp(reason="ttft_p95")]
+
+
+def test_scale_up_capped_at_max_replicas():
+    pol = ScalingPolicy(_cfg(max_replicas=2))
+    reps = [_ready("replica-0"), _ready("replica-1")]
+    pol.decide(_snap(0.0, reps, p95=2.0))
+    assert pol.decide(_snap(10.0, reps, p95=2.0)) == []
+
+
+def test_cooldown_suppresses_second_scale_up():
+    pol = ScalingPolicy(_cfg())
+    reps = [_ready("replica-0")]
+    pol.decide(_snap(0.0, reps, p95=2.0))
+    assert pol.decide(_snap(2.0, reps, p95=2.0)) == \
+        [ScaleUp(reason="ttft_p95")]
+    reps2 = reps + [_ready("replica-1")]
+    pol.decide(_snap(3.0, reps2, p95=2.0))   # breach resumes at t=3
+    assert pol.decide(_snap(10.0, reps2, p95=2.0)) == []   # not cooled
+    assert pol.decide(_snap(31.0, reps2, p95=2.0)) == []   # 31-2 < 30
+    assert pol.decide(_snap(33.0, reps2, p95=2.0)) == \
+        [ScaleUp(reason="ttft_p95")]
+
+
+def test_hysteresis_band_never_flaps():
+    """p95 oscillating inside (frac*SLO, SLO] runs neither timer, and an
+    oscillation crossing both thresholds faster than the sustain windows
+    keeps resetting them — no action either way."""
+    pol = ScalingPolicy(_cfg(scale_cooldown_secs=0.0))
+    reps = [_ready("replica-0"), _ready("replica-1")]
+    for t in range(200):
+        p95 = 0.95 if t % 2 else 0.6      # inside the band
+        assert pol.decide(_snap(float(t), reps, p95=p95)) == []
+    pol2 = ScalingPolicy(_cfg(scale_cooldown_secs=0.0))
+    for t in range(200):
+        p95 = 1.5 if t % 2 else 0.3       # crossing, but never sustained
+        assert pol2.decide(_snap(float(t), reps, p95=p95)) == []
+
+
+def test_scale_down_picks_coldest_ready_replica():
+    pol = ScalingPolicy(_cfg(scale_down_idle_secs=10.0,
+                             scale_cooldown_secs=0.0))
+    reps = [_ready("replica-0", affinity=5),
+            _ready("replica-1", affinity=1),
+            _ready("replica-2", affinity=3)]
+    assert pol.decide(_snap(0.0, reps, p95=0.1)) == []
+    assert pol.decide(_snap(10.0, reps, p95=0.1)) == \
+        [ScaleDown(victim="replica-1")]
+    # affinity ties break toward the replica with least in-flight
+    pol2 = ScalingPolicy(_cfg(scale_down_idle_secs=10.0,
+                              scale_cooldown_secs=0.0))
+    tied = [_ready("replica-0", affinity=1, in_flight=2),
+            _ready("replica-1", affinity=1, in_flight=0)]
+    pol2.decide(_snap(0.0, tied))
+    assert pol2.decide(_snap(10.0, tied)) == \
+        [ScaleDown(victim="replica-1")]
+
+
+def test_scale_down_respects_min_replicas():
+    pol = ScalingPolicy(_cfg(scale_down_idle_secs=10.0,
+                             scale_cooldown_secs=0.0, min_replicas=1))
+    reps = [_ready("replica-0")]
+    pol.decide(_snap(0.0, reps))
+    assert pol.decide(_snap(100.0, reps)) == []
+
+
+def test_respawn_backoff_doubles_in_storm_and_resets_outside():
+    pol = ScalingPolicy(_cfg())
+    dead = [ReplicaInfo(slot="replica-0", state="dead",
+                        process_dead=True)]
+    assert pol.decide(_snap(0.0, dead)) == [Respawn("replica-0", 1.0)]
+    # next_allowed gates the retry; then each storm respawn doubles
+    assert pol.decide(_snap(0.5, dead)) == []
+    assert pol.decide(_snap(1.5, dead)) == [Respawn("replica-0", 2.0)]
+    assert pol.decide(_snap(4.0, dead)) == [Respawn("replica-0", 4.0)]
+    assert pol.decide(_snap(8.5, dead)) == [Respawn("replica-0", 8.0)]
+    assert pol.decide(_snap(17.0, dead)) == \
+        [Respawn("replica-0", 8.0)]                       # capped
+    # a death after a quiet storm-window resets to the base backoff
+    assert pol.decide(_snap(17.0 + 60.0, dead)) == \
+        [Respawn("replica-0", 1.0)]
+
+
+def test_breaker_death_needs_confirmation_window():
+    pol = ScalingPolicy(_cfg(dead_confirmation_secs=3.0))
+    brk = [ReplicaInfo(slot="replica-0", state="dead", dead_since=99.0)]
+    assert pol.decide(_snap(100.0, brk)) == []    # 1s open: not yet
+    assert pol.decide(_snap(102.0, brk)) == [Respawn("replica-0", 1.0)]
+
+
+def test_retiring_and_starting_replicas_never_respawned():
+    pol = ScalingPolicy(_cfg())
+    reps = [ReplicaInfo(slot="replica-0", state="retiring",
+                        process_dead=True),
+            ReplicaInfo(slot="replica-1", state="starting")]
+    assert pol.decide(_snap(0.0, reps)) == []
+
+
+def test_hist_delta_windowed_p95_sees_recovery():
+    """Lifetime percentiles latch after a spike; the per-poll bucket
+    delta is what lets the scaler observe recovery."""
+    calm = {"buckets": {"0.5": 100, "1.0": 0, "+Inf": 0},
+            "count": 100, "sum": 10.0}
+    spike = {"buckets": {"0.5": 100, "1.0": 0, "+Inf": 50},
+             "count": 150, "sum": 300.0}
+    after = {"buckets": {"0.5": 200, "1.0": 0, "+Inf": 50},
+             "count": 250, "sum": 330.0}
+    w1 = _hist_delta(spike, calm)
+    assert w1["count"] == 50 and w1["buckets"]["+Inf"] == 50
+    assert _histogram_percentile(w1, 0.95) == pytest.approx(1.0)
+    # lifetime after recovery still reads past the SLO ...
+    assert _histogram_percentile(after, 0.95) == pytest.approx(1.0)
+    # ... while the last window has recovered
+    w2 = _hist_delta(after, spike)
+    assert _histogram_percentile(w2, 0.95) <= 0.5
+    # degenerate shapes answer None / pass-through
+    assert _hist_delta(None, calm) is None
+    assert _hist_delta(spike, None) is spike
+    assert _histogram_percentile(None, 0.95) is None
+    assert _histogram_percentile({"buckets": {}, "count": 0}, 0.95) \
+        is None
+
+
+# ---------------------------------------------------------------------------
+# supervisor over an in-process fake backend
+# ---------------------------------------------------------------------------
+
+class _MiniReplica:
+    """Engine-replica lookalike for supervisor tests: /api, /health,
+    /metrics (configurable engine queue depth), POST /drain."""
+
+    def __init__(self, name, queue_depth=0, throttle_body=None):
+        self.name = name
+        self.queue_depth = queue_depth
+        self.throttle_body = throttle_body
+        self.hits = []
+        self.drained = threading.Event()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _json(self, code, body):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                if self.path == "/drain":
+                    stub.drained.set()
+                    self._json(200, {"status": "draining"})
+                    return
+                stub.hits.append(self.path)
+                if stub.throttle_body is not None:
+                    self._json(429, stub.throttle_body)
+                    return
+                self._json(200, {"backend": stub.name, "text": ["ok"],
+                                 "tokens": [[1, 2, 3]]})
+
+            do_POST = do_PUT
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._json(200, {"status": "draining"
+                                     if stub.drained.is_set() else "ok"})
+                else:
+                    self._json(200, {
+                        "requests": len(stub.hits),
+                        "engine": {"queue_depth": stub.queue_depth}})
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class _FakeHandle:
+    def __init__(self, stub):
+        self.stub = stub
+        self.dead = False
+
+
+class _FakeBackend(ReplicaBackend):
+    """In-process ReplicaBackend: spawn starts a stub HTTP server,
+    kill marks the handle dead (what poll then reports) — the whole
+    lifecycle without a subprocess."""
+
+    spawn_eta_secs = 5.0
+
+    def __init__(self, queue_depth=0):
+        self.queue_depth = queue_depth
+        self.handles = []
+
+    def spawn(self):
+        h = _FakeHandle(_MiniReplica(f"fake-{len(self.handles)}",
+                                     queue_depth=self.queue_depth))
+        self.handles.append(h)
+        return h
+
+    def poll(self, handle):
+        if handle.dead:
+            return "dead", None
+        return "ready", handle.stub.url
+
+    def kill(self, handle):
+        if not handle.dead:
+            handle.dead = True
+            handle.stub.close()
+
+
+def _quiet_cfg(**kw):
+    """Policy knobs that keep the scaler inert unless a test arms it."""
+    base = dict(ttft_p95_slo_secs=1e9, queue_depth_high=10 ** 9,
+                breach_secs=3600.0, scale_cooldown_secs=3600.0,
+                scale_down_idle_secs=3600.0, min_replicas=1,
+                max_replicas=4, respawn_backoff_secs=0.0,
+                dead_confirmation_secs=3600.0)
+    base.update(kw)
+    return PolicyConfig(**base)
+
+
+def _payload(prompt):
+    return json.dumps({"prompts": [prompt],
+                       "tokens_to_generate": 4}).encode()
+
+
+@pytest.fixture()
+def fleet():
+    """(router, backend, make_supervisor) with teardown."""
+    sups = []
+    router = ReplicaRouter([], health_interval_secs=3600.0)
+    backend = _FakeBackend()
+
+    def make(**kw):
+        sup = FleetSupervisor(router, backend, **kw)
+        sups.append(sup)
+        return sup
+
+    yield router, backend, make
+    for sup in sups:
+        sup.stop(kill_replicas=True)
+    router.stop()
+    for h in backend.handles:
+        backend.kill(h)
+
+
+def test_supervisor_registers_and_reports_fleet_stats(fleet, tmp_path):
+    router, backend, make = fleet
+    log = tmp_path / "fleet.jsonl"
+    sup = make(config=_quiet_cfg(), event_log_path=str(log))
+    sup.spawn_initial(2)
+    assert router.snapshot()["backends_total"] == 0   # not yet polled
+    sup.run_once()
+    snap = router.snapshot()
+    assert snap["backends_total"] == 2
+    # supervisor counters ride the router snapshot via the stats hook
+    assert snap["fleet"]["replicas_ready"] == 2
+    assert snap["fleet"]["spawns_total"] == 2
+    assert snap["fleet"]["respawns_total"] == 0
+    # requests actually route to supervisor-registered replicas
+    status, _, body = router.dispatch("PUT", "/api", _payload("1 2 3"))
+    assert status == 200 and json.loads(body)["text"] == ["ok"]
+    # structured JSONL event log: schema-stamped fleet events
+    events = [json.loads(line) for line in
+              log.read_text().splitlines()]
+    assert [e["event"] for e in events] == \
+        ["replica_spawned", "replica_spawned"]
+    for e in events:
+        assert e["kind"] == "fleet" and e["schema"] == 7
+        assert e["slot"].startswith("replica-")
+        assert e["url"].startswith("http://127.0.0.1:")
+
+
+def test_dead_replica_is_respawned_under_same_slot(fleet):
+    router, backend, make = fleet
+    sup = make(config=_quiet_cfg())
+    sup.spawn_initial(2)
+    sup.run_once()
+    backend.kill(sup.replicas["replica-0"].handle)    # SIGKILL stand-in
+    acts = sup.run_once()
+    # death observed -> deregistered -> respawn decided in the same turn
+    assert any(isinstance(a, Respawn) and a.slot == "replica-0"
+               for a in acts)
+    assert sup.counters["deaths_total"] == 1
+    sup.run_once()                       # replacement reports ready
+    assert router.snapshot()["backends_total"] == 2
+    assert sup.counters["respawns_total"] == 1
+    assert sup.replicas["replica-0"].state == "ready"
+    names = [e["event"] for e in sup.events]
+    assert "replica_died" in names and "replica_respawned" in names
+
+
+def test_scale_up_opens_brownout_until_replica_ready(fleet):
+    router, backend, make = fleet
+    backend.queue_depth = 50             # every stub reports a backlog
+    sup = make(config=_quiet_cfg(queue_depth_high=10, breach_secs=0.0,
+                                 max_replicas=2))
+    sup.spawn_initial(1)
+    acts = sup.run_once()
+    assert [a for a in acts if isinstance(a, ScaleUp)] == \
+        [ScaleUp(reason="queue_depth")]
+    assert sup.counters["scale_ups_total"] == 1
+    assert sup.counters["brownouts_total"] == 1
+    snap = router.snapshot()
+    assert snap["brownout_active"] == 1
+    assert snap["brownout_remaining_secs"] > 0
+    names = [e["event"] for e in sup.events]
+    assert "scale_up" in names and "brownout" in names
+    # a throttled 429 during the brownout carries the spawn-ETA floor
+    for h in backend.handles:
+        h.stub.throttle_body = {"message": "throttled",
+                                "retry_after_secs": 0.25,
+                                "queue_depth": 7,
+                                "estimated_wait_secs": 0.5}
+    with pytest.raises(AllBackendsThrottled) as ei:
+        router.dispatch("PUT", "/api", _payload("1 2 3"))
+    assert ei.value.body["brownout"] is True
+    assert ei.value.body["retry_after_secs"] > 0.25
+    assert router.snapshot()["brownout_429s_total"] == 1
+    for h in backend.handles:
+        h.stub.throttle_body = None
+    # the new replica registering closes the brownout window
+    sup.run_once()
+    snap = router.snapshot()
+    assert snap["backends_total"] == 2
+    assert snap["brownout_active"] == 0
+    assert router.brownout_remaining() == 0.0
+
+
+def test_scale_down_drains_coldest_and_reaps_without_healing(fleet):
+    router, backend, make = fleet
+    sup = make(config=_quiet_cfg(scale_cooldown_secs=0.0,
+                                 max_replicas=2))
+    sup.spawn_initial(2)
+    sup.run_once()
+    # pin a sticky prefix on one replica: the OTHER one is coldest
+    router.dispatch("PUT", "/api", _payload("7 7 7"))
+    hot = [h.stub.url for h in backend.handles if h.stub.hits][0]
+    sup.config.scale_down_idle_secs = 0.0    # arm the scaler
+    acts = sup.run_once()
+    downs = [a for a in acts if isinstance(a, ScaleDown)]
+    assert len(downs) == 1
+    victim = sup.replicas[downs[0].victim]
+    assert victim.url != hot
+    assert victim.state == "retiring"
+    assert sup.counters["scale_downs_total"] == 1
+    cold = [h for h in backend.handles if h.stub.url == victim.url][0]
+    assert cold.stub.drained.wait(5.0)       # got POST /drain
+    # drained replica exits; the supervisor reaps it, no healing
+    sup.config.scale_down_idle_secs = 3600.0
+    backend.kill(cold)
+    sup.run_once()
+    assert router.snapshot()["backends_total"] == 1
+    assert victim.slot not in sup.replicas
+    assert sup.counters["deaths_total"] == 0
+    assert "replica_died" not in [e["event"] for e in sup.events]
+
+
+def test_router_runtime_membership_and_affinity_purge():
+    a, b = _MiniReplica("a"), _MiniReplica("b")
+    router = ReplicaRouter([], health_interval_secs=3600.0)
+    try:
+        with pytest.raises(NoBackendAvailable):
+            router.dispatch("PUT", "/api", _payload("1 2 3"))
+        first = router.add_backend(a.url)
+        assert router.add_backend(a.url) is first    # idempotent on URL
+        status, _, _ = router.dispatch("PUT", "/api", _payload("1 2 3"))
+        assert status == 200
+        assert router.affinity_counts()[a.url] == 1
+        router.add_backend(b.url)
+        assert router.snapshot()["backends_total"] == 2
+        assert router.remove_backend(a.url) is True
+        assert router.remove_backend(a.url) is False     # unknown now
+        # sticky entries pointing at the removed replica are purged
+        assert router.affinity_counts() == {b.url: 0}
+        status, _, body = router.dispatch("PUT", "/api",
+                                          _payload("1 2 3"))
+        assert status == 200
+        assert json.loads(body)["backend"] == "b"
+    finally:
+        router.stop()
+        a.close()
+        b.close()
+
+
+def test_brownout_ends_restore_optimistic_429():
+    stub = _MiniReplica("t", throttle_body={
+        "message": "throttled", "retry_after_secs": 0.25,
+        "queue_depth": 7, "estimated_wait_secs": 0.5})
+    router = ReplicaRouter([stub.url], health_interval_secs=3600.0)
+    try:
+        router.begin_brownout(30.0)
+        with pytest.raises(AllBackendsThrottled) as ei:
+            router.dispatch("PUT", "/api", _payload("1 2 3"))
+        assert ei.value.body["brownout"] is True
+        assert ei.value.body["retry_after_secs"] >= 25.0
+        router.end_brownout()
+        with pytest.raises(AllBackendsThrottled) as ei2:
+            router.dispatch("PUT", "/api", _payload("1 2 3"))
+        assert "brownout" not in ei2.value.body
+        assert ei2.value.body["retry_after_secs"] == 0.25
+        snap = router.snapshot()
+        assert snap["throttled_total"] == 2
+        assert snap["brownout_429s_total"] == 1
+    finally:
+        router.stop()
+        stub.close()

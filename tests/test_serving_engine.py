@@ -330,7 +330,7 @@ def test_request_done_schema_golden(engine, tmp_path):
     the schema history comment in telemetry.py)."""
     from megatron_llm_tpu import telemetry
 
-    assert telemetry.TELEMETRY_SCHEMA_VERSION == 6
+    assert telemetry.TELEMETRY_SCHEMA_VERSION == 7
     captured = []
     engine.request_done_hook = captured.append
     stream = telemetry.TelemetryStream(str(tmp_path))
@@ -364,7 +364,7 @@ def test_request_done_schema_golden(engine, tmp_path):
             (tmp_path / "telemetry.jsonl").read_text().splitlines()
             if "request_done" in ln][0]
     assert frozenset(line) == frozenset(rec) | {"schema", "time_unix"}
-    assert line["schema"] == 6
+    assert line["schema"] == 7
 
 
 def test_engine_int8_kv_cache_serves(model_and_params):

@@ -6,7 +6,10 @@ with ``--stream``, which also measures true time-to-first-token), with
 either closed-loop arrivals (each client fires its next request as soon
 as the previous returns) or open-loop Poisson arrivals (``--rate``
 requests/sec across the fleet — the shape real traffic has, and the one
-that exposes queueing).
+that exposes queueing).  ``--rate_schedule "r1:t1,r2:t2,..."`` drives
+piecewise rates instead (a spike→recover workload for the fleet
+autoscaler), reporting per-segment throughput and p95 alongside the
+run-level tables.
 
 Reports a latency table (mean/p50/p95/p99), TTFT, token throughput, and
 the server's own /metrics delta; ``--json`` emits one machine-readable
@@ -60,7 +63,8 @@ JSON_SCHEMA_KEYS = (
     "latency_mean_secs", "latency_p50_secs", "latency_p95_secs",
     "latency_p99_secs", "ttft_mean_secs", "ttft_p50_secs",
     "ttft_p95_secs", "tpot_mean_secs", "tpot_p50_secs",
-    "tpot_p95_secs", "stream", "rate", "prefix_tokens",
+    "tpot_p95_secs", "stream", "rate", "rate_schedule", "segments",
+    "prefix_tokens",
     "shared_prefix_frac", "prefill_tokens_submitted",
     "prefill_tokens_computed", "prefill_tokens_cached",
     "prefill_computed_frac", "prefill_tokens_per_sec",
@@ -70,6 +74,48 @@ JSON_SCHEMA_KEYS = (
     "engine_restarts", "slots_evicted_nonfinite", "preemptions",
     "drained",
 )
+
+
+def parse_rate_schedule(spec: str):
+    """``"r1:t1,r2:t2,..."`` -> [(rate_req_per_sec, duration_secs)].
+    A ``0`` rate is a silent segment (drain pause in a spike->recover
+    workload)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        rate_s, sep, dur_s = part.partition(":")
+        if not sep:
+            raise ValueError(
+                f"rate_schedule segment {part!r} is not 'rate:secs'")
+        rate, dur = float(rate_s), float(dur_s)
+        if rate < 0 or dur <= 0:
+            raise ValueError(
+                f"rate_schedule segment {part!r} needs rate >= 0 and "
+                f"secs > 0")
+        out.append((rate, dur))
+    if not out:
+        raise ValueError("empty rate_schedule")
+    return out
+
+
+def build_arrivals(schedule, seed: int):
+    """Deterministic Poisson arrival times over the piecewise schedule:
+    ``[(offset_secs, segment_idx), ...]`` sorted by time.  Pre-generated
+    so every client sleeps toward an absolute deadline — the spike stays
+    a spike even when slow responses bunch the clients up."""
+    rng = random.Random(seed * 1000003 + 17)
+    arrivals = []
+    t0 = 0.0
+    for i, (rate, dur) in enumerate(schedule):
+        if rate > 0:
+            t = t0 + rng.expovariate(rate)
+            while t < t0 + dur:
+                arrivals.append((t, i))
+                t += rng.expovariate(rate)
+        t0 += dur
+    return arrivals
 
 
 def _percentile(values, q: float):
@@ -170,16 +216,27 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
               rate: float = 0.0, stream: bool = False,
               timeout: float = 300.0, seed: int = 0,
               prefix_tokens: int = 0,
-              shared_prefix_frac: float = 1.0) -> dict:
+              shared_prefix_frac: float = 1.0,
+              rate_schedule: str = None) -> dict:
     """Drive the load and aggregate results (importable — the tier-1
-    smoke test calls this directly against an in-process server)."""
+    smoke test calls this directly against an in-process server).
+
+    With ``rate_schedule`` ("r1:t1,r2:t2,...") the request count and
+    arrival times come from the piecewise Poisson schedule —
+    ``requests`` and ``rate`` are ignored — and the summary gains a
+    per-segment breakdown (``segments``)."""
     results = []
     results_lock = threading.Lock()
-    n_total = max(int(requests), 1)
+    schedule = parse_rate_schedule(rate_schedule) if rate_schedule \
+        else None
+    arrivals = build_arrivals(schedule, seed) if schedule else None
+    n_total = len(arrivals) if arrivals is not None \
+        else max(int(requests), 1)
     issued = {"n": 0}
     issue_lock = threading.Lock()
     rng = random.Random(seed)
     start_gate = threading.Event()
+    t_start = None
 
     def take_ticket():
         with issue_lock:
@@ -194,7 +251,15 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
             ticket = take_ticket()
             if ticket is None:
                 return
-            if rate > 0:
+            segment = None
+            if arrivals is not None:
+                # absolute deadline, not a relative gap: late clients
+                # don't stretch the schedule
+                offset, segment = arrivals[ticket]
+                delay = (t_start + offset) - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+            elif rate > 0:
                 # open-loop Poisson arrivals across the fleet: each
                 # client sleeps an exponential gap scaled by fleet size
                 time.sleep(rng.expovariate(rate / max(clients, 1)))
@@ -204,6 +269,8 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                        "tokens_to_generate": int(tokens),
                        "no_log": True}
             r = _one_request(base_url, payload, stream, timeout)
+            if segment is not None:
+                r["segment"] = segment
             with results_lock:
                 results.append(r)
 
@@ -251,6 +318,10 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         "tpot_p95_secs": _percentile(tpot, 0.95),
         "stream": stream,
         "rate": rate,
+        # piecewise-rate workload (--rate_schedule): the spec string and
+        # a per-segment breakdown (filled below), None on constant rate
+        "rate_schedule": rate_schedule,
+        "segments": None,
         "prefix_tokens": prefix_tokens,
         "shared_prefix_frac": shared_prefix_frac,
         # prefix-cache effectiveness (engine /metrics deltas; None when
@@ -276,6 +347,26 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         "preemptions": None,
         "drained": None,
     }
+    if schedule:
+        segs = []
+        for i, (seg_rate, seg_dur) in enumerate(schedule):
+            rs = [r for r in results if r.get("segment") == i]
+            oks = [r for r in rs if r["ok"]]
+            seg_lat = [r["secs"] for r in oks]
+            seg_ttft = [r["ttft_secs"] for r in oks
+                        if r["ttft_secs"] is not None]
+            segs.append({
+                "segment": i,
+                "rate": seg_rate,
+                "duration_secs": seg_dur,
+                "requests": len(rs),
+                "ok": len(oks),
+                "errors": len(rs) - len(oks),
+                "requests_per_sec": round(len(oks) / seg_dur, 3),
+                "latency_p95_secs": _percentile(seg_lat, 0.95),
+                "ttft_p95_secs": _percentile(seg_ttft, 0.95),
+            })
+        out["segments"] = segs
     if m0 is not None and m1 is not None:
         # a router /metrics nests the fleet-summed engine counters (and
         # request counts) under "aggregate" — delta those transparently
@@ -387,6 +478,17 @@ def print_table(r: dict) -> None:
           + (" (stream)" if r["stream"] else ""))
     for k, v in rows:
         print(f"  {k:<{w}}  {v}")
+    if r.get("segments"):
+        print(f"  rate schedule ({r.get('rate_schedule')}):")
+        print(f"    {'seg':>3} {'rate':>8} {'secs':>7} {'ok/total':>9} "
+              f"{'req/s':>8} {'lat p95':>9} {'ttft p95':>9}")
+        for s in r["segments"]:
+            print(f"    {s['segment']:>3} {_fmt(s['rate']):>8} "
+                  f"{_fmt(s['duration_secs']):>7} "
+                  f"{s['ok']}/{s['requests']:<7} "
+                  f"{_fmt(s['requests_per_sec']):>8} "
+                  f"{_fmt(s['latency_p95_secs']):>9} "
+                  f"{_fmt(s['ttft_p95_secs']):>9}")
 
 
 def main(argv=None):
@@ -404,6 +506,12 @@ def main(argv=None):
     p.add_argument("--rate", type=float, default=0.0,
                    help="open-loop Poisson arrival rate in req/s across "
                         "the fleet (0 = closed loop)")
+    p.add_argument("--rate_schedule", default=None,
+                   metavar="R1:T1,R2:T2,...",
+                   help="piecewise open-loop Poisson rates (req/s for "
+                        "secs each; 0 rate = silent pause) for "
+                        "spike->recover workloads; overrides --rate and "
+                        "--requests and adds a per-segment table")
     p.add_argument("--stream", action="store_true",
                    help="use /api/stream (measures true TTFT)")
     p.add_argument("--timeout", type=float, default=300.0)
@@ -432,7 +540,8 @@ def main(argv=None):
               tokens=args.tokens, prompt=args.prompt, rate=args.rate,
               stream=args.stream, timeout=args.timeout, seed=args.seed,
               prefix_tokens=args.prefix_tokens,
-              shared_prefix_frac=args.shared_prefix_frac)
+              shared_prefix_frac=args.shared_prefix_frac,
+              rate_schedule=args.rate_schedule)
     if args.ab:
         if not args.ab_url:
             p.error("--ab needs --ab_url (the second arm's server)")

@@ -23,6 +23,10 @@ trace_id, per-request phase attribution, tpot_secs) and prints:
   quantifying what the PR 6 prefix cache is worth end-to-end
 * per-replica comparison — pass several JSONL files/dirs (one per
   replica) and each gets its own column plus the fleet total
+* fleet-event timeline — supervisor events (``kind: "fleet"``, schema
+  >= 7: replica_spawned/died/respawned, scale_up/down, brownout) from a
+  serve log or a ``tools/serve_fleet.py --fleet_event_log`` JSONL,
+  rendered as counters plus a chronological timeline
 
 Pure stdlib — no jax import, runs anywhere the files do.
 
@@ -47,6 +51,11 @@ PHASE_KEYS = ("queue_secs", "admission_secs", "prefill_secs",
 
 RESILIENCE_EVENTS = ("engine_restart", "preemption", "drain")
 
+# supervisor control-loop events (kind "fleet", schema >= 7); the order
+# here is the counter order in the report
+FLEET_EVENTS = ("replica_spawned", "replica_died", "replica_respawned",
+                "scale_up", "scale_down", "brownout")
+
 
 def load_records(path: str) -> List[Dict]:
     """request_done records from a telemetry.jsonl (or its dir)."""
@@ -58,17 +67,27 @@ def load_resilience_events(path: str) -> List[Dict]:
     return _load(path)[1]
 
 
+def load_fleet_events(path: str) -> List[Dict]:
+    """Supervisor fleet events (scale_up / replica_died / ...) from a
+    serve log or a --fleet_event_log JSONL."""
+    return _load(path)[2]
+
+
 def _load(path: str):
     if os.path.isdir(path):
         path = os.path.join(path, STREAM_FILENAME)
     if not os.path.exists(path):
         raise FileNotFoundError(f"no serve log at {path}")
-    records, events = [], []
+    records, events, fleet = [], [], []
     with open(path) as f:
         for line in f:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "fleet" \
+                    and rec.get("event") in FLEET_EVENTS:
+                fleet.append(rec)
                 continue
             if rec.get("kind") != "serve":
                 continue
@@ -76,7 +95,7 @@ def _load(path: str):
                 records.append(rec)
             elif rec.get("event") in RESILIENCE_EVENTS:
                 events.append(rec)
-    return records, events
+    return records, events, fleet
 
 
 def _percentile(values: List[float], q: float) -> Optional[float]:
@@ -188,10 +207,12 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
     per_replica: Dict[str, Dict] = {}
     all_records: List[Dict] = []
     all_events: List[Dict] = []
+    all_fleet: List[Dict] = []
     for p in paths:
-        records, events = _load(p)
+        records, events, fleet = _load(p)
         all_records.extend(records)
         all_events.extend(events)
+        all_fleet.extend(fleet)
         if len(paths) > 1:
             per_replica[p] = {
                 **latency_summary(records),
@@ -229,9 +250,40 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
     for r in all_records:
         fr = r.get("finish_reason") or "?"
         out["finish_reasons"][fr] = out["finish_reasons"].get(fr, 0) + 1
+    if all_fleet:
+        out["fleet"] = fleet_summary(all_fleet)
     if per_replica:
         out["replicas"] = per_replica
     return out
+
+
+def fleet_summary(events: List[Dict]) -> Dict:
+    """Counters plus a chronological timeline of supervisor activity
+    (scale-ups, deaths, respawns, brownouts) with offsets relative to
+    the first fleet event — the narrative of a chaos/autoscale run."""
+    events = sorted(events, key=lambda e: e.get("time_unix") or 0.0)
+    t0 = next((e["time_unix"] for e in events
+               if isinstance(e.get("time_unix"), (int, float))), None)
+    timeline = []
+    for e in events:
+        t = e.get("time_unix")
+        entry = {
+            "t_secs": (round(t - t0, 3)
+                       if isinstance(t, (int, float)) and t0 is not None
+                       else None),
+            "event": e.get("event"),
+        }
+        for key in ("slot", "url", "reason", "exited_while",
+                    "ttft_p95_secs", "queue_depth", "eta_secs",
+                    "spawn_secs"):
+            if e.get(key) is not None:
+                entry[key] = e[key]
+        timeline.append(entry)
+    return {
+        "events": {name: sum(e.get("event") == name for e in events)
+                   for name in FLEET_EVENTS},
+        "timeline": timeline,
+    }
 
 
 def _fmt(v, unit="s") -> str:
@@ -310,6 +362,21 @@ def render(report: Dict) -> str:
                     "nonfinite_evictions"):
             lines.append(f"  {key:>20}: {res.get(key, 0)}")
 
+    fleet = report.get("fleet")
+    if fleet:
+        counts = " ".join(f"{k}={v}" for k, v in fleet["events"].items()
+                          if v)
+        lines.append(f"\nfleet events: {counts or '-'}")
+        for e in fleet["timeline"]:
+            t = e.get("t_secs")
+            detail = " ".join(
+                f"{k}={e[k]}" for k in ("slot", "url", "reason",
+                                        "exited_while", "ttft_p95_secs",
+                                        "queue_depth", "eta_secs",
+                                        "spawn_secs") if k in e)
+            lines.append(f"  +{t if t is not None else '?':>9}s "
+                         f"{e['event']:<18} {detail}")
+
     for path, s in (report.get("replicas") or {}).items():
         lines.append(f"\nreplica {path} "
                      f"(joint SLO "
@@ -340,7 +407,7 @@ def main(argv=None) -> int:
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
-    if report["summary"]["requests"] == 0:
+    if report["summary"]["requests"] == 0 and not report.get("fleet"):
         print("no request_done records found (serve with "
               "--structured_log_dir and schema >= 5)", file=sys.stderr)
         return 2

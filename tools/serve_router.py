@@ -17,6 +17,7 @@ GET /metrics (JSON or Prometheus).  See docs/guide/serving.md,
 
 import argparse
 import os
+import signal
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -32,10 +33,12 @@ def parse_args(argv=None):
     p.add_argument("--fail_threshold", type=int, default=3,
                    help="consecutive transport failures before a replica "
                         "is circuit-broken")
-    p.add_argument("--cooldown_secs", type=float, default=1.0,
+    p.add_argument("--breaker_backoff_secs", "--cooldown_secs",
+                   dest="breaker_backoff_secs", type=float, default=1.0,
                    help="initial breaker cooldown (doubles per trip)")
     p.add_argument("--max_cooldown_secs", type=float, default=30.0)
-    p.add_argument("--health_interval_secs", type=float, default=2.0,
+    p.add_argument("--probe_interval_secs", "--health_interval_secs",
+                   dest="probe_interval_secs", type=float, default=2.0,
                    help="background /health probe period")
     p.add_argument("--affinity_chars", type=int, default=256,
                    help="prompt prefix length keying session affinity")
@@ -64,18 +67,29 @@ def main(argv=None):
         start_trace_flusher(Tracing(tracer=tracer,
                                     trace_dir=args.trace_dir))
 
+    backends = [u for u in args.backends.split(",") if u.strip()]
+    if not backends:
+        raise SystemExit("serve_router: --backends needs at least one "
+                         "replica address (for a dynamic fleet use "
+                         "tools/serve_fleet.py)")
     router = ReplicaRouter(
-        [u for u in args.backends.split(",") if u.strip()],
+        backends,
         fail_threshold=args.fail_threshold,
-        cooldown_secs=args.cooldown_secs,
+        cooldown_secs=args.breaker_backoff_secs,
         max_cooldown_secs=args.max_cooldown_secs,
         affinity_chars=args.affinity_chars,
         affinity_max=args.affinity_max,
-        health_interval_secs=args.health_interval_secs,
+        health_interval_secs=args.probe_interval_secs,
         request_timeout_secs=args.request_timeout_secs,
         tracer=tracer,
     )
-    RouterServer(router).run(host=args.host, port=args.port)
+    server = RouterServer(router)
+
+    # deterministic teardown: stop the health prober, then break
+    # serve_forever (today the probe thread dies whenever the process
+    # does — SIGTERM should be a clean exit, not a daemon-thread race)
+    signal.signal(signal.SIGTERM, lambda *_: server.shutdown())
+    server.run(host=args.host, port=args.port)
 
 
 if __name__ == "__main__":

@@ -108,6 +108,13 @@ MANIFEST: List[Step] = [
          "python -m pytest tests/test_serve_bench_tool.py "
          "-k ab_prefill -q -p no:cacheprovider",
          900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+    # fleet supervisor chaos: spike schedule breaches the TTFT SLO, the
+    # supervisor scales up and p95 recovers; a mid-run SIGKILL is
+    # respawned — zero dropped requests, zero engine restarts
+    Step("serve_fleet_chaos",
+         "python -m pytest tests/test_serve_fleet.py "
+         "-m slow -q -p no:cacheprovider",
+         1200, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
 ]
 
 
