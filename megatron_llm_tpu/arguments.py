@@ -455,6 +455,17 @@ def _add_inference_args(parser):
                         "when the Pallas backend is available, 'on' "
                         "forces it, 'off' keeps the dense XLA gather "
                         "branch")
+    g.add_argument("--serve_speculative", type=int, default=0,
+                   help="in-engine speculative decoding: host-side "
+                        "prompt-lookup drafting (serving/drafter.py) "
+                        "verified by a fixed-shape [slots, draft_k+1] "
+                        "exact-greedy step on the paged cache; sampled-"
+                        "temperature requests decode normally inside the "
+                        "same program; 0 disables")
+    g.add_argument("--serve_draft_k", type=int, default=4,
+                   help="max draft tokens proposed per slot per "
+                        "speculative verify step (the verify program's "
+                        "compiled width is draft_k + 1)")
     g.add_argument("--serve_prefix_cache", type=int, default=1,
                    help="share KV pages across requests with equal "
                         "prompt prefixes (refcounted copy-on-write "

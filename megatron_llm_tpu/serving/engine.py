@@ -9,6 +9,21 @@ a single paged KV pool (text_generation/generation.py
   writes land in the garbage block).  All sampling knobs, block tables,
   lengths and PRNG keys are *traced* inputs, so requests join and leave
   the batch with zero recompiles — the continuous-batching property.
+* ``verify_step`` — the speculative replacement for ``decode_step``
+  when ``EngineConfig.speculative`` is on: a single fixed-shape
+  ``[num_slots, draft_k + 1]`` forward that verifies host-proposed
+  draft tokens (serving/drafter.py prompt-lookup) for every slot at
+  once.  It rides the same paged pool through the scatter-before-read
+  prefill path (n = K+1 <= paged_prefill_max_q in the verify-only
+  config override), with per-slot draft tokens and valid counts as
+  traced inputs — a slot with no usable draft degenerates to a masked
+  plain decode row, so mixed drafting/non-drafting/sampled batches
+  stay zero-recompile.  Verification is exact-greedy (accepted tokens
+  are token-identical to the plain path by construction); host accept
+  logic advances each slot 1..K+1 tokens and rolls the context cursor
+  back over rejected drafts (pages are per-slot append-only, so
+  rollback is a cursor decrement — the garbage-redirect scatter
+  tolerates the re-writes).
 * ``prefill_step`` — ``[1, prefill_chunk]`` tokens of one request's
   prompt.  Chunking fixes the shape (one compile for any prompt length)
   and bounds how long a long prompt can stall decode: the scheduler
@@ -66,6 +81,7 @@ import numpy as np
 
 from megatron_llm_tpu import telemetry, tracing
 from megatron_llm_tpu.models.language_model import language_model_forward
+from megatron_llm_tpu.serving.drafter import draft_budget, lookup_draft
 from megatron_llm_tpu.serving.kv_blocks import (
     BlockManager,
     derive_num_blocks,
@@ -113,6 +129,15 @@ class EngineConfig:
     # prefill config override (so the jitted prefill program never
     # recompiles) and reported as stats()['prefill_kernel'].
     prefill_kernel: str = "auto"
+    # in-engine speculative decoding (--serve_speculative /
+    # --serve_draft_k): host-side prompt-lookup drafting + a fixed-shape
+    # [S, K+1] exact-greedy verify step replacing the plain decode
+    # program.  Resolved ONCE at __init__ (the verify program's width is
+    # a compiled shape) and reported as stats()['speculative'] /
+    # stats()['draft_k'].  Sampled-temperature slots draft K=0 and
+    # decode normally inside the same program.
+    speculative: bool = False
+    draft_k: int = 4
     # resilience (--serve_watchdog_secs / --serve_preemption /
     # --serve_fault_inject; serving/resilience.py)
     watchdog_secs: float = 0.0      # 0 = no engine watchdog
@@ -220,10 +245,27 @@ class InferenceEngine:
             paged_prefill_kernel=(
                 "on" if self.prefill_kernel == "pallas" else "off"),
             paged_prefill_max_q=max(cfg.prefill_chunk, 2))
+        # speculative verify step, resolved ONCE like the kernel paths:
+        # the [S, K+1] verify forward is just another small-n "prefill"
+        # call through the scatter-before-read paged branch, so it rides
+        # the resolved *prefill* attention path with paged_prefill_max_q
+        # widened to K+1.  draft_k is a compiled shape — flipping it
+        # later would recompile, so it is pinned here.
+        if cfg.speculative and cfg.draft_k < 1:
+            raise ValueError(f"speculative decoding needs draft_k >= 1, "
+                             f"got {cfg.draft_k}")
+        self.speculative = bool(cfg.speculative)
+        self.draft_k = int(cfg.draft_k) if self.speculative else 0
+        self._verify_cfg = mcfg.replace(
+            paged_attention_kernel="off",
+            paged_prefill_kernel=(
+                "on" if self.prefill_kernel == "pallas" else "off"),
+            paged_prefill_max_q=max(self.draft_k + 1, 2))
 
         self._st = self._new_state(gen=0)
 
         self._decode_step = jax.jit(self._decode_impl)
+        self._verify_step = jax.jit(self._verify_impl)
         self._prefill_step = jax.jit(self._prefill_impl)
         self._sample_first = jax.jit(self._sample_first_impl)
         self._cow_copy = jax.jit(self._cow_copy_impl)
@@ -236,6 +278,8 @@ class InferenceEngine:
         self.prefill_tokens_computed = 0    # actually ran through prefill
         self.prefill_tokens_cached = 0      # adopted from the prefix cache
         self.occupancy_sum = 0          # sum of active slots over decode steps
+        self.drafted_tokens = 0         # prompt-lookup proposals verified
+        self.accepted_tokens = 0        # proposals committed by verify
         self.prefill_secs = 0.0
         self.decode_secs = 0.0
         self.finished: Dict[str, int] = {}
@@ -267,7 +311,8 @@ class InferenceEngine:
         blocks = BlockManager(self._num_blocks, cfg.block_size,
                               cfg.num_slots, self._max_blocks_per_slot,
                               prefix_cache=cfg.prefix_cache)
-        sched = Scheduler(self.queue, blocks, cfg.max_model_len)
+        sched = Scheduler(self.queue, blocks, cfg.max_model_len,
+                          draft_k=self.draft_k)
         if carry is not None:
             old = carry.scheduler
             sched.admitted = old.admitted
@@ -347,6 +392,50 @@ class InferenceEngine:
         next_tokens = sample_batched(logits, sub[:, 0], top_ks, top_ps,
                                      temps)
         return next_tokens, self._strip_pages(new_caches), sub[:, 1], finite
+
+    def _verify_impl(self, params, pages, tokens, context_lens,
+                     block_tables, vlens, temps, top_ks, top_ps,
+                     ban_a, ban_b, keys):
+        """Speculative [S, K+1] verify step — the decode program when
+        ``speculative`` is on.  Row s carries ``[last_token, draft_1..
+        draft_L, pad]`` with ``vlens[s] = 1 + L`` (0 for inactive
+        slots); the paged scatter-before-read branch writes the valid
+        prefix's KV at ``context_lens[s]..`` and redirects padded and
+        inactive rows to the garbage block, exactly like a prefill
+        chunk.  Output row 0 goes through ``sample_batched`` with ONE
+        key split per slot — a non-drafting (sampled or draft-less)
+        slot therefore sees bit-identical logits, key chain and token
+        stream to the plain decode program.  Rows >= 1 are raw argmax:
+        only exact-greedy slots draft, and argmax of row j is exact
+        whenever drafts 1..j all matched (the host accept rule commits
+        no further)."""
+        cfg = self._verify_cfg
+        K1 = tokens.shape[1]
+        positions = context_lens[:, None] + jnp.arange(K1)[None, :]
+        caches = self._layer_caches(pages, block_tables, context_lens,
+                                    vlens)
+        logits, new_caches = language_model_forward(
+            params, tokens, positions, None, cfg,
+            rng_key=None, train=False, kv_caches=caches)
+        logits = logits.astype(jnp.float32)             # [S, K+1, V]
+        # per-slot sentinel over the VALID rows only — padded rows
+        # attend garbage KV and may legitimately misbehave
+        row_valid = jnp.arange(K1)[None, :] < vlens[:, None]
+        finite = (jnp.isfinite(logits).all(axis=-1)
+                  | ~row_valid).all(axis=-1)            # [S] bool
+        V = logits.shape[-1]
+        # ban pair per position: row j samples the token following
+        # tokens[:, j], so that input token is the "previous" one
+        banned = (ban_a[:, None] >= 0) & (tokens == ban_a[:, None])
+        hit = (jnp.arange(V)[None, None, :]
+               == jnp.clip(ban_b, 0, V - 1)[:, None, None])
+        logits = jnp.where(banned[:, :, None] & hit, NEG_INF, logits)
+        sub = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+        first = sample_batched(logits[:, 0, :], sub[:, 0], top_ks,
+                               top_ps, temps)
+        emit = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        emit = emit.at[:, 0].set(first.astype(jnp.int32))
+        return emit, self._strip_pages(new_caches), sub[:, 1], finite
 
     def _prefill_impl(self, params, pages, tokens, start_pos, valid_len,
                       block_table):
@@ -763,6 +852,13 @@ class InferenceEngine:
     # -- decode ---------------------------------------------------------
 
     def _run_decode(self, st: _EngineState, slots: List[int]) -> None:
+        if self.speculative:
+            # one decode path: with speculation on EVERY decode step is
+            # the [S, K+1] verify program — draft-less and sampled slots
+            # ride it masked (vlen = 1), so the plain decode program is
+            # never dispatched and cannot cause a late first compile
+            self._run_verify(st, slots)
+            return
         bs = self.config.block_size
         for s in slots:
             self._writable(st, s, int(st.context_lens[s]) // bs)
@@ -823,6 +919,115 @@ class InferenceEngine:
             if sp.top_p_decay > 0.0:
                 st.top_ps[s] = sp.top_p_at(len(req.out_tokens) + 1)
             self._emit_and_check(st, req, tok)
+
+    def _run_verify(self, st: _EngineState, slots: List[int]) -> None:
+        """Speculative decode step: draft on the host (prompt-lookup
+        per slot), verify all slots in one [S, K+1] forward, then commit
+        1..K+1 tokens per slot with rejected drafts rolled back by a
+        cursor decrement (the pages are per-slot append-only; the next
+        step's scatter overwrites the stale tail)."""
+        cfg = self.config
+        K = self.draft_k
+        bs = cfg.block_size
+        S = cfg.num_slots
+        decoding = [r for r in (st.scheduler.active.get(s) for s in slots)
+                    if r is not None and r.state == RequestState.DECODE]
+        # host drafting: each exact-greedy slot proposes from its OWN
+        # history, clamped so accepted drafts + the bonus token can
+        # never overshoot max_new_tokens (satisfying the scheduler's +K
+        # page reservation as a side effect); sampled-temperature slots
+        # draft 0 and decode normally inside the same program
+        draft_tokens = np.zeros((S, K), np.int32)
+        draft_lens = np.zeros(S, np.int32)
+        for req in decoding:
+            sp = req.sampling
+            if not sp.greedy:
+                continue
+            d = lookup_draft(req.tokens,
+                             draft_budget(K, sp.max_new_tokens,
+                                          len(req.out_tokens)))
+            if d:
+                draft_lens[req.slot] = len(d)
+                draft_tokens[req.slot, :len(d)] = d
+        vlens = np.where(st.active > 0, 1 + draft_lens, 0).astype(np.int32)
+        verify_tokens = np.zeros((S, K + 1), np.int32)
+        verify_tokens[:, 0] = st.last_tokens
+        verify_tokens[:, 1:] = draft_tokens
+        for s in slots:
+            ctx = int(st.context_lens[s])
+            last = ctx + max(int(vlens[s]), 1) - 1
+            for bi in range(ctx // bs, last // bs + 1):
+                self._writable(st, s, bi)
+        traces = sorted({r.trace_id for r in decoding if r.trace_id})
+        t0 = time.perf_counter()
+        with tracing.span("decode_step", "serve", batch=len(slots),
+                          traces=traces,
+                          drafted=int(draft_lens.sum())):
+            emit, st.pages, new_keys, finite = self._verify_step(
+                self.params, st.pages, verify_tokens, st.context_lens,
+                st.blocks.tables.copy(), vlens, st.temps, st.top_ks,
+                st.top_ps, st.ban_a, st.ban_b, st.keys)
+            emit = np.asarray(emit)
+        # same key discipline as the plain decode step: exactly one
+        # split per decoding slot per step, so a sampled slot's stream
+        # is bit-identical spec-on vs spec-off
+        new_keys = np.asarray(new_keys)
+        finite = np.asarray(finite).copy()
+        for s in slots:
+            st.keys[s] = new_keys[s]
+        if st is not self._st:
+            return          # engine restarted mid-dispatch: stale state
+        inj = self.fault_injector if self.warmed_up else None
+        if slots and inj is not None \
+                and inj.poison_nonfinite(self._dispatches):
+            finite[min(slots)] = False
+        step_secs = time.perf_counter() - t0
+        self.decode_secs += step_secs
+        self.decode_steps += 1
+        self.occupancy_sum += len(slots)
+        share = step_secs / max(len(decoding), 1)
+        for req in decoding:
+            req.decode_amortized_secs += share
+        for s in slots:
+            req = st.scheduler.active.get(s)
+            if req is None or req.state != RequestState.DECODE:
+                continue
+            if not finite[s]:
+                self._evict_nonfinite(st, req)
+                continue
+            L = int(draft_lens[s])
+            g = emit[s]
+            # accept rule: longest prefix with draft_i == the token the
+            # verified logits emit at position i — exactly the token the
+            # plain path would have produced, because row i's logits are
+            # exact whenever drafts 1..i all matched
+            a = 0
+            while a < L and int(draft_tokens[s, a]) == int(g[a]):
+                a += 1
+            self.drafted_tokens += L
+            req.spec_drafted += L
+            sp = req.sampling
+            committed = 0
+            for i in range(a + 1):
+                # advance the cursor BEFORE emitting: _retire (via a
+                # stop/length finish inside _emit_and_check) reads
+                # context_lens[s] as the written-KV count
+                st.context_lens[s] += 1
+                tok = int(g[i])
+                st.last_tokens[s] = tok
+                req.decode_tokens += 1
+                committed += 1
+                if sp.top_p_decay > 0.0:
+                    st.top_ps[s] = sp.top_p_at(len(req.out_tokens) + 1)
+                self._emit_and_check(st, req, tok)
+                if req.state == RequestState.DONE:
+                    break       # stop token mid-chain: drop the rest
+            # committed - 1 of the commits were drafts (the bonus token
+            # is the engine's own); context_lens now points past the
+            # last committed token — rejected drafts' KV beyond it is
+            # stale but unreachable (valid_lens gates every read)
+            self.accepted_tokens += committed - 1
+            req.spec_accepted += committed - 1
 
     # -- completion -----------------------------------------------------
 
@@ -894,6 +1099,10 @@ class InferenceEngine:
                 max(len(req.prompt_tokens) - req.cached_prompt_tokens, 0),
             "new_tokens": len(req.out_tokens),
             "decode_tokens": req.decode_tokens,
+            "drafted_tokens": req.spec_drafted,
+            "accepted_tokens": req.spec_accepted,
+            "accept_rate": (round(req.accept_rate(), 4)
+                            if req.accept_rate() is not None else None),
             "finish_reason": req.finish_reason,
             "ttft_secs": req.ttft_secs(),
             "latency_secs": req.latency_secs(),
@@ -926,11 +1135,12 @@ class InferenceEngine:
 
     def warmup(self) -> None:
         """Compile the steady-state programs (prefill chunk, first-token
-        sampler, decode step) with one dummy greedy request.  The decode
-        step and the prefill chunk each bake in their resolved
-        paged-attention path (Pallas ragged kernel or XLA gather — static
-        config fields), so each kernel compiles here exactly once.  Call
-        before
+        sampler, and the decode step — the [S, K+1] verify program when
+        speculative is on, the [S] plain step otherwise) with one dummy
+        greedy request.  The decode/verify step and the prefill chunk
+        each bake in their resolved paged-attention path (Pallas ragged
+        kernel or XLA gather — static config fields), so each kernel
+        compiles here exactly once.  Call before
         ``tracing.RecompileDetector.mark_steady()`` — after this, serving
         arbitrary requests triggers zero compiles."""
         assert self._thread is None, "warm up before start()"
@@ -982,6 +1192,10 @@ class InferenceEngine:
             "warmed_up": self.warmed_up,
             "paged_kernel": self.paged_kernel,
             "prefill_kernel": self.prefill_kernel,
+            "speculative": self.speculative,
+            "draft_k": self.draft_k,
+            "drafted_tokens": self.drafted_tokens,
+            "accepted_tokens": self.accepted_tokens,
             "engine_restarts": self.engine_restarts,
             "slots_evicted_nonfinite": self.slots_evicted_nonfinite,
         })

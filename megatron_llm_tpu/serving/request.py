@@ -123,6 +123,12 @@ class Request:
         self.decode_amortized_secs = 0.0    # share of batched decode steps
         self.stream_write_secs = 0.0
         self.decode_tokens = 0
+        # speculative-decoding attribution (engine verify steps):
+        # drafted = prompt-lookup proposals this request rode into verify
+        # steps; accepted = the subset verification committed.  Greedy
+        # requests with zero proposals and sampled requests both stay 0/0.
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self.preempt_count = 0          # pool-pressure preemptions survived
         self._done = threading.Event()
         self._events: Optional[queue.Queue] = queue.Queue() if stream \
@@ -199,6 +205,14 @@ class Request:
         if self.decode_tokens <= 0:
             return None
         return self.decode_amortized_secs / self.decode_tokens
+
+    def accept_rate(self) -> Optional[float]:
+        """Fraction of this request's drafted tokens that verification
+        accepted.  None when the request never drafted (speculative off,
+        sampled temperature, or no n-gram ever matched)."""
+        if self.spec_drafted <= 0:
+            return None
+        return self.spec_accepted / self.spec_drafted
 
     def phases(self) -> dict:
         """Wall-clock attribution for the request_done record: where this

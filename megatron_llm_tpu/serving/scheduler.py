@@ -47,10 +47,15 @@ from megatron_llm_tpu.serving.request import (
 
 class Scheduler:
     def __init__(self, queue: RequestQueue, blocks: BlockManager,
-                 max_model_len: int):
+                 max_model_len: int, draft_k: int = 0):
         self.queue = queue
         self.blocks = blocks
         self.max_model_len = int(max_model_len)
+        # speculative decoding (engine verify step): a drafting slot's
+        # verify step scatters KV for up to draft_k proposals BEYOND the
+        # committed context before the host accept logic rolls the cursor
+        # back, so the worst-case reservation must cover those writes too
+        self.draft_k = int(draft_k)
         self.active: Dict[int, Request] = {}     # slot -> request
         self._last_was_prefill = False
         # counters surfaced through engine stats / ServerMetrics
@@ -62,12 +67,28 @@ class Scheduler:
     # -- admission ------------------------------------------------------
 
     def total_tokens(self, req: Request) -> int:
-        return len(req.prompt_tokens) + req.sampling.max_new_tokens
+        """Worst-case token positions this request may write KV for —
+        what admission must reserve blocks against.  A drafting (greedy,
+        speculative-on) slot's verify step scatters up to ``draft_k``
+        proposals past the committed context before rejection rolls the
+        cursor back, so its reservation grows by K; without this a
+        near-full pool admits a request whose first verify step writes
+        into blocks it never reserved.  Capped at ``max_model_len``: the
+        engine's draft budget clamp keeps every write position below it,
+        and the cap keeps boundary-sized requests (prompt + max_new ==
+        max_model_len) admittable."""
+        base = len(req.prompt_tokens) + req.sampling.max_new_tokens
+        if self.draft_k > 0 and req.sampling.greedy:
+            return min(base + self.draft_k, self.max_model_len)
+        return base
 
     def validate(self, req: Request) -> None:
         """Raises ValueError for requests that could never run (too long
-        for the model/pool) — callers map this to HTTP 400, not 429."""
-        total = self.total_tokens(req)
+        for the model/pool) — callers map this to HTTP 400, not 429.
+        Checked against the base need, NOT the +K draft reservation:
+        drafting never extends the *committed* sequence past the budget,
+        so a boundary-sized request stays valid with speculation on."""
+        total = len(req.prompt_tokens) + req.sampling.max_new_tokens
         if total > self.max_model_len:
             self.rejected_len += 1
             raise ValueError(

@@ -424,7 +424,12 @@ def _wants_prometheus(path: str, accept: str) -> bool:
 #    replica_respawned / scale_up / scale_down / brownout, each with
 #    slot/url/reason fields) — see serving/supervisor.py and
 #    tools/serve_report.py's fleet-event timeline
-TELEMETRY_SCHEMA_VERSION = 7
+# 8: serve request_done records gain speculative-decoding attribution:
+#    drafted_tokens / accepted_tokens (prompt-lookup proposals this
+#    request rode into verify steps and the subset verification
+#    committed) and accept_rate (accepted/drafted, null when the request
+#    never drafted) — see serving/engine.py and serving/drafter.py
+TELEMETRY_SCHEMA_VERSION = 8
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 

@@ -69,6 +69,11 @@ def main():
                         "raise it so a spike backlogs instead of 429s)")
     p.add_argument("--serve_deadline_secs", type=float, default=60.0,
                    help="default per-request deadline")
+    p.add_argument("--serve_speculative", type=int, default=0,
+                   help="1 = prompt-lookup speculative decoding "
+                        "(fixed-shape K+1 verify step)")
+    p.add_argument("--serve_draft_k", type=int, default=4,
+                   help="max draft tokens per slot per verify step")
     args = p.parse_args()
     if args.structured_log_dir:
         from megatron_llm_tpu import telemetry
@@ -97,6 +102,8 @@ def main():
         default_deadline_secs=args.serve_deadline_secs,
         paged_kernel=args.paged_kernel,
         prefill_kernel=args.prefill_kernel,
+        speculative=bool(args.serve_speculative),
+        draft_k=args.serve_draft_k,
         watchdog_secs=args.serve_watchdog_secs,
         fault_spec=args.serve_fault_inject,
         restart_backoff_secs=0.0))

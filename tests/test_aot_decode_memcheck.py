@@ -23,3 +23,9 @@ def test_tiny_rows():
     assert by_name["tiny-bf16"]["fits"] and by_name["tiny-int8"]["fits"]
     assert (by_name["tiny-int8"]["arg_gb"]
             < by_name["tiny-bf16"]["arg_gb"])
+    # the speculative row AOT-compiles the [b, K+1] verify window
+    # through the same path and stays resident
+    spec = by_name["tiny-int8-spec4"]
+    assert spec["fits"] and spec["spec_k"] == 4
+    # same weights + cache as the int8 row: only activation temp grows
+    assert spec["arg_gb"] == by_name["tiny-int8"]["arg_gb"]

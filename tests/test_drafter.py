@@ -1,0 +1,145 @@
+"""serving/drafter.py prompt-lookup drafting edge cases + the scheduler's
+speculative +K worst-case page reservation — pure host-side (no model,
+no jax device work)."""
+
+import pytest
+
+from megatron_llm_tpu.serving.drafter import draft_budget, lookup_draft
+from megatron_llm_tpu.serving.kv_blocks import BlockManager
+from megatron_llm_tpu.serving.request import (
+    Request,
+    RequestQueue,
+    SamplingParams,
+)
+from megatron_llm_tpu.serving.scheduler import Scheduler
+
+
+# ---------------------------------------------------------------------------
+# lookup_draft
+# ---------------------------------------------------------------------------
+
+def test_draft_basic_bigram_continuation():
+    # bigram (1, 2) last occurred at position 3 -> continuation [5, 1, 2]
+    assert lookup_draft([1, 2, 9, 1, 2, 5, 1, 2], 2) == [5, 1]
+
+
+def test_draft_prefers_most_recent_match():
+    # (1, 2) occurs at 0 (-> 9) and at 3 (-> 5): the recent one wins
+    assert lookup_draft([1, 2, 9, 1, 2, 5, 1, 2], 1) == [5]
+
+
+def test_draft_match_at_position_zero():
+    # the ONLY earlier occurrence of (7, 8) starts the history
+    assert lookup_draft([7, 8, 5, 7, 8], 3) == [5, 7, 8]
+
+
+def test_draft_empty_and_short_history():
+    assert lookup_draft([], 4) == []
+    assert lookup_draft([1], 4) == []
+    assert lookup_draft([1, 2], 4) == []        # bigram, no continuation
+
+
+def test_draft_no_earlier_occurrence():
+    assert lookup_draft([1, 2, 3, 4, 5], 4) == []
+    # the current bigram itself is not a match (j + 2 < n excluded)
+    assert lookup_draft([9, 9, 1, 2], 4) == []
+
+
+def test_draft_k_zero_slot():
+    # sampled-temperature slots pass k=0: always no proposal
+    assert lookup_draft([1, 2, 1, 2, 1, 2], 0) == []
+    assert lookup_draft([1, 2, 1, 2, 1, 2], -1) == []
+
+
+def test_draft_truncates_at_history_end():
+    # match at 0, continuation [3, 1, 2] — only 3 known tokens, never
+    # padded up to k
+    assert lookup_draft([1, 2, 3, 1, 2], 4) == [3, 1, 2]
+
+
+def test_draft_never_exceeds_k():
+    d = lookup_draft([1, 2, 3, 4, 5, 6, 1, 2], 3)
+    assert d == [3, 4, 5]
+
+
+def test_draft_budget_clamps_to_remaining_tokens():
+    # a verify step commits up to draft_len + 1 tokens, so the budget
+    # leaves room for the bonus: never overshoot max_new_tokens
+    assert draft_budget(4, 16, 0) == 4          # plenty left
+    assert draft_budget(4, 16, 11) == 4
+    assert draft_budget(4, 16, 12) == 3         # 4 left -> draft 3
+    assert draft_budget(4, 16, 14) == 1
+    assert draft_budget(4, 16, 15) == 0         # 1 left: plain decode
+    assert draft_budget(4, 16, 16) == 0
+    for gen in range(17):
+        k = draft_budget(4, 16, gen)
+        assert k + 1 + gen <= 16 or k == 0
+
+
+# ---------------------------------------------------------------------------
+# scheduler +K reservation (the ride-along bugfix): a drafting slot's
+# verify step writes KV up to K tokens past the committed context, so a
+# near-full pool must NOT admit a request whose base reservation fits
+# but whose first verify step would write into unreserved blocks
+# ---------------------------------------------------------------------------
+
+def _sched(num_blocks, draft_k, block_size=4, max_model_len=64):
+    bm = BlockManager(num_blocks=num_blocks, block_size=block_size,
+                      num_slots=2, max_blocks_per_slot=16,
+                      prefix_cache=False)
+    return Scheduler(RequestQueue(8), bm, max_model_len, draft_k=draft_k)
+
+
+GREEDY8 = SamplingParams(max_new_tokens=8, temperature=0.0)
+
+
+def test_reservation_counts_draft_tokens():
+    # prompt 8 + max_new 8 = 16 tokens = 4 blocks base; +K=4 -> 5 blocks
+    req = Request([1] * 8, GREEDY8)
+    assert _sched(9, 0).total_tokens(req) == 16
+    assert _sched(9, 4).total_tokens(req) == 20
+
+
+def test_near_full_pool_rejects_drafting_request():
+    # 5 pool blocks = 4 usable (block 0 is the garbage block): exactly
+    # the base need.  Without the corrected reservation this admits and
+    # the first verify step scatters into blocks it never reserved.
+    sched = _sched(5, 4)
+    sched.queue.put(Request([1] * 8, GREEDY8))
+    assert sched.admit() == []
+    # one more usable block covers the +K worst case: admits
+    sched = _sched(6, 4)
+    req = Request([1] * 8, GREEDY8)
+    sched.queue.put(req)
+    assert sched.admit() == [req]
+
+
+def test_sampled_request_keeps_base_reservation():
+    # a sampled-temperature request never drafts: the near-full pool
+    # that refuses the greedy request still admits it
+    sched = _sched(5, 4)
+    req = Request([1] * 8, SamplingParams(max_new_tokens=8,
+                                          temperature=0.9))
+    sched.queue.put(req)
+    assert sched.admit() == [req]
+
+
+def test_boundary_request_stays_admittable_with_speculation():
+    # prompt + max_new == max_model_len: the +K reservation caps at
+    # max_model_len (the engine's draft budget clamp keeps every write
+    # below it), so speculation must not 400-reject or starve it
+    sched = _sched(32, 4, block_size=4, max_model_len=32)
+    req = Request([1] * 16, SamplingParams(max_new_tokens=16,
+                                           temperature=0.0))
+    sched.validate(req)                          # no ValueError
+    assert sched.total_tokens(req) == 32
+    sched.queue.put(req)
+    assert sched.admit() == [req]
+
+
+def test_over_length_still_rejected_with_speculation():
+    sched = _sched(32, 4, block_size=4, max_model_len=32)
+    with pytest.raises(ValueError):
+        sched.validate(Request([1] * 17,
+                               SamplingParams(max_new_tokens=16,
+                                              temperature=0.0)))

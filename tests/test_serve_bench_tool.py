@@ -66,6 +66,8 @@ def _start_stub(paged_kernel="xla", prefill_kernel="xla"):
                     "prefix_cache_hits": 2 * n,
                     "prefix_cache_misses": n,
                     "prefix_cache_evictions": 0,
+                    "drafted_tokens": 3 * n,
+                    "accepted_tokens": 2 * n,
                     "paged_kernel": paged_kernel,
                     "prefill_kernel": prefill_kernel,
                 }
@@ -183,6 +185,16 @@ def test_prefix_workload_reports_engine_deltas(stub_server):
     assert r["prefill_tokens_per_sec"] > 0
     assert r["prefill_tokens_per_sec"] == pytest.approx(
         16 / r["wall_secs"], rel=0.01)
+
+
+def test_bench_reports_speculative_deltas(stub_server):
+    # the stub's engine drafts 3 and accepts 2 tokens per request
+    r = serve_bench.run_bench(stub_server, clients=2, requests=4, tokens=3)
+    assert r["drafted_tokens"] == 12
+    assert r["accepted_tokens"] == 8
+    assert r["accept_rate"] == pytest.approx(8 / 12, abs=1e-4)
+    assert r["accepted_tokens_per_sec"] == pytest.approx(
+        8 / r["wall_secs"], rel=0.01)
 
 
 def test_percentile_helper():
@@ -396,6 +408,51 @@ def test_ab_end_to_end_two_engines(capsys):
         assert rows[1]["paged_kernel"] == "xla"
         for r in rows:
             assert r["errors"] == 0 and r["tokens_per_sec"] > 0
+    finally:
+        for p in (p_on, p_off):
+            p.kill()
+            p.wait()
+
+
+@pytest.mark.slow
+def test_ab_speculative_end_to_end_two_replicas(capsys):
+    """Acceptance: --ab serve_speculative runs end-to-end on CPU — two
+    real engine subprocesses (prompt-lookup drafting + K+1 verify step
+    vs plain decode), one serve_bench invocation.  The repeated-suffix
+    prompt makes bigram lookup land, so the ON arm reports a non-zero
+    accept rate; the OFF arm reports zero drafting."""
+    p_on, port_on = _spawn_replica(
+        "off", extra_args=("--serve_speculative", "1",
+                           "--serve_draft_k", "4"))
+    p_off, port_off = _spawn_replica("off")
+    try:
+        rc = serve_bench.main([
+            "--url", f"http://127.0.0.1:{port_on}",
+            "--ab", "serve_speculative",
+            "--ab_url", f"http://127.0.0.1:{port_off}",
+            "--clients", "2", "--requests", "4", "--tokens", "12",
+            "--prompt", "5 6 7 8 5 6 7 8 5 6 7",
+            "--temperature", "0",        # greedy: the drafting mode
+            "--timeout", "180", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        rows = out["rows"]
+        assert [r["ab_arm"] for r in rows] == ["on", "off"]
+        on, off = rows
+        for r in rows:
+            assert r["errors"] == 0 and r["tokens_per_sec"] > 0
+        # greedy spec-on output matches spec-off token-for-token: the
+        # stub-free replicas share weights, so identical prompts yield
+        # identical throughput-bearing token counts
+        assert on["tokens_total"] == off["tokens_total"]
+        # the ON arm drafted and accepted on the repeated-suffix prompt
+        assert on["drafted_tokens"] > 0
+        assert on["accepted_tokens"] > 0
+        assert on["accept_rate"] > 0
+        assert on["accepted_tokens_per_sec"] > 0
+        # the OFF arm never drafts
+        assert off["drafted_tokens"] == 0
+        assert off["accept_rate"] is None
     finally:
         for p in (p_on, p_off):
             p.kill()

@@ -16,14 +16,17 @@ import serve_report  # noqa: E402
 
 
 def _record(i, cached=0, ttft=0.2, e2e=1.0, tpot=0.02,
-            finish="length", trace=True):
+            finish="length", trace=True, drafted=0, accepted=0):
     return {
-        "schema": 6, "kind": "serve", "event": "request_done",
+        "schema": 8, "kind": "serve", "event": "request_done",
         "time_unix": 1700000000 + i, "request": f"req-{i}",
         "trace_id": f"{i:016x}" if trace else None,
         "prompt_tokens": 16, "cached_prompt_tokens": cached,
         "prefill_computed_tokens": 16 - cached, "new_tokens": 8,
         "decode_tokens": 8, "finish_reason": finish,
+        "drafted_tokens": drafted, "accepted_tokens": accepted,
+        "accept_rate": (round(accepted / drafted, 4) if drafted
+                        else None),
         "ttft_secs": ttft, "latency_secs": e2e, "tpot_secs": tpot,
         "phases": {"queue_secs": 0.05, "admission_secs": 0.001,
                    "prefill_secs": 0.1, "decode_secs": tpot * 8,
@@ -92,6 +95,43 @@ def test_analyze_multi_log_per_replica(tmp_path):
     assert set(r["replicas"]) == {a, b}
     assert r["replicas"][a]["e2e_mean_secs"] == pytest.approx(0.5)
     assert r["replicas"][b]["e2e_mean_secs"] == pytest.approx(2.0)
+
+
+def test_speculative_summary_and_tpot_split(tmp_path):
+    """Schema-8 speculative attribution: fleet accept rate is total
+    accepted / total drafted, and the TPOT means are split by whether
+    the request drafted at all."""
+    recs = [_record(i, drafted=8, accepted=6, tpot=0.01)
+            for i in range(3)]
+    recs += [_record(10 + i, drafted=0, accepted=0, tpot=0.03)
+             for i in range(2)]
+    log = _write_log(str(tmp_path / "spec"), recs)
+    r = serve_report.analyze([log])
+    sp = r["speculative"]
+    assert sp["drafted_tokens"] == 24
+    assert sp["accepted_tokens"] == 18
+    assert sp["accept_rate"] == pytest.approx(0.75)
+    assert sp["requests_drafting"] == 3
+    assert sp["tpot_mean_secs_drafting"] == pytest.approx(0.01)
+    assert sp["tpot_mean_secs_plain"] == pytest.approx(0.03)
+    # rendered + --json forms both carry the section
+    out = serve_report.render(r)
+    assert "speculative decoding: accepted 18/24" in out
+    assert "75.0% accept rate" in out
+    cli = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "serve_report.py"),
+         log, "--json"],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert cli.returncode == 0, cli.stderr
+    assert json.loads(cli.stdout)["speculative"]["accept_rate"] == \
+        pytest.approx(0.75)
+    # a fleet that never drafted renders no speculative section and a
+    # null accept rate (never a divide-by-zero)
+    plain = _write_log(str(tmp_path / "plain"),
+                       [_record(i) for i in range(2)])
+    r2 = serve_report.analyze([plain])
+    assert r2["speculative"]["accept_rate"] is None
+    assert "speculative decoding" not in serve_report.render(r2)
 
 
 def test_slo_counts_unmeasured_dimension_as_met(tmp_path):

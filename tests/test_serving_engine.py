@@ -330,7 +330,7 @@ def test_request_done_schema_golden(engine, tmp_path):
     the schema history comment in telemetry.py)."""
     from megatron_llm_tpu import telemetry
 
-    assert telemetry.TELEMETRY_SCHEMA_VERSION == 7
+    assert telemetry.TELEMETRY_SCHEMA_VERSION == 8
     captured = []
     engine.request_done_hook = captured.append
     stream = telemetry.TelemetryStream(str(tmp_path))
@@ -351,7 +351,8 @@ def test_request_done_schema_golden(engine, tmp_path):
     assert frozenset(rec) == frozenset((
         "kind", "event", "request", "trace_id", "prompt_tokens",
         "cached_prompt_tokens", "prefill_computed_tokens", "new_tokens",
-        "decode_tokens", "finish_reason", "ttft_secs", "latency_secs",
+        "decode_tokens", "drafted_tokens", "accepted_tokens",
+        "accept_rate", "finish_reason", "ttft_secs", "latency_secs",
         "tpot_secs", "phases", "paged_kernel", "prefill_kernel",
         "queue_depth", "blocks_free", "blocks_in_use",
         "blocks_cached_reusable"))
@@ -364,7 +365,7 @@ def test_request_done_schema_golden(engine, tmp_path):
             (tmp_path / "telemetry.jsonl").read_text().splitlines()
             if "request_done" in ln][0]
     assert frozenset(line) == frozenset(rec) | {"schema", "time_unix"}
-    assert line["schema"] == 7
+    assert line["schema"] == 8
 
 
 def test_engine_int8_kv_cache_serves(model_and_params):
@@ -390,12 +391,130 @@ def test_engine_stats_shape(engine):
     for key in ("queue_depth", "mean_batch_occupancy", "decode_steps",
                 "prefill_chunks", "tokens_generated", "prefill_secs",
                 "decode_secs", "blocks_in_use", "finished", "warmed_up",
-                "paged_kernel", "prefill_kernel"):
+                "paged_kernel", "prefill_kernel", "speculative",
+                "draft_k", "drafted_tokens", "accepted_tokens"):
         assert key in s
     assert s["warmed_up"] is True
     # resolved attention paths, not the requested modes
     assert s["paged_kernel"] in ("pallas", "xla")
     assert s["prefill_kernel"] in ("pallas", "xla")
+    assert s["speculative"] is False and s["draft_k"] == 0
+
+
+# ---------------------------------------------------------------------------
+# in-engine speculative decoding (serving/drafter.py + the [S, K+1]
+# verify step; docs/guide/serving.md "Speculative decoding")
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spec_engine(model_and_params):
+    model, params = model_and_params
+    eng = InferenceEngine(model, params, EngineConfig(
+        num_slots=4, block_size=8, prefill_chunk=16, max_model_len=64,
+        max_queue_depth=32, default_deadline_secs=0.0,
+        speculative=True, draft_k=4))
+    eng.warmup()
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+# repetitive greedy prompts (prompt-lookup fires), a non-repeating
+# greedy prompt (usually no usable draft), and a sampled slot (drafts
+# K=0 by design) — all co-batched into the same verify steps
+SPEC_MIX = [
+    ([1, 2, 3, 4, 1, 2, 3], SamplingParams(max_new_tokens=16, **GREEDY)),
+    ([2, 3, 2, 3, 2, 3], SamplingParams(max_new_tokens=12, **GREEDY)),
+    ([5, 6, 7, 8, 9], SamplingParams(max_new_tokens=16, **GREEDY)),
+    ([5, 6, 7], SamplingParams(max_new_tokens=8, temperature=0.9,
+                               top_k=20, seed=7, eod_id=63)),
+]
+
+
+def _run_spec_mix(eng):
+    outs = [None] * len(SPEC_MIX)
+
+    def client(i):
+        prompt, sp = SPEC_MIX[i]
+        outs[i] = eng.submit(prompt, sp).result(timeout=180).out_tokens
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(SPEC_MIX))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return outs
+
+
+def test_engine_speculative_greedy_parity_cobatched(engine, spec_engine):
+    """Acceptance: engine greedy output with speculation on is token-
+    identical to spec-off for the same seeds/prompts at occupancy > 1,
+    co-batched with sampled + non-drafting slots — and the speculative
+    arm really drafted and accepted (the parity is not vacuous)."""
+    occ0, dec0 = spec_engine.occupancy_sum, spec_engine.decode_steps
+    drafted0 = spec_engine.drafted_tokens
+    accepted0 = spec_engine.accepted_tokens
+    want = _run_spec_mix(engine)
+    got = _run_spec_mix(spec_engine)
+    assert got == want
+    occ = ((spec_engine.occupancy_sum - occ0)
+           / max(spec_engine.decode_steps - dec0, 1))
+    assert occ > 1.0, f"no co-batching: mean occupancy {occ}"
+    assert spec_engine.drafted_tokens > drafted0
+    assert spec_engine.accepted_tokens > accepted0
+    assert spec_engine.accepted_tokens <= spec_engine.drafted_tokens
+    s = spec_engine.stats()
+    assert s["speculative"] is True and s["draft_k"] == 4
+
+
+def test_engine_speculative_zero_recompiles(spec_engine, tmp_path):
+    """The zero-recompile guard with speculation on: mixed drafting /
+    non-drafting / sampled traffic all rides the one [S, K+1] verify
+    program — per-slot draft tokens and valid counts are traced inputs,
+    so proposal churn never compiles — and the request_done records
+    carry the accept attribution."""
+    from megatron_llm_tpu import telemetry
+
+    tracer = tracing.SpanTracer()
+    det = tracing.RecompileDetector(tracer)
+    tracing.install_tracing(tracing.Tracing(tracer=tracer, recompile=det))
+    stream = telemetry.TelemetryStream(str(tmp_path))
+    telemetry.install_stream(stream)
+    try:
+        det.mark_steady()
+        reqs = []
+        for i in range(10):
+            if i % 3 == 2:      # sampled: drafts K=0 by design
+                sp = SamplingParams(max_new_tokens=3 + (i % 5),
+                                    temperature=0.8, top_k=5 + i,
+                                    seed=i, eod_id=63)
+            else:
+                sp = SamplingParams(max_new_tokens=3 + (i % 5), **GREEDY)
+            prompt = ([1 + i, 2, 1 + i, 2, 1 + i] if i % 2 == 0
+                      else list(range(1, 2 + (i % 7))))
+            reqs.append(spec_engine.submit(prompt, sp,
+                                           trace_id=f"{i:016x}"))
+        for r in reqs:
+            r.result(timeout=180)
+        assert det.recompiles == 0, \
+            f"{det.recompiles} recompiles after warmup: {list(det.events)}"
+    finally:
+        tracing.install_tracing(None)
+        telemetry.install_stream(None)
+        stream.close()
+    import json as _json
+    done = [_json.loads(ln) for ln in
+            (tmp_path / "telemetry.jsonl").read_text().splitlines()
+            if "request_done" in ln]
+    assert len(done) == 10
+    for r in done:
+        assert r["accepted_tokens"] <= r["drafted_tokens"]
+        assert (r["accept_rate"] is None) == (r["drafted_tokens"] == 0)
+    drafted = [r for r in done if r["drafted_tokens"] > 0]
+    assert drafted, "no request drafted — the guard run is vacuous"
+    for r in drafted:
+        assert 0.0 <= r["accept_rate"] <= 1.0
 
 
 def test_engine_paged_kernel_token_identity(model_and_params):

@@ -45,11 +45,22 @@ ROWS = {
     "l3-8b-64k-int8": dict(L=32, h=4096, heads=32, kv=8, ffn=14336,
                            vocab=128256, ctx=65536, wq=True, kvq=True,
                            hbm_gb=15.75),
+    # speculative decode step: the loop body is the [b, K+1] verify
+    # window (serving/engine.py with --serve_speculative), so the
+    # residency claim must hold for THAT shape too — K extra query
+    # positions and K extra logits rows on top of the int8 row
+    "l3-8b-64k-int8-spec4": dict(L=32, h=4096, heads=32, kv=8,
+                                 ffn=14336, vocab=128256, ctx=65536,
+                                 wq=True, kvq=True, spec_k=4,
+                                 hbm_gb=15.75),
     # CI-sized smoke (same code path, minutes -> seconds)
     "tiny-bf16": dict(L=2, h=256, heads=4, kv=2, ffn=704, vocab=512,
                       ctx=512, wq=False, kvq=False, hbm_gb=15.75),
     "tiny-int8": dict(L=2, h=256, heads=4, kv=2, ffn=704, vocab=512,
                       ctx=512, wq=True, kvq=True, hbm_gb=15.75),
+    "tiny-int8-spec4": dict(L=2, h=256, heads=4, kv=2, ffn=704,
+                            vocab=512, ctx=512, wq=True, kvq=True,
+                            spec_k=4, hbm_gb=15.75),
 }
 
 
@@ -98,14 +109,20 @@ def run_row(name: str) -> dict:
         lambda: init_kv_caches(cfg, b, spec["ctx"],
                                quantized=spec["kvq"]))
 
-    def decode_step(params, caches, tok):
-        # one decoded token at the LAST cache position: the steady-state
-        # loop body (cache fully resident, weights read once)
-        logits, caches = _forward_with_cache(
-            model, params, tok, caches, spec["ctx"] - 1)
-        return jnp.argmax(logits[:, -1], axis=-1), caches
+    # spec_k > 0 rows prove the speculative-decoding loop body instead:
+    # the engine's fixed-shape [b, K+1] verify window at the last cache
+    # positions (K draft tokens + the bonus row)
+    k1 = int(spec.get("spec_k", 0)) + 1
 
-    tok = jax.ShapeDtypeStruct((b, 1), jnp.int32)
+    def decode_step(params, caches, tok):
+        # one decoded token (or the K+1 verify window) at the LAST
+        # cache positions: the steady-state loop body (cache fully
+        # resident, weights read once)
+        logits, caches = _forward_with_cache(
+            model, params, tok, caches, spec["ctx"] - k1)
+        return jnp.argmax(logits, axis=-1), caches
+
+    tok = jax.ShapeDtypeStruct((b, k1), jnp.int32)
     print(f"[{name}] lowering ({n_params/1e9:.2f}B params, "
           f"ctx {spec['ctx']})...", file=sys.stderr, flush=True)
     lowered = jax.jit(decode_step, device=dev,
@@ -122,6 +139,7 @@ def run_row(name: str) -> dict:
     rec = {
         "row": name, "n_params": n_params, "ctx": spec["ctx"],
         "int8_weights": spec["wq"], "int8_kv": spec["kvq"],
+        "spec_k": spec.get("spec_k", 0),
         "arg_gb": round(arg / GB, 3), "temp_gb": round(tmp / GB, 3),
         "total_gb": round(total, 3), "hbm_gb": spec["hbm_gb"],
         "fits": total <= spec["hbm_gb"],
@@ -167,6 +185,7 @@ def main(argv):
                 rec = {"row": name, "ctx": ROWS[name]["ctx"],
                        "int8_weights": ROWS[name]["wq"],
                        "int8_kv": ROWS[name]["kvq"],
+                       "spec_k": ROWS[name].get("spec_k", 0),
                        "total_gb": float(m.group(1)),
                        "hbm_gb": ROWS[name]["hbm_gb"], "fits": False,
                        "compiler_verdict": "RESOURCE_EXHAUSTED",

@@ -115,38 +115,48 @@ def main():
                   kv_int8=True)
     # speculative prompt-lookup A/B on a repetitive prompt (the
     # favorable case: summarization/code-edit-like repetition) —
-    # exactness is covered by tests/test_speculative.py, this measures
-    # the accepted-draft speedup
+    # exactness is covered by tests/test_serving_engine.py's greedy
+    # parity test, this measures the accepted-draft speedup through the
+    # engine's fixed-shape K+1 verify step
     run_spec_trial(model, params, p["prompt"], p["gen"], p["vocab"])
 
 
-def run_spec_trial(model, params, prompt, gen, vocab):
-    from megatron_llm_tpu.text_generation.generation import generate_tokens
-    from megatron_llm_tpu.text_generation.speculative import (
-        speculative_greedy_generate)
+def run_spec_trial(model, params, prompt, gen, vocab, draft_k=4):
+    from megatron_llm_tpu.serving import EngineConfig, InferenceEngine
+    from megatron_llm_tpu.serving.request import SamplingParams
     rng = np.random.RandomState(1)
     pattern = rng.randint(1, vocab, max(prompt // 4, 2))
-    toks = jnp.asarray(np.tile(pattern, prompt // len(pattern) + 1)
-                       [None, :prompt])
-    lens = jnp.full((1,), prompt, jnp.int32)
-    key = jax.random.PRNGKey(0)
+    toks = [int(t) for t in np.tile(pattern, prompt // len(pattern) + 1)
+            [:prompt]]
+    sp = SamplingParams(max_new_tokens=2 * gen, temperature=0.0)
 
-    def timed(fn):
-        out = fn()
-        float(jnp.asarray(out[1]).sum())
-        t0 = time.perf_counter()
-        out = fn()
-        float(jnp.asarray(out[1]).sum())
-        return time.perf_counter() - t0
+    def timed(speculative):
+        eng = InferenceEngine(model, params, EngineConfig(
+            num_slots=1, block_size=16,
+            prefill_chunk=max(prompt, 16),
+            max_model_len=prompt + 2 * gen + draft_k,
+            default_deadline_secs=0.0,
+            speculative=speculative, draft_k=draft_k))
+        eng.warmup()
+        eng.start()
+        try:
+            eng.submit(toks, sp).result(timeout=600)  # warm run
+            t0 = time.perf_counter()
+            r = eng.submit(toks, sp).result(timeout=600)
+            dt = time.perf_counter() - t0
+            return dt, len(r.out_tokens), eng.stats()
+        finally:
+            eng.stop()
 
-    t_van = timed(lambda: generate_tokens(
-        model, params, toks, lens, key, max_new_tokens=2 * gen,
-        min_prompt_len=prompt, greedy=True))
-    t_spec = timed(lambda: speculative_greedy_generate(
-        model, params, toks, lens, max_new_tokens=2 * gen, draft_k=8))
+    t_van, n_van, _ = timed(False)
+    t_spec, n_spec, stats = timed(True)
+    drafted = stats.get("drafted_tokens") or 0
+    accepted = stats.get("accepted_tokens") or 0
+    rate = f"{accepted / drafted:.2f}" if drafted else "-"
     print(f"b=  1 prompt={prompt} gen={2*gen} (repetitive): "
-          f"greedy {2*gen/t_van:9.1f} tok/s | speculative "
-          f"{2*gen/t_spec:9.1f} tok/s ({t_van/t_spec:.2f}x)", flush=True)
+          f"greedy {n_van/t_van:9.1f} tok/s | speculative[K+1={draft_k+1}] "
+          f"{n_spec/t_spec:9.1f} tok/s ({t_van/t_spec:.2f}x, "
+          f"accept {rate})", flush=True)
 
 
 if __name__ == "__main__":

@@ -89,6 +89,8 @@ def main():
             prefix_cache=bool(args.serve_prefix_cache),
             paged_kernel=args.serve_paged_kernel,
             prefill_kernel=args.serve_prefill_kernel,
+            speculative=bool(args.serve_speculative),
+            draft_k=args.serve_draft_k,
             watchdog_secs=args.serve_watchdog_secs,
             preemption=bool(args.serve_preemption),
             fault_spec=args.serve_fault_inject,
@@ -100,6 +102,9 @@ def main():
               flush=True)
         print(f" * paged-attention prefill path: {engine.prefill_kernel}",
               flush=True)
+        spec = (f"on (draft_k={engine.draft_k})"
+                if engine.speculative else "off")
+        print(f" * speculative decoding: {spec}", flush=True)
         engine.warmup()
         from megatron_llm_tpu import tracing
         tr = tracing.get_tracing()

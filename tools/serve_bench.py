@@ -31,7 +31,10 @@ each tagged with ``ab_arm`` and the server's self-reported
 throughput delta falls out of a single invocation.  Prefill throughput
 (computed-prefill tokens/sec, from the engine's
 ``prefill_tokens_computed`` counter delta) is reported next to TTFT so
-a prefill A/B measures the thing it changes.
+a prefill A/B measures the thing it changes.  ``--ab
+serve_speculative`` works the same way: each arm additionally reports
+the engine's drafted/accepted token deltas, the accept rate, and
+accepted tokens/sec (the decode steps speculation saved).
 
 Examples::
 
@@ -73,6 +76,11 @@ JSON_SCHEMA_KEYS = (
     # resilience counters (engine/server /metrics deltas over the run)
     "engine_restarts", "slots_evicted_nonfinite", "preemptions",
     "drained",
+    # speculative decoding (engine counter deltas; accept_rate =
+    # accepted/drafted, accepted_tokens_per_sec = draft-attributed
+    # "free" tokens over the run wall clock)
+    "drafted_tokens", "accepted_tokens", "accept_rate",
+    "accepted_tokens_per_sec",
 )
 
 
@@ -217,7 +225,8 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
               timeout: float = 300.0, seed: int = 0,
               prefix_tokens: int = 0,
               shared_prefix_frac: float = 1.0,
-              rate_schedule: str = None) -> dict:
+              rate_schedule: str = None,
+              temperature: float = None) -> dict:
     """Drive the load and aggregate results (importable — the tier-1
     smoke test calls this directly against an in-process server).
 
@@ -268,6 +277,10 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                            shared_prefix_frac, seed)],
                        "tokens_to_generate": int(tokens),
                        "no_log": True}
+            if temperature is not None:
+                # 0.0 = greedy — the workload speculative decoding
+                # drafts on (sampled slots never draft)
+                payload["temperature"] = float(temperature)
             r = _one_request(base_url, payload, stream, timeout)
             if segment is not None:
                 r["segment"] = segment
@@ -346,6 +359,13 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         "slots_evicted_nonfinite": None,
         "preemptions": None,
         "drained": None,
+        # speculative decoding: drafted/accepted engine counter deltas,
+        # their ratio, and accepted tokens/sec — the number a
+        # --ab serve_speculative run actually changes
+        "drafted_tokens": None,
+        "accepted_tokens": None,
+        "accept_rate": None,
+        "accepted_tokens_per_sec": None,
     }
     if schedule:
         segs = []
@@ -400,7 +420,8 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                             "prefix_cache_evictions",
                             "engine_restarts",
                             "slots_evicted_nonfinite",
-                            "preemptions"):
+                            "preemptions",
+                            "drafted_tokens", "accepted_tokens"):
                     out[key] = delta(key)
                 sub, comp = (out["prefill_tokens_submitted"],
                              out["prefill_tokens_computed"])
@@ -408,6 +429,13 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                     out["prefill_computed_frac"] = round(comp / sub, 4)
                 if comp is not None and wall > 0:
                     out["prefill_tokens_per_sec"] = round(comp / wall, 3)
+                drafted, accepted = (out["drafted_tokens"],
+                                     out["accepted_tokens"])
+                if drafted and accepted is not None:
+                    out["accept_rate"] = round(accepted / drafted, 4)
+                if accepted is not None and wall > 0:
+                    out["accepted_tokens_per_sec"] = round(
+                        accepted / wall, 3)
     return out
 
 
@@ -461,6 +489,16 @@ def print_table(r: dict) -> None:
     if r.get("prefill_tokens_per_sec") is not None:
         rows += [("prefill throughput",
                   _fmt(r["prefill_tokens_per_sec"], " tok/s"))]
+    if r.get("drafted_tokens") is not None:
+        rows += [
+            ("spec accepted/drafted",
+             f"{_fmt(r['accepted_tokens'])}/{_fmt(r['drafted_tokens'])}"
+             + (f" ({_fmt(r['accept_rate'])})"
+                if r.get("accept_rate") is not None else "")),
+        ]
+        if r.get("accepted_tokens_per_sec") is not None:
+            rows += [("spec accepted throughput",
+                      _fmt(r["accepted_tokens_per_sec"], " tok/s"))]
     if r.get("prefill_tokens_submitted") is not None:
         rows += [
             ("prefill computed/submitted",
@@ -516,6 +554,10 @@ def main(argv=None):
                    help="use /api/stream (measures true TTFT)")
     p.add_argument("--timeout", type=float, default=300.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--temperature", type=float, default=None,
+                   help="per-request sampling temperature (0 = greedy, "
+                        "the mode speculative decoding drafts on); "
+                        "omitted from the payload by default")
     p.add_argument("--prefix_tokens", type=int, default=0,
                    help="repeated-prefix workload: shared prompt header "
                         "length in words (0 = off, all prompts identical "
@@ -541,7 +583,8 @@ def main(argv=None):
               stream=args.stream, timeout=args.timeout, seed=args.seed,
               prefix_tokens=args.prefix_tokens,
               shared_prefix_frac=args.shared_prefix_frac,
-              rate_schedule=args.rate_schedule)
+              rate_schedule=args.rate_schedule,
+              temperature=args.temperature)
     if args.ab:
         if not args.ab_url:
             p.error("--ab needs --ab_url (the second arm's server)")
@@ -560,6 +603,13 @@ def main(argv=None):
                       f"{on['tokens_per_sec']:.3f} / "
                       f"{off['tokens_per_sec']:.3f} tok/s "
                       f"({on['tokens_per_sec'] / off['tokens_per_sec']:.2f}x)")
+            if on.get("accept_rate") is not None or \
+                    off.get("accept_rate") is not None:
+                print(f"A/B spec accept rate on/off: "
+                      f"{_fmt(on.get('accept_rate'))} / "
+                      f"{_fmt(off.get('accept_rate'))} "
+                      f"(accepted {_fmt(on.get('accepted_tokens'))} / "
+                      f"{_fmt(off.get('accepted_tokens'))} tok)")
             if on.get("prefill_tokens_per_sec") and \
                     off.get("prefill_tokens_per_sec"):
                 print(f"A/B prefill throughput on/off: "

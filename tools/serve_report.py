@@ -18,6 +18,9 @@ trace_id, per-request phase attribution, tpot_secs) and prints:
 * prefill throughput — computed-prefill tokens per second of prefill
   compute, attributed to the attention path (``prefill_kernel``) that
   served them, next to the TTFT numbers it drives
+* speculative-decoding summary — fleet accept rate (accepted vs
+  drafted tokens, schema >= 8) and mean TPOT for drafting vs plain
+  requests: what the PR 14 prompt-lookup speculation bought end-to-end
 * cache-hit stratification — the same latency table split by whether
   the request adopted prefix-cache pages (``cached_prompt_tokens > 0``),
   quantifying what the PR 6 prefix cache is worth end-to-end
@@ -192,6 +195,31 @@ def prefill_summary(records: List[Dict]) -> Dict:
     }
 
 
+def speculative_summary(records: List[Dict]) -> Dict:
+    """Speculative-decoding effectiveness (telemetry schema >= 8):
+    fleet accept rate (total accepted / total drafted), the
+    accepted-vs-drafted token totals, how many requests actually
+    drafted, and the mean TPOT split by whether the request drafted —
+    the offline answer to "what did speculation buy us"."""
+    drafted = sum(r.get("drafted_tokens") or 0 for r in records)
+    accepted = sum(r.get("accepted_tokens") or 0 for r in records)
+    spec = [r for r in records if (r.get("drafted_tokens") or 0) > 0]
+    plain = [r for r in records if (r.get("drafted_tokens") or 0) == 0]
+
+    def mean_tpot(rs):
+        vals = _vals(rs, "tpot_secs")
+        return sum(vals) / len(vals) if vals else None
+
+    return {
+        "drafted_tokens": drafted,
+        "accepted_tokens": accepted,
+        "accept_rate": (accepted / drafted) if drafted > 0 else None,
+        "requests_drafting": len(spec),
+        "tpot_mean_secs_drafting": mean_tpot(spec),
+        "tpot_mean_secs_plain": mean_tpot(plain),
+    }
+
+
 def cache_stratified(records: List[Dict]) -> Dict:
     hits = [r for r in records
             if (r.get("cached_prompt_tokens") or 0) > 0]
@@ -224,6 +252,7 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
         "phases": phase_breakdown(all_records),
         "slo": slo_attainment(all_records, ttft_slo, tpot_slo),
         "prefill": prefill_summary(all_records),
+        "speculative": speculative_summary(all_records),
         "by_cache": cache_stratified(all_records),
         "finish_reasons": {},
         "traced": sum(1 for r in all_records if r.get("trace_id")),
@@ -333,6 +362,19 @@ def render(report: Dict) -> str:
                      f"in {_fmt(pf['compute_secs'])} -> "
                      + (f"{tps:.1f} tok/s" if tps else "-")
                      + f" (kernel: {kern})")
+
+    sp = report.get("speculative") or {}
+    if sp.get("drafted_tokens"):
+        rate = sp.get("accept_rate")
+        lines.append(
+            f"\nspeculative decoding: accepted {sp['accepted_tokens']}/"
+            f"{sp['drafted_tokens']} drafted tokens"
+            + (f" ({rate * 100:.1f}% accept rate)" if rate is not None
+               else "")
+            + f" over {sp['requests_drafting']} drafting request(s)")
+        lines.append(
+            f"  tpot mean  drafting {_fmt(sp['tpot_mean_secs_drafting']):>9}"
+            f"  plain {_fmt(sp['tpot_mean_secs_plain']):>9}")
 
     slo = report["slo"]
     lines.append(f"\nSLO attainment (ttft <= {slo['ttft_slo_secs']}s, "

@@ -108,6 +108,15 @@ MANIFEST: List[Step] = [
          "python -m pytest tests/test_serve_bench_tool.py "
          "-k ab_prefill -q -p no:cacheprovider",
          900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+    # speculative-decoding A/B smoke: serve_bench --ab serve_speculative
+    # against two real replica processes (prompt-lookup drafting + K+1
+    # verify step vs plain decode) on a repeated-suffix workload —
+    # asserts a non-zero accept rate and spec-on == spec-off throughput
+    # accounting end to end on CPU
+    Step("serve_spec_ab",
+         "python -m pytest tests/test_serve_bench_tool.py "
+         "-k ab_speculative -q -p no:cacheprovider",
+         900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
     # fleet supervisor chaos: spike schedule breaches the TTFT SLO, the
     # supervisor scales up and p95 recovers; a mid-run SIGKILL is
     # respawned — zero dropped requests, zero engine restarts
