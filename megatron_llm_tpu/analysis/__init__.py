@@ -28,6 +28,10 @@ telemetry   request_done writer keys == golden test frozenset ==
 stdlib      tools documented as stdlib-only import only the stdlib
 locks       no blocking calls while a serving lock is held; writes
             to ``_lock_protected_`` fields hold the declared lock
+threads     thread-topology races & deadlocks: unlocked cross-
+            thread writes (TH001), lock-order cycles (TH002),
+            blocking under a contested lock (TH003),
+            use-after-drain in daemon loops (TH004)
 markers     every ``pytest.mark.<m>`` under tests/ is registered
 ==========  =====================================================
 
@@ -50,6 +54,7 @@ from megatron_llm_tpu.analysis import (  # noqa: F401
     recompile,
     stdlib_gate,
     telemetry_schema,
+    threads,
 )
 
 #: checker name -> callable(Repo, Baseline) -> list[Violation].
@@ -60,6 +65,7 @@ CHECKERS = {
     "telemetry": telemetry_schema.check,
     "stdlib": stdlib_gate.check,
     "locks": locks.check,
+    "threads": threads.check,
     "markers": markers.check,
 }
 

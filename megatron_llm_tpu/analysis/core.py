@@ -255,3 +255,183 @@ def walk_functions(tree: ast.AST):
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             yield node
+
+
+# -- cross-module call-graph machinery ----------------------------------
+#
+# Shared by the ``recompile`` checker (jit-root reachability) and the
+# ``threads`` checker (thread-root reachability).  Kept here so both
+# walk the same resolution rules: nested defs, ``self._method``, module
+# functions, package imports, lambdas.
+
+
+class Scope:
+    """Lexical scope of a def: enclosing class (if method) and the
+    chain of enclosing function nodes (for nested-def resolution)."""
+
+    def __init__(self, cls: Optional[str], chain: Tuple[ast.AST, ...]):
+        self.cls = cls
+        self.chain = chain
+
+
+class ModuleIndex:
+    """One parsed module: top-level functions, class methods (top-level
+    AND nested classes), imports, and a scope map for every def."""
+
+    def __init__(self, path: str, tree: ast.AST):
+        self.path = path
+        self.tree = tree
+        self.functions: Dict[str, ast.AST] = {}           # top-level defs
+        self.methods: Dict[str, Dict[str, ast.AST]] = {}  # class -> defs
+        self.classes: Dict[str, ast.ClassDef] = {}        # incl. nested
+        self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        self.scopes: Dict[int, Scope] = {}                # id(def) -> scope
+        self._index()
+
+    def _index(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+        # imports anywhere (tools import heavy deps inside main())
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._record_import(node)
+        # every class (however nested) and its direct methods
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                meths = {}
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        meths[sub.name] = sub
+                self.methods.setdefault(node.name, {}).update(meths)
+                self.classes.setdefault(node.name, node)
+        # scope map for every def (and lambda), however nested
+        def visit(node, cls, chain):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    self.scopes[id(child)] = Scope(cls, chain)
+                    visit(child, cls, chain + (child,))
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child.name, chain)
+                else:
+                    visit(child, cls, chain)
+        visit(self.tree, None, ())
+
+    def _record_import(self, node) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                self.imports[a.asname or a.name.split(".")[0]] = \
+                    (a.name, None)
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                self.imports[a.asname or a.name] = (node.module, a.name)
+
+
+class PackageIndex:
+    """All modules of the given subtrees, keyed both by path and dotted
+    module name."""
+
+    def __init__(self, repo: "Repo", *subdirs: str):
+        self.by_mod: Dict[str, ModuleIndex] = {}
+        self.by_path: Dict[str, ModuleIndex] = {}
+        for sub in subdirs:
+            for rel in repo.py_files(sub):
+                tree = repo.tree(rel)
+                if tree is None:
+                    continue
+                mod = ModuleIndex(rel, tree)
+                self.by_path[rel] = mod
+                dotted = rel[:-3].replace("/", ".")
+                if dotted.endswith(".__init__"):
+                    dotted = dotted[: -len(".__init__")]
+                self.by_mod[dotted] = mod
+
+    def resolve_import(self, mod: ModuleIndex, local: str
+                       ) -> Optional[Tuple[ModuleIndex, Optional[str]]]:
+        tgt = mod.imports.get(local)
+        if tgt is None:
+            return None
+        modname, attr = tgt
+        other = self.by_mod.get(modname)
+        if other is None:
+            return None
+        return other, attr
+
+    def resolve_class(self, mod: ModuleIndex, name: str
+                      ) -> Optional[Tuple[ModuleIndex, ast.ClassDef]]:
+        """(module, ClassDef) a bare name denotes in ``mod``: defined
+        there, or imported — chasing re-export chains (a class imported
+        from a package ``__init__`` that itself imports it)."""
+        seen = set()
+        while (mod.path, name) not in seen:
+            seen.add((mod.path, name))
+            if name in mod.classes:
+                return mod, mod.classes[name]
+            hit = self.resolve_import(mod, name)
+            if hit is None:
+                return None
+            mod, attr = hit
+            name = attr or name
+        return None
+
+
+def resolve_callable(index: PackageIndex, mod: ModuleIndex, scope: Scope,
+                     expr: ast.AST) -> List[Tuple[ModuleIndex, ast.AST]]:
+    """Function-def nodes an expression may denote: nested defs in the
+    enclosing scope, ``self._method``, module functions, or functions
+    imported from package modules.  Lambdas resolve to themselves."""
+    if isinstance(expr, ast.Lambda):
+        return [(mod, expr)]
+    d = dotted_name(expr)
+    if d is None:
+        return []
+    parts = d.split(".")
+    if parts[0] == "self" and len(parts) == 2 and scope.cls:
+        meth = mod.methods.get(scope.cls, {}).get(parts[1])
+        return [(mod, meth)] if meth is not None else []
+    if len(parts) == 1:
+        name = parts[0]
+        for encl in reversed(scope.chain):
+            for child in ast.walk(encl):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) \
+                        and child.name == name and child is not encl:
+                    return [(mod, child)]
+        if name in mod.functions:
+            return [(mod, mod.functions[name])]
+        hit = index.resolve_import(mod, name)
+        if hit:
+            other, attr = hit
+            if attr and attr in other.functions:
+                return [(other, other.functions[attr])]
+        return []
+    if len(parts) == 2:
+        hit = index.resolve_import(mod, parts[0])
+        if hit:
+            other, attr = hit
+            if attr is None and parts[1] in other.functions:
+                return [(other, other.functions[parts[1]])]
+    return []
+
+
+def enclosing_scope(mod: ModuleIndex, node: ast.AST) -> Scope:
+    """Scope for resolving names at an arbitrary node: the innermost
+    def containing it (by position), with its class context."""
+    best: Optional[ast.AST] = None
+    best_scope = Scope(None, ())
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return best_scope
+    for n in ast.walk(mod.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(n, "end_lineno", n.lineno)
+            if n.lineno <= line <= end:
+                if best is None or n.lineno >= best.lineno:
+                    best = n
+    if best is None:
+        return best_scope
+    outer = mod.scopes.get(id(best), Scope(None, ()))
+    return Scope(outer.cls, outer.chain + (best,))

@@ -38,6 +38,10 @@ CHECKER = "locks"
 
 SERVING_DIR = "megatron_llm_tpu/serving"
 
+#: single files outside SERVING_DIR that are part of the serving stack
+#: and carry ``_lock_protected_`` annotations (the HTTP front-end)
+EXTRA_FILES = ("megatron_llm_tpu/text_generation_server.py",)
+
 ANNOTATION = "_lock_protected_"
 DEFAULT_LOCK = "_lock"
 
@@ -203,7 +207,11 @@ class _FunctionScanner:
 
 def check(repo: Repo, baseline=None) -> List[Violation]:
     out: List[Violation] = []
-    for rel in repo.py_files(SERVING_DIR):
+    targets = list(repo.py_files(SERVING_DIR))
+    targets += [rel for rel in EXTRA_FILES
+                if repo.tree(rel) is not None
+                and rel not in targets]
+    for rel in targets:
         tree = repo.tree(rel)
         if tree is None:
             continue

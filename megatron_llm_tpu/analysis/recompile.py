@@ -41,6 +41,8 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from megatron_llm_tpu.analysis.core import (
     Repo, Violation, dotted_name,
+    Scope as _Scope, ModuleIndex as _Module, PackageIndex,
+    resolve_callable, enclosing_scope as _enclosing_scope,
 )
 
 CHECKER = "recompile"
@@ -95,128 +97,10 @@ def _root_kind(func_expr: ast.AST) -> Optional[str]:
     return None
 
 
-class _Scope:
-    """Lexical scope of a def: enclosing class (if method) and the
-    chain of enclosing function nodes (for nested-def resolution)."""
-
-    def __init__(self, cls: Optional[str], chain: Tuple[ast.AST, ...]):
-        self.cls = cls
-        self.chain = chain
-
-
-class _Module:
-    def __init__(self, path: str, tree: ast.AST):
-        self.path = path
-        self.tree = tree
-        self.functions: Dict[str, ast.AST] = {}           # top-level defs
-        self.methods: Dict[str, Dict[str, ast.AST]] = {}  # class -> defs
-        self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
-        self.scopes: Dict[int, _Scope] = {}               # id(def) -> scope
-        self._index()
-
-    def _index(self) -> None:
-        for node in self.tree.body:
-            if isinstance(node, (ast.Import, ast.ImportFrom)):
-                self._record_import(node)
-            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.functions[node.name] = node
-            elif isinstance(node, ast.ClassDef):
-                meths = {}
-                for sub in node.body:
-                    if isinstance(sub, (ast.FunctionDef,
-                                        ast.AsyncFunctionDef)):
-                        meths[sub.name] = sub
-                self.methods[node.name] = meths
-        # scope map for every def, however nested
-        def visit(node, cls, chain):
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef)):
-                    self.scopes[id(child)] = _Scope(cls, chain)
-                    visit(child, cls, chain + (child,))
-                elif isinstance(child, ast.ClassDef):
-                    visit(child, child.name, chain)
-                else:
-                    visit(child, cls, chain)
-        visit(self.tree, None, ())
-
-    def _record_import(self, node) -> None:
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                self.imports[a.asname or a.name.split(".")[0]] = \
-                    (a.name, None)
-        elif isinstance(node, ast.ImportFrom) and node.module \
-                and node.level == 0:
-            for a in node.names:
-                self.imports[a.asname or a.name] = (node.module, a.name)
-
-
-class _Index:
-    """All package modules, keyed both by path and dotted module name."""
-
-    def __init__(self, repo: Repo, package: str):
-        self.by_mod: Dict[str, _Module] = {}
-        self.by_path: Dict[str, _Module] = {}
-        for rel in repo.py_files(package):
-            tree = repo.tree(rel)
-            if tree is None:
-                continue
-            mod = _Module(rel, tree)
-            self.by_path[rel] = mod
-            dotted = rel[:-3].replace("/", ".")
-            if dotted.endswith(".__init__"):
-                dotted = dotted[: -len(".__init__")]
-            self.by_mod[dotted] = mod
-
-    def resolve_import(self, mod: _Module, local: str
-                       ) -> Optional[Tuple[_Module, Optional[str]]]:
-        tgt = mod.imports.get(local)
-        if tgt is None:
-            return None
-        modname, attr = tgt
-        other = self.by_mod.get(modname)
-        if other is None:
-            return None
-        return other, attr
-
-
-def _resolve_callable(index: _Index, mod: _Module, scope: _Scope,
-                      expr: ast.AST) -> List[Tuple[_Module, ast.AST]]:
-    """Function-def nodes an expression may denote: nested defs in the
-    enclosing scope, ``self._method``, module functions, or functions
-    imported from package modules.  Lambdas resolve to themselves."""
-    if isinstance(expr, ast.Lambda):
-        return [(mod, expr)]
-    d = dotted_name(expr)
-    if d is None:
-        return []
-    parts = d.split(".")
-    if parts[0] == "self" and len(parts) == 2 and scope.cls:
-        meth = mod.methods.get(scope.cls, {}).get(parts[1])
-        return [(mod, meth)] if meth is not None else []
-    if len(parts) == 1:
-        name = parts[0]
-        for encl in reversed(scope.chain):
-            for child in ast.walk(encl):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef)) \
-                        and child.name == name and child is not encl:
-                    return [(mod, child)]
-        if name in mod.functions:
-            return [(mod, mod.functions[name])]
-        hit = index.resolve_import(mod, name)
-        if hit:
-            other, attr = hit
-            if attr and attr in other.functions:
-                return [(other, other.functions[attr])]
-        return []
-    if len(parts) == 2:
-        hit = index.resolve_import(mod, parts[0])
-        if hit:
-            other, attr = hit
-            if attr is None and parts[1] in other.functions:
-                return [(other, other.functions[parts[1]])]
-    return []
+#: call-graph machinery lives in core.py (shared with the ``threads``
+#: checker); kept under the old local names for this module's walkers.
+_Index = PackageIndex
+_resolve_callable = resolve_callable
 
 
 def _find_roots(index: _Index) -> List[Tuple[_Module, ast.AST]]:
@@ -252,26 +136,6 @@ def _find_roots(index: _Index) -> List[Tuple[_Module, ast.AST]]:
                         roots.extend(_resolve_callable(
                             index, mod, scope, first.args[0]))
     return roots
-
-
-def _enclosing_scope(mod: _Module, node: ast.AST) -> _Scope:
-    """Scope for resolving names at an arbitrary node: the innermost
-    def containing it (by position), with its class context."""
-    best: Optional[ast.AST] = None
-    best_scope = _Scope(None, ())
-    line = getattr(node, "lineno", None)
-    if line is None:
-        return best_scope
-    for n in ast.walk(mod.tree):
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            end = getattr(n, "end_lineno", n.lineno)
-            if n.lineno <= line <= end:
-                if best is None or n.lineno >= best.lineno:
-                    best = n
-    if best is None:
-        return best_scope
-    outer = mod.scopes.get(id(best), _Scope(None, ()))
-    return _Scope(outer.cls, outer.chain + (best,))
 
 
 def _static_params(fn: ast.AST) -> Set[str]:

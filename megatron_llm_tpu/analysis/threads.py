@@ -1,0 +1,1260 @@
+"""Checker ``threads``: thread-topology race & deadlock detection.
+
+The serving stack is a real concurrent system — engine loop, watchdog
+daemon, router health prober, fleet supervisor, drain waiters, HTTP
+handler threads — and ``locks`` (LD001/LD002) only verifies fields
+someone remembered to annotate.  This checker goes the other way: it
+*discovers* the thread topology, computes which functions run on which
+threads, and infers shared state from actual cross-thread access.
+
+Topology: every ``threading.Thread(target=...)`` / ``threading.Timer``
+spawn (including lambdas and bound methods), every ``do_*`` method of a
+stdlib HTTP handler class, every ``signal.signal`` callback, and every
+``main()`` entry point becomes a *thread root*; a BFS over the shared
+call graph (``core.PackageIndex``) — through constructor-typed
+receivers and registered callbacks (``engine.request_done_hook = ...``,
+ctor kwargs like ``on_fire=lambda: self.restart(...)``) — assigns each
+reachable function the set of roots it may run on.
+
+On top of the topology:
+
+* ``TH001`` — attribute written from ≥2 thread roots (or container-
+  mutated from one root while another root touches it) with no common
+  lock held across all write sites.  The message carries the
+  ``_lock_protected_`` declaration to paste, turning LD002 from opt-in
+  to enforced.  Reads are advisory; ``__init__`` bodies and ``*_locked``
+  methods are exempt; attributes holding ``threading.Event`` / ``queue.
+  Queue`` / other sync primitives are thread-safe by contract and
+  skipped.  A single-writer scalar rebind with foreign readers (the
+  "publish a display counter" idiom) is deliberately NOT flagged.
+* ``TH002`` — lock-order inversion: edges of the acquires-while-holding
+  graph come from lexically nested ``with <lock>:`` blocks and from
+  calls made under a lock into functions whose (transitive) lock set is
+  known; any cycle — including a non-reentrant self-cycle — is flagged.
+* ``TH003`` — blocking call (``join``, ``Condition.wait`` /
+  ``queue.get`` without timeout, subprocess/socket/HTTP I/O) made while
+  holding a lock that a *different* thread root also acquires: the
+  classic drain/watchdog deadlock shape.
+* ``TH004`` — use-after-drain: a daemon-thread loop that tests a
+  stop/drain flag, blocks, then mutates shared state without re-reading
+  the flag or taking a lock — the shutdown race where a drained object
+  is written one more time.
+
+Model limits (documented, on purpose): lock identity is
+``<Class>.<attr>`` (instances of one class conflate — per-instance
+confinement needs a baseline suppression saying why it is safe), and
+locksets are *lexical* per function — a lock held by the caller is
+invisible here, so "callers hold the lock" contracts are suppressed
+with that rationale rather than silently trusted.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from megatron_llm_tpu.analysis.core import (
+    ModuleIndex, PackageIndex, Repo, Scope, Violation,
+    dotted_name, enclosing_scope, resolve_callable,
+)
+from megatron_llm_tpu.analysis.locks import (
+    ANNOTATION, _BLOCKING_NAMES, _BLOCKING_PREFIXES, _MUTATORS,
+    _is_lock_attr, _protected_fields,
+)
+
+CHECKER = "threads"
+
+#: subtrees whose modules participate in the topology
+SCAN_DIRS = ("megatron_llm_tpu", "tools")
+
+#: thread-safe-by-contract constructors: attributes holding these are
+#: never shared-state findings (the primitive IS the synchronization)
+_SYNC_CTORS = frozenset((
+    "Event", "Condition", "Semaphore", "BoundedSemaphore", "Barrier",
+    "Lock", "RLock", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "local",
+))
+_SYNC_MODULES = frozenset(("threading", "queue"))
+
+#: HTTP handler base classes whose do_* methods are thread entry points
+_HTTP_BASES = ("BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+               "CGIHTTPRequestHandler", "StreamRequestHandler",
+               "BaseRequestHandler")
+
+#: container annotation heads whose element type we track
+_ELEM_HEADS = frozenset(("List", "list", "Sequence", "Iterable",
+                         "Iterator", "Set", "set", "FrozenSet", "Deque",
+                         "deque", "Tuple", "tuple"))
+_DICT_HEADS = frozenset(("Dict", "dict", "Mapping", "MutableMapping",
+                         "DefaultDict", "OrderedDict"))
+
+#: stop/drain flag spellings for TH004
+_STOP_FLAG_RE = re.compile(
+    r"(stop|running|drain|shutdown|closed|quit|alive|exit)", re.I)
+
+ClsRef = Tuple[str, str]          # (module path, class name)
+_TYPE = Tuple[ClsRef, bool]       # (class, is-element-of-container)
+
+
+class ThreadRoot:
+    def __init__(self, name: str, kind: str, path: str, line: int,
+                 entry: str, daemon: bool):
+        self.name = name
+        self.kind = kind          # thread | timer | http | signal | main
+        self.path = path
+        self.line = line
+        self.entry = entry        # label of the entry function
+        self.daemon = daemon
+
+    @property
+    def concurrent(self) -> bool:
+        """Does this root race with the others?  The ``main`` root
+        models setup/teardown code, which is ordered against every
+        spawned thread by the ``Thread.start()``/``join()``
+        happens-before edges — so it never *counts* as a racing writer
+        (it still contributes reachability, lock acquisition, and
+        TH003 contention).  Signal handlers interrupt the main thread
+        asynchronously and DO count (root ``signal``)."""
+        return self.kind != "main"
+
+
+class Access:
+    __slots__ = ("owner", "field", "kind", "locks", "path", "line",
+                 "label", "exempt", "fn_id")
+
+    def __init__(self, owner: ClsRef, field: str, kind: str,
+                 locks: FrozenSet[str], path: str, line: int,
+                 label: str, exempt: bool, fn_id: int):
+        self.owner = owner
+        self.field = field
+        self.kind = kind          # write | cmut | read
+        self.locks = locks
+        self.path = path
+        self.line = line
+        self.label = label
+        self.exempt = exempt
+        self.fn_id = fn_id
+
+
+def _fn_label(fn: ast.AST) -> str:
+    return getattr(fn, "name", "<lambda>")
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+
+
+class Topology:
+    """Thread roots, per-function root sets, and the access/lock facts
+    the TH checkers evaluate.  Built once per check() run; also the
+    engine behind ``--threads`` and ``--suggest-locks``."""
+
+    def __init__(self, repo: Repo):
+        self.repo = repo
+        self.index = PackageIndex(repo, *[d for d in SCAN_DIRS
+                                          if repo.exists(d)])
+        # class registry: (path, name) -> (ModuleIndex, ClassDef)
+        self.classes: Dict[ClsRef, Tuple[ModuleIndex, ast.ClassDef]] = {}
+        for mod in self.index.by_path.values():
+            for cname, cnode in mod.classes.items():
+                self.classes.setdefault((mod.path, cname), (mod, cnode))
+        # inferred types
+        self.attr_types: Dict[ClsRef, Dict[str, _TYPE]] = {}
+        self.sync_attrs: Dict[ClsRef, Set[str]] = {}
+        self.rlock_classes: Set[str] = set()   # classes using RLock
+        self.param_types: Dict[Tuple[ClsRef, str, str], _TYPE] = {}
+        self.ret_types: Dict[Tuple[ClsRef, str], _TYPE] = {}
+        self.fn_ret: Dict[Tuple[str, str], _TYPE] = {}  # module fns
+        # callback registry: (owner class, attr/param name) -> fn nodes
+        self.callbacks: Dict[Tuple[ClsRef, str],
+                             List[Tuple[ModuleIndex, ast.AST]]] = {}
+        self.roots: List[ThreadRoot] = []
+        self.entries: List[Tuple[str, ModuleIndex, ast.AST]] = []
+        self.reach: Dict[int, Set[str]] = {}
+        self.fn_site: Dict[int, Tuple[ModuleIndex, ast.AST]] = {}
+        self.accesses: List[Access] = []
+        self.lock_edges: List[Tuple[str, str, str, int, str]] = []
+        self.fn_acquires: Dict[int, Set[str]] = {}
+        self.calls_under_lock: List[Tuple[FrozenSet[str], int,
+                                          str, int, str]] = []
+        self.blocking: List[Tuple[int, FrozenSet[str], str, str, int,
+                                  str]] = []
+        self._build()
+
+    # -- type inference -------------------------------------------------
+
+    def _resolve_class_name(self, mod: ModuleIndex, name: str
+                            ) -> Optional[ClsRef]:
+        hit = self.index.resolve_class(mod, name)
+        if hit is None:
+            return None
+        return (hit[0].path, hit[1].name)
+
+    def _ann_type(self, mod: ModuleIndex, ann: Optional[ast.AST],
+                  elem: bool = False) -> Optional[_TYPE]:
+        """Class a type annotation denotes (unwrapping Optional and
+        tracking container element types)."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.Name):
+            ref = self._resolve_class_name(mod, ann.id)
+            return (ref, elem) if ref else None
+        if isinstance(ann, ast.Attribute):
+            d = dotted_name(ann)
+            if d and d.count(".") == 1:
+                head, cls = d.split(".")
+                hit = self.index.resolve_import(mod, head)
+                if hit and hit[1] is None and cls in hit[0].classes:
+                    return ((hit[0].path, cls), elem)
+            return None
+        if isinstance(ann, ast.Subscript):
+            head = ann.value.id if isinstance(ann.value, ast.Name) \
+                else (ann.value.attr if isinstance(ann.value,
+                                                   ast.Attribute) else "")
+            sl = ann.slice
+            if head == "Optional":
+                return self._ann_type(mod, sl, elem)
+            if head in _ELEM_HEADS:
+                inner = sl.elts[0] if isinstance(sl, ast.Tuple) \
+                    and sl.elts else sl
+                return self._ann_type(mod, inner, True)
+            if head in _DICT_HEADS:
+                if isinstance(sl, ast.Tuple) and len(sl.elts) == 2:
+                    return self._ann_type(mod, sl.elts[1], True)
+        return None
+
+    def _is_sync_ctor(self, mod: ModuleIndex, call: ast.Call) -> bool:
+        d = dotted_name(call.func)
+        if d is None:
+            return False
+        parts = d.split(".")
+        if len(parts) == 2 and parts[0] in _SYNC_MODULES \
+                and parts[1] in _SYNC_CTORS:
+            return True
+        if len(parts) == 1 and parts[0] in _SYNC_CTORS:
+            imp = mod.imports.get(parts[0])
+            return bool(imp and imp[0] in _SYNC_MODULES)
+        return False
+
+    def _ctor_class(self, mod: ModuleIndex, call: ast.Call
+                    ) -> Optional[ClsRef]:
+        """Class a Call constructs, unwrapping builder chains like
+        ``EngineWatchdog(...).start()``."""
+        func = call.func
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Call):
+            inner = self._ctor_class(mod, func.value)
+            if inner is not None:
+                return inner
+        d = dotted_name(func)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if len(parts) == 1:
+            return self._resolve_class_name(mod, parts[0])
+        if len(parts) == 2:
+            hit = self.index.resolve_import(mod, parts[0])
+            if hit and hit[1] is None and parts[1] in hit[0].classes:
+                return (hit[0].path, parts[1])
+        return None
+
+    def _self_cls(self, mod: ModuleIndex, scope: Scope
+                  ) -> Optional[ClsRef]:
+        if scope.cls is None:
+            return None
+        if (mod.path, scope.cls) in self.classes:
+            return (mod.path, scope.cls)
+        return None
+
+    def _fn_env(self, mod: ModuleIndex, fn: ast.AST, scope: Scope
+                ) -> Dict[str, _TYPE]:
+        """Flow-insensitive local type environment: annotated/inferred
+        params, ctor assignments, typed for-loop targets.  Closure
+        variables inherit from the enclosing defs' environments."""
+        env: Dict[str, _TYPE] = {}
+        for encl in scope.chain:
+            outer_scope = mod.scopes.get(id(encl), Scope(None, ()))
+            env.update(self._fn_env_local(mod, encl, outer_scope, {}))
+        env.update(self._fn_env_local(mod, fn, scope, env))
+        return env
+
+    def _fn_env_local(self, mod, fn, scope, base) -> Dict[str, _TYPE]:
+        env: Dict[str, _TYPE] = dict(base)
+        selfc = self._self_cls(mod, scope)
+        if not isinstance(fn, ast.Lambda):
+            for p in (list(fn.args.posonlyargs) + list(fn.args.args) +
+                      list(fn.args.kwonlyargs)):
+                t = self._ann_type(mod, p.annotation)
+                if t is None and selfc is not None:
+                    t = self.param_types.get((selfc, fn.name, p.arg))
+                if t is not None:
+                    env.setdefault(p.arg, t)
+        ctx = (mod, selfc, env)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for top in body:
+            for node in ast.walk(top):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)) and node is not fn:
+                    continue
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    tgts = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    val = node.value
+                    t = None
+                    if isinstance(node, ast.AnnAssign):
+                        t = self._ann_type(mod, node.annotation)
+                    if t is None and val is not None:
+                        t = self._expr_type(ctx, val)
+                    if t is not None:
+                        for tg in tgts:
+                            if isinstance(tg, ast.Name):
+                                env.setdefault(tg.id, t)
+                elif isinstance(node, ast.For) \
+                        and isinstance(node.target, ast.Name):
+                    t = self._expr_type(ctx, node.iter)
+                    if t is not None and t[1]:
+                        env.setdefault(node.target.id, (t[0], False))
+        return env
+
+    def _expr_type(self, ctx, expr) -> Optional[_TYPE]:
+        mod, selfc, env = ctx
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and selfc is not None:
+                return (selfc, False)
+            return env.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self._expr_type(ctx, expr.value)
+            if base is None or base[1]:
+                return None
+            return self.attr_types.get(base[0], {}).get(expr.attr)
+        if isinstance(expr, ast.Subscript):
+            base = self._expr_type(ctx, expr.value)
+            if base is not None and base[1]:
+                return (base[0], False)
+            return None
+        if isinstance(expr, ast.Call):
+            ref = self._ctor_class(mod, expr)
+            if ref is not None:
+                return (ref, False)
+            f = expr.func
+            if isinstance(f, ast.Attribute):
+                recv = self._expr_type(ctx, f.value)
+                if recv is not None and not recv[1]:
+                    return self.ret_types.get((recv[0], f.attr))
+            elif isinstance(f, ast.Name):
+                return self.fn_ret.get((mod.path, f.id))
+        if isinstance(expr, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            t = self._expr_type(ctx, expr.elt) \
+                if isinstance(expr.elt, ast.Call) else None
+            if t is not None:
+                return (t[0], True)
+        if isinstance(expr, (ast.List, ast.Tuple, ast.Set)) and expr.elts:
+            t = self._expr_type(ctx, expr.elts[0])
+            if t is not None and not t[1]:
+                return (t[0], True)
+        if isinstance(expr, ast.Dict) and expr.values:
+            t = self._expr_type(ctx, expr.values[0])
+            if t is not None and not t[1]:
+                return (t[0], True)
+        if isinstance(expr, ast.IfExp):
+            return self._expr_type(ctx, expr.body) \
+                or self._expr_type(ctx, expr.orelse)
+        if isinstance(expr, ast.BoolOp) and expr.values:
+            for v in expr.values:
+                t = self._expr_type(ctx, v)
+                if t is not None:
+                    return t
+        return None
+
+    # -- build ----------------------------------------------------------
+
+    def _build(self) -> None:
+        self._collect_annotations()
+        for _ in range(3):
+            self._harvest_pass()
+        self._find_roots()
+        self._bfs()
+        self._scan_reachable()
+
+    def _collect_annotations(self) -> None:
+        for (path, cname), (mod, cnode) in self.classes.items():
+            ref = (path, cname)
+            amap = self.attr_types.setdefault(ref, {})
+            for node in cnode.body:
+                if isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name):
+                    t = self._ann_type(mod, node.annotation)
+                    if t is not None:
+                        amap[node.target.id] = t
+            for mname, meth in mod.methods.get(cname, {}).items():
+                rt = self._ann_type(mod, getattr(meth, "returns", None))
+                if rt is not None:
+                    self.ret_types[(ref, mname)] = rt
+        for mod in self.index.by_path.values():
+            for fname, fnode in mod.functions.items():
+                rt = self._ann_type(mod, getattr(fnode, "returns", None))
+                if rt is not None:
+                    self.fn_ret[(mod.path, fname)] = rt
+
+    def _callable_targets(self, mod, scope, ctx, expr
+                          ) -> List[Tuple[ModuleIndex, ast.AST]]:
+        """Function nodes a callback expression may denote, adding
+        typed-receiver bound methods to core's resolution."""
+        out = list(resolve_callable(self.index, mod, scope, expr))
+        if isinstance(expr, ast.Attribute) and not out:
+            recv = self._expr_type(ctx, expr.value)
+            if recv is not None and not recv[1]:
+                cpath, cname = recv[0]
+                cmod = self.index.by_path.get(cpath)
+                if cmod is not None:
+                    meth = cmod.methods.get(cname, {}).get(expr.attr)
+                    if meth is not None:
+                        out.append((cmod, meth))
+        # self._method inside nested classes (core only sees top-level)
+        if not out and scope.cls is not None:
+            d = dotted_name(expr)
+            if d and d.startswith("self.") and d.count(".") == 1:
+                meth = mod.methods.get(scope.cls, {}).get(d.split(".")[1])
+                if meth is not None:
+                    out.append((mod, meth))
+        return out
+
+    def _harvest_pass(self) -> None:
+        """One round of attribute-type / ctor-param / callback harvest
+        over every function body (run to a small fixpoint)."""
+        for mod in self.index.by_path.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                scope = mod.scopes.get(id(node), Scope(None, ()))
+                env = self._fn_env(mod, node, scope)
+                selfc = self._self_cls(mod, scope)
+                ctx = (mod, selfc, env)
+                body = node.body
+                for top in body:
+                    for sub in ast.walk(top):
+                        self._harvest_node(mod, node, scope, ctx, sub)
+
+    def _harvest_node(self, mod, fn, scope, ctx, node) -> None:
+        _, selfc, env = ctx
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            val = node.value
+            for tg in tgts:
+                if not isinstance(tg, ast.Attribute):
+                    continue
+                owner = self._expr_type(ctx, tg.value)
+                if owner is None or owner[1]:
+                    continue
+                oref = owner[0]
+                amap = self.attr_types.setdefault(oref, {})
+                t = None
+                if isinstance(node, ast.AnnAssign):
+                    t = self._ann_type(mod, node.annotation)
+                if t is None and isinstance(val, ast.Call):
+                    if self._is_sync_ctor(mod, val):
+                        self.sync_attrs.setdefault(oref, set()).add(
+                            tg.attr)
+                        d = dotted_name(val.func) or ""
+                        if d.rsplit(".", 1)[-1] == "RLock":
+                            self.rlock_classes.add(oref[1])
+                        continue
+                if t is None and val is not None:
+                    t = self._expr_type(ctx, val)
+                if t is not None:
+                    amap.setdefault(tg.attr, t)
+                # callback registration: recv.attr = <callable>
+                if val is not None:
+                    cbs = self._callable_targets(mod, scope, ctx, val)
+                    if cbs:
+                        key = (oref, tg.attr)
+                        cur = self.callbacks.setdefault(key, [])
+                        for c in cbs:
+                            if all(c[1] is not e[1] for e in cur):
+                                cur.append(c)
+                # alias: self.X = <param registered as ctor callback>
+                if isinstance(val, ast.Name) and selfc is not None \
+                        and getattr(fn, "name", "") == "__init__" \
+                        and isinstance(tg.value, ast.Name) \
+                        and tg.value.id == "self":
+                    src = self.callbacks.get((selfc, val.id))
+                    if src:
+                        cur = self.callbacks.setdefault(
+                            (selfc, tg.attr), [])
+                        for c in src:
+                            if all(c[1] is not e[1] for e in cur):
+                                cur.append(c)
+        elif isinstance(node, ast.Call):
+            ref = self._ctor_class(ctx[0], node)
+            if ref is None:
+                return
+            cmod, cnode = self.classes.get(ref, (None, None))
+            if cnode is None:
+                return
+            init = cmod.methods.get(ref[1], {}).get("__init__")
+            if init is None:
+                return
+            params = [p for p in _param_names(init) if p != "self"]
+            bound: List[Tuple[str, ast.AST]] = []
+            for i, a in enumerate(node.args):
+                if i < len(params):
+                    bound.append((params[i], a))
+            for kw in node.keywords:
+                if kw.arg:
+                    bound.append((kw.arg, kw.value))
+            for pname, aexpr in bound:
+                t = self._expr_type(ctx, aexpr)
+                if t is not None:
+                    self.param_types.setdefault(
+                        (ref, "__init__", pname), t)
+                cbs = self._callable_targets(ctx[0], scope, ctx, aexpr)
+                if cbs:
+                    cur = self.callbacks.setdefault((ref, pname), [])
+                    for c in cbs:
+                        if all(c[1] is not e[1] for e in cur):
+                            cur.append(c)
+
+    # -- roots ----------------------------------------------------------
+
+    def _thread_ctor_kind(self, mod, call) -> Optional[str]:
+        d = dotted_name(call.func)
+        if d is None:
+            return None
+        last = d.rsplit(".", 1)[-1]
+        if last not in ("Thread", "Timer"):
+            return None
+        if "." in d:
+            return "thread" if last == "Thread" else "timer"
+        imp = mod.imports.get(last)
+        if imp and imp[0] == "threading":
+            return "thread" if last == "Thread" else "timer"
+        return None
+
+    def _find_roots(self) -> None:
+        seen_names: Dict[str, ThreadRoot] = {}
+
+        def add(name, kind, mod, line, targets, daemon):
+            entry = ", ".join(sorted({
+                (f"{m.path}:{_fn_label(f)}").rsplit("/", 1)[-1]
+                for m, f in targets})) or "?"
+            root = seen_names.get(name)
+            if root is None:
+                root = ThreadRoot(name, kind, mod.path, line, entry,
+                                  daemon)
+                seen_names[name] = root
+                self.roots.append(root)
+            else:
+                root.daemon = root.daemon or daemon
+            for m, f in targets:
+                self.entries.append((name, m, f))
+
+        for mod in self.index.by_path.values():
+            stem = mod.path.rsplit("/", 1)[-1][:-3]
+            # main() entry points collapse into one "main" pseudo-root
+            if "main" in mod.functions:
+                add("main", "main", mod, mod.functions["main"].lineno,
+                    [(mod, mod.functions["main"])], False)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    base_names = {dotted_name(b) or "" for b in node.bases}
+                    if any(b.rsplit(".", 1)[-1] in _HTTP_BASES
+                           for b in base_names):
+                        handlers = [
+                            (mod, m) for m in node.body
+                            if isinstance(m, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef))
+                            and m.name.startswith("do_")]
+                        if handlers:
+                            add(f"http:{stem}", "http", mod, node.lineno,
+                                handlers, False)
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = self._thread_ctor_kind(mod, node)
+                if kind is not None:
+                    scope = enclosing_scope(mod, node)
+                    env = {}
+                    encl = scope.chain[-1] if scope.chain else None
+                    if encl is not None:
+                        env = self._fn_env(
+                            mod, encl,
+                            mod.scopes.get(id(encl), Scope(None, ())))
+                    ctx = (mod, self._self_cls(mod, scope), env)
+                    tgt_expr = None
+                    daemon = kind == "timer"
+                    name = None
+                    args = list(node.args)
+                    for kw in node.keywords:
+                        if kw.arg == "target" or \
+                                (kind == "timer" and kw.arg == "function"):
+                            tgt_expr = kw.value
+                        elif kw.arg == "name" and \
+                                isinstance(kw.value, ast.Constant):
+                            name = str(kw.value.value)
+                        elif kw.arg == "daemon" and \
+                                isinstance(kw.value, ast.Constant):
+                            daemon = bool(kw.value.value)
+                    if tgt_expr is None and kind == "timer" \
+                            and len(args) >= 2:
+                        tgt_expr = args[1]
+                    if tgt_expr is None:
+                        continue
+                    targets = self._callable_targets(mod, scope, ctx,
+                                                     tgt_expr)
+                    if not targets:
+                        continue
+                    if name is None:
+                        lbl = _fn_label(targets[0][1])
+                        name = f"{kind}:{stem}.{lbl}"
+                    add(name, kind, mod, node.lineno, targets, daemon)
+                else:
+                    d = dotted_name(node.func)
+                    if d in ("signal.signal",) and len(node.args) == 2:
+                        scope = enclosing_scope(mod, node)
+                        encl = scope.chain[-1] if scope.chain else None
+                        env = self._fn_env(
+                            mod, encl,
+                            mod.scopes.get(id(encl),
+                                           Scope(None, ()))) \
+                            if encl is not None else {}
+                        ctx = (mod, self._self_cls(mod, scope), env)
+                        targets = self._callable_targets(
+                            mod, scope, ctx, node.args[1])
+                        if targets:
+                            # signal handlers run on the main thread
+                            # but interrupt it asynchronously
+                            add("signal", "signal", mod, node.lineno,
+                                targets, False)
+
+    # -- reachability ---------------------------------------------------
+
+    def _edges_from(self, mod, fn) -> List[Tuple[ModuleIndex, ast.AST]]:
+        scope_base = mod.scopes.get(id(fn), Scope(None, ()))
+        scope = Scope(scope_base.cls, scope_base.chain + (fn,))
+        env = self._fn_env(mod, fn, scope_base)
+        ctx = (mod, self._self_cls(mod, scope_base), env)
+        out: List[Tuple[ModuleIndex, ast.AST]] = []
+        # local callable aliases: h = self.hook; ...; h()
+        aliases: Dict[str, List[Tuple[ModuleIndex, ast.AST]]] = {}
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        nested = _nested_member_ids(fn)
+        for top in body:
+            for node in ast.walk(top):
+                if id(node) in nested:
+                    continue
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and not isinstance(node.value, ast.Call):
+                    tg = self._call_targets(mod, scope, ctx, node.value)
+                    if tg:
+                        aliases[node.targets[0].id] = tg
+        for top in body:
+            for node in ast.walk(top):
+                if id(node) in nested or not isinstance(node, ast.Call):
+                    continue
+                out.extend(self._call_targets(mod, scope, ctx, node.func))
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in aliases:
+                    out.extend(aliases[node.func.id])
+        return out
+
+    def _call_targets(self, mod, scope, ctx, expr
+                      ) -> List[Tuple[ModuleIndex, ast.AST]]:
+        out = self._callable_targets(mod, scope, ctx, expr)
+        # callback dispatch through a typed receiver attribute
+        if isinstance(expr, ast.Attribute):
+            recv = self._expr_type(ctx, expr.value)
+            if recv is not None and not recv[1]:
+                cbs = self.callbacks.get((recv[0], expr.attr))
+                if cbs:
+                    out = out + [c for c in cbs
+                                 if all(c[1] is not e[1] for e in out)]
+        return out
+
+    def _bfs(self) -> None:
+        queue: List[Tuple[str, ModuleIndex, ast.AST]] = list(self.entries)
+        edge_cache: Dict[int, List[Tuple[ModuleIndex, ast.AST]]] = {}
+        while queue:
+            root, mod, fn = queue.pop()
+            if fn is None:
+                continue
+            fid = id(fn)
+            roots = self.reach.setdefault(fid, set())
+            if root in roots:
+                continue
+            roots.add(root)
+            self.fn_site[fid] = (mod, fn)
+            if fid not in edge_cache:
+                edge_cache[fid] = self._edges_from(mod, fn)
+            for m2, f2 in edge_cache[fid]:
+                queue.append((root, m2, f2))
+        self._edge_cache = edge_cache
+
+    # -- access / lock scan ---------------------------------------------
+
+    def _lock_name(self, ctx, expr) -> Optional[str]:
+        """'<Class>.<attr>' for a lock-ish attribute expression."""
+        if isinstance(expr, ast.Attribute) and _is_lock_attr(expr.attr):
+            t = self._expr_type(ctx, expr.value)
+            if t is not None and not t[1]:
+                return f"{t[0][1]}.{expr.attr}"
+        return None
+
+    def _scan_reachable(self) -> None:
+        for fid, roots in self.reach.items():
+            mod, fn = self.fn_site[fid]
+            scope_base = mod.scopes.get(id(fn), Scope(None, ()))
+            env = self._fn_env(mod, fn, scope_base)
+            selfc = self._self_cls(mod, scope_base)
+            ctx = (mod, selfc, env)
+            label = _fn_label(fn)
+            if scope_base.cls:
+                label = f"{scope_base.cls}.{label}"
+            exempt_fn = (getattr(fn, "name", "") == "__init__"
+                         or str(getattr(fn, "name", "")
+                                ).endswith("_locked"))
+            acquires = self.fn_acquires.setdefault(fid, set())
+            nested = _nested_member_ids(fn)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+
+            def visit(node, held: FrozenSet[str]):
+                if id(node) in nested:
+                    return
+                if isinstance(node, ast.With):
+                    newly = []
+                    for item in node.items:
+                        ln = self._lock_name(ctx, item.context_expr)
+                        if ln is not None:
+                            for h in held.union(newly):
+                                self.lock_edges.append(
+                                    (h, ln, mod.path, node.lineno,
+                                     label))
+                            newly.append(ln)
+                            acquires.add(ln)
+                    inner = held.union(newly)
+                    for st in node.body:
+                        visit(st, inner)
+                    return
+                if isinstance(node, ast.Call):
+                    self._record_call(ctx, fid, label, mod, node, held)
+                self._record_access(ctx, fid, label, mod, node, held,
+                                    exempt_fn, roots)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+
+            for top in body:
+                visit(top, frozenset())
+
+    def _record_call(self, ctx, fid, label, mod, node, held) -> None:
+        """Blocking-call sites and calls-made-under-a-lock."""
+        blk = _blocking_label(ctx, self, mod, node)
+        if blk is not None and held:
+            self.blocking.append((fid, held, blk, mod.path,
+                                  node.lineno, label))
+        if held:
+            scope_base = mod.scopes.get(id(self.fn_site[fid][1]),
+                                        Scope(None, ()))
+            scope = Scope(scope_base.cls,
+                          scope_base.chain + (self.fn_site[fid][1],))
+            for m2, f2 in self._call_targets(mod, scope, ctx, node.func):
+                self.calls_under_lock.append(
+                    (held, id(f2), mod.path, node.lineno, label))
+
+    def _record_access(self, ctx, fid, label, mod, node, held,
+                       exempt_fn, roots) -> None:
+        recs: List[Tuple[ClsRef, str, str]] = []
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tg in tgts:
+                hit = self._field_of(ctx, tg)
+                if hit:
+                    owner, fieldname, via_subscript = hit
+                    kind = "cmut" if via_subscript else "write"
+                    recs.append((owner, fieldname, kind))
+        elif isinstance(node, ast.Delete):
+            for tg in node.targets:
+                hit = self._field_of(ctx, tg)
+                if hit:
+                    recs.append((hit[0], hit[1], "cmut"))
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            hit = self._field_of(ctx, node.func.value)
+            if hit:
+                # if the receiver field holds a *package* class with a
+                # real method of that name (e.g. RequestQueue.remove,
+                # internally locked), the call-edge into the method
+                # body records any actual mutation — don't double-count
+                # it as a raw container mutation here
+                ft = self.attr_types.get(hit[0], {}).get(hit[1])
+                is_method = False
+                if ft is not None and ft[0] in self.classes:
+                    fmod = self.classes[ft[0]][0]
+                    is_method = node.func.attr in \
+                        fmod.methods.get(ft[0][1], {})
+                if not is_method:
+                    recs.append((hit[0], hit[1], "cmut"))
+        elif isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load):
+            t = self._expr_type(ctx, node.value)
+            if t is not None and not t[1] and t[0] in self.classes:
+                recs.append((t[0], node.attr, "read"))
+        for owner, fieldname, kind in recs:
+            if fieldname.startswith("__"):
+                continue
+            if fieldname in self.sync_attrs.get(owner, set()):
+                continue
+            if owner not in self.classes:
+                continue
+            # methods are code, not state
+            cmod = self.classes[owner][0]
+            if fieldname in cmod.methods.get(owner[1], {}):
+                continue
+            if roots:
+                self.accesses.append(Access(
+                    owner, fieldname, kind, held, mod.path,
+                    getattr(node, "lineno", 0), label,
+                    exempt_fn and kind != "read", fid))
+
+    def _field_of(self, ctx, expr
+                  ) -> Optional[Tuple[ClsRef, str, bool]]:
+        """(owner class, field, via-container) for an attribute-rooted
+        lvalue, peeling subscripts: ``self.finished[k]`` -> finished."""
+        via = False
+        while isinstance(expr, ast.Subscript):
+            expr = expr.value
+            via = True
+        if not isinstance(expr, ast.Attribute):
+            return None
+        t = self._expr_type(ctx, expr.value)
+        if t is None or t[1]:
+            return None
+        if t[0] not in self.classes:
+            return None
+        return (t[0], expr.attr, via)
+
+    # -- per-fn lock closure (for TH002/TH003) --------------------------
+
+    def transitive_acquires(self) -> Dict[int, Set[str]]:
+        """fn id -> locks acquired by it or anything it calls."""
+        acq = {fid: set(locks)
+               for fid, locks in self.fn_acquires.items()}
+        for fid in self.reach:
+            acq.setdefault(fid, set())
+        changed = True
+        while changed:
+            changed = False
+            for fid in self.reach:
+                for m2, f2 in self._edge_cache.get(fid, ()):
+                    sub = acq.get(id(f2))
+                    if sub and not sub <= acq[fid]:
+                        acq[fid] |= sub
+                        changed = True
+        return acq
+
+
+def _nested_member_ids(fn: ast.AST) -> Set[int]:
+    """ids of every node inside a nested def/lambda/class of fn."""
+    out: Set[int] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for top in body:
+        for n in ast.walk(top):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                for sub in ast.walk(n):
+                    if sub is not n:
+                        out.add(id(sub))
+                out.add(id(n))
+    return out
+
+
+_QUEUEISH_RE = re.compile(r"(queue|events|inbox|mailbox|channel)", re.I)
+
+
+def _blocking_label(ctx, topo, mod, node: ast.Call) -> Optional[str]:
+    """Label when a call can block: LD001's list plus join /
+    wait-without-timeout / queue.get-without-timeout / .result() /
+    .getresponse()."""
+    d = dotted_name(node.func)
+    if d is not None:
+        if any(d.startswith(p) for p in _BLOCKING_PREFIXES):
+            return d
+        if d in _BLOCKING_NAMES:
+            return d
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    has_timeout = bool(node.args) or any(
+        kw.arg in ("timeout", "block") for kw in node.keywords)
+    if attr == "join":
+        # joining a thread blocks (bounded or not)
+        recv = dotted_name(node.func.value) or ""
+        if not isinstance(node.func.value, ast.Constant) and \
+                not (recv and recv.split(".")[-1] in ("sep",)):
+            # exclude str.join: a constant/str receiver or args that are
+            # genexprs over strings — heuristic: thread-ish receivers
+            # are attributes/locals named *thread*/*worker* or typed
+            if re.search(r"(thread|worker|proc|timer)",
+                         (recv or ""), re.I):
+                return f"{recv}.join"
+        return None
+    if attr == "wait" and not has_timeout:
+        return f"{dotted_name(node.func.value) or '?'}.wait"
+    if attr == "get" and not has_timeout:
+        recv = dotted_name(node.func.value) or ""
+        if _QUEUEISH_RE.search(recv):
+            return f"{recv}.get"
+        return None
+    if attr in ("result", "getresponse") and not node.args:
+        return f"{dotted_name(node.func.value) or '?'}.{attr}"
+    return None
+
+
+# -- checkers ------------------------------------------------------------
+
+
+def _roots_of(topo: Topology, acc: Access) -> Set[str]:
+    return topo.reach.get(acc.fn_id, set())
+
+
+def _counting_roots(topo: Topology) -> Set[str]:
+    """Roots that count as racing writers (see ThreadRoot.concurrent)."""
+    return {r.name for r in topo.roots if r.concurrent}
+
+
+def _th001(topo: Topology, out: List[Violation]) -> None:
+    by_field: Dict[Tuple[ClsRef, str], List[Access]] = {}
+    for acc in topo.accesses:
+        by_field.setdefault((acc.owner, acc.field), []).append(acc)
+    for (owner, fieldname), accs in sorted(
+            by_field.items(), key=lambda kv: (kv[0][0][0], kv[0][0][1],
+                                              kv[0][1])):
+        live = [a for a in accs if not a.exempt]
+        writes = [a for a in live if a.kind in ("write", "cmut")]
+        if not writes:
+            continue
+        counting = _counting_roots(topo)
+        writer_roots: Set[str] = set()
+        for a in writes:
+            writer_roots |= _roots_of(topo, a) & counting
+        access_roots: Set[str] = set()
+        for a in live:
+            access_roots |= _roots_of(topo, a) & counting
+        common = None
+        for a in writes:
+            common = a.locks if common is None else common & a.locks
+        if common:
+            continue
+        cmut_roots: Set[str] = set()
+        for a in writes:
+            if a.kind == "cmut":
+                cmut_roots |= _roots_of(topo, a) & counting
+        multi_writer = len(writer_roots) >= 2
+        foreign_touch = bool(cmut_roots) and \
+            bool(access_roots - cmut_roots)
+        if not (multi_writer or foreign_touch):
+            # single-writer scalar publish (display counters): fine
+            continue
+        cpath = owner[0]
+        cmod, cnode = topo.classes[owner]
+        declared = _protected_fields(cnode)
+        lock_hint = declared.get(fieldname)
+        if lock_hint is None:
+            # most common lock this class already uses, else _lock
+            counts: Dict[str, int] = {}
+            for a in accs:
+                for ln in a.locks:
+                    if ln.startswith(owner[1] + "."):
+                        counts[ln] = counts.get(ln, 0) + 1
+            lock_hint = max(counts, key=counts.get).split(".", 1)[1] \
+                if counts else "_lock"
+        first = min((a for a in writes if a.path == cpath),
+                    key=lambda a: a.line, default=writes[0])
+        wr = ",".join(sorted(writer_roots))
+        out.append(Violation(
+            CHECKER, "TH001", cpath, cnode.lineno,
+            f"{owner[1]}.{fieldname}",
+            f"'{owner[1]}.{fieldname}' is written from thread roots "
+            f"[{wr}] (e.g. {first.label} at {first.path}:{first.line}) "
+            f"with no common lock on all write paths; guard every "
+            f"access with 'with self.{lock_hint}:' and declare "
+            f"{ANNOTATION} = {{\"{fieldname}\": \"{lock_hint}\"}} so "
+            f"LD002 enforces it"))
+
+
+def _th002(topo: Topology, out: List[Violation]) -> None:
+    acq = topo.transitive_acquires()
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for held, ln, path, line, label in topo.lock_edges:
+        edges.setdefault((held, ln), (path, line, label))
+    for held, callee, path, line, label in topo.calls_under_lock:
+        for h in held:
+            for ln in acq.get(callee, ()):
+                edges.setdefault((h, ln), (path, line, label))
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    # self-cycles: re-acquiring a non-reentrant lock deadlocks alone
+    for (a, b), (path, line, label) in sorted(edges.items()):
+        if a == b and a.split(".")[0] not in topo.rlock_classes:
+            out.append(Violation(
+                CHECKER, "TH002", path, line, f"{a}->{b}",
+                f"'{label}' acquires {b} while already holding it "
+                f"(non-reentrant threading.Lock self-deadlock)"))
+    # longer cycles: DFS with a path stack
+    def find_cycle() -> Optional[List[str]]:
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def dfs(n) -> Optional[List[str]]:
+            color[n] = 1
+            stack.append(n)
+            for m in sorted(graph.get(n, ())):
+                if m == n:
+                    continue
+                if color.get(m) == 1:
+                    return stack[stack.index(m):] + [m]
+                if color.get(m, 0) == 0:
+                    cyc = dfs(m)
+                    if cyc:
+                        return cyc
+            color[n] = 2
+            stack.pop()
+            return None
+
+        for n in sorted(graph):
+            if color.get(n, 0) == 0:
+                cyc = dfs(n)
+                if cyc:
+                    return cyc
+        return None
+
+    cyc = find_cycle()
+    if cyc:
+        # canonical rotation so the fingerprint is stable
+        ring = cyc[:-1]
+        k = ring.index(min(ring))
+        ring = ring[k:] + ring[:k]
+        a, b = ring[0], ring[1 % len(ring)]
+        path, line, label = edges.get(
+            (a, b), next(iter(edges.values())))
+        sym = "->".join(ring + [ring[0]])
+        out.append(Violation(
+            CHECKER, "TH002", path, line, sym,
+            f"lock-order inversion: cycle {sym} in the acquires-while-"
+            f"holding graph (e.g. '{label}' at {path}:{line}); impose "
+            f"a single acquisition order or narrow the outer critical "
+            f"section"))
+
+
+def _th003(topo: Topology, out: List[Violation]) -> None:
+    acq = topo.transitive_acquires()
+    # which roots acquire each lock (directly or transitively)
+    lock_roots: Dict[str, Set[str]] = {}
+    for fid, locks in acq.items():
+        roots = topo.reach.get(fid, set())
+        for ln in locks:
+            lock_roots.setdefault(ln, set()).update(roots)
+    for fid, held, blk, path, line, label in topo.blocking:
+        my_roots = topo.reach.get(fid, set())
+        for ln in sorted(held):
+            others = lock_roots.get(ln, set()) - my_roots
+            if others:
+                out.append(Violation(
+                    CHECKER, "TH003", path, line, f"{label}/{blk}",
+                    f"'{label}' blocks on {blk} while holding {ln}, "
+                    f"which thread root(s) [{','.join(sorted(others))}] "
+                    f"also need — move the blocking call outside the "
+                    f"critical section or bound it with a timeout"))
+                break
+
+
+def _th004(topo: Topology, out: List[Violation]) -> None:
+    daemon_roots = {r.name for r in topo.roots
+                    if r.daemon or r.kind == "timer"}
+    if not daemon_roots:
+        return
+    # shared fields: TH001-eligible or declared in _lock_protected_
+    shared: Set[Tuple[ClsRef, str]] = set()
+    for ref, (mod, cnode) in topo.classes.items():
+        for f in _protected_fields(cnode):
+            shared.add((ref, f))
+    counting = _counting_roots(topo)
+    seen_fields: Dict[Tuple[ClsRef, str], Set[str]] = {}
+    for acc in topo.accesses:
+        if acc.kind in ("write", "cmut") and not acc.exempt:
+            seen_fields.setdefault((acc.owner, acc.field), set()) \
+                .update(_roots_of(topo, acc) & counting)
+    for key, roots in seen_fields.items():
+        if len(roots) >= 2:
+            shared.add(key)
+    for fid, roots in topo.reach.items():
+        if not (roots & daemon_roots):
+            continue
+        mod, fn = topo.fn_site[fid]
+        scope_base = mod.scopes.get(id(fn), Scope(None, ()))
+        env = topo._fn_env(mod, fn, scope_base)
+        ctx = (mod, topo._self_cls(mod, scope_base), env)
+        label = _fn_label(fn)
+        if scope_base.cls:
+            label = f"{scope_base.cls}.{label}"
+        nested = _nested_member_ids(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for top in body:
+            for node in ast.walk(top):
+                if id(node) in nested or not isinstance(node, ast.While):
+                    continue
+                flags = _flag_attrs(ctx, topo, node.test)
+                if not flags:
+                    continue
+                _scan_drain_loop(ctx, topo, mod, node, flags, shared,
+                                 label, nested, out)
+
+
+def _flag_attrs(ctx, topo, test: ast.AST) -> Set[Tuple[ClsRef, str]]:
+    """Stop/drain flag attributes read in a while-test."""
+    flags: Set[Tuple[ClsRef, str]] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) \
+                and _STOP_FLAG_RE.search(node.attr):
+            t = topo._expr_type(ctx, node.value)
+            if t is not None and not t[1] and t[0] in topo.classes:
+                flags.add((t[0], node.attr))
+    return flags
+
+
+def _scan_drain_loop(ctx, topo, mod, loop, flags, shared, label,
+                     nested, out) -> None:
+    stmts = sorted((n for n in ast.walk(loop) if n is not loop
+                    and id(n) not in nested
+                    and hasattr(n, "lineno")),
+                   key=lambda n: (n.lineno, getattr(n, "col_offset", 0)))
+    blocked_since: Optional[str] = None
+    reported: Set[str] = set()
+    held_lines = _with_lock_lines(ctx, topo, loop, nested)
+    for node in stmts:
+        if isinstance(node, ast.Call):
+            blk = _blocking_label(ctx, topo, mod, node)
+            if blk is not None:
+                blocked_since = blk
+                continue
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load):
+            t = topo._expr_type(ctx, node.value)
+            if t is not None and not t[1] and (t[0], node.attr) in flags:
+                blocked_since = None   # flag re-checked
+                continue
+        if blocked_since is None:
+            continue
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tg in tgts:
+                hit = topo._field_of(ctx, tg)
+                if hit is None:
+                    continue
+                owner, fieldname, _via = hit
+                if (owner, fieldname) not in shared:
+                    continue
+                if node.lineno in held_lines:
+                    continue
+                if fieldname in reported:
+                    continue
+                reported.add(fieldname)
+                out.append(Violation(
+                    CHECKER, "TH004", mod.path, node.lineno,
+                    f"{label}/{fieldname}",
+                    f"daemon loop '{label}' writes shared "
+                    f"'{owner[1]}.{fieldname}' after blocking on "
+                    f"{blocked_since} without re-checking its stop/"
+                    f"drain flag under a lock — a drained object can "
+                    f"be mutated one more time; re-test the flag (or "
+                    f"take the lock) after the blocking call"))
+
+
+def _with_lock_lines(ctx, topo, loop, nested) -> Set[int]:
+    """Line numbers inside ``with <lock>:`` bodies within the loop."""
+    lines: Set[int] = set()
+    for node in ast.walk(loop):
+        if id(node) in nested or not isinstance(node, ast.With):
+            continue
+        if any(topo._lock_name(ctx, it.context_expr)
+               for it in node.items):
+            end = getattr(node, "end_lineno", node.lineno)
+            lines.update(range(node.lineno, end + 1))
+    return lines
+
+
+# -- public API -----------------------------------------------------------
+
+
+def build_topology(repo: Repo) -> Topology:
+    return Topology(repo)
+
+
+def check(repo: Repo, baseline=None) -> List[Violation]:
+    topo = build_topology(repo)
+    out: List[Violation] = []
+    _th001(topo, out)
+    _th002(topo, out)
+    _th003(topo, out)
+    _th004(topo, out)
+    return out
+
+
+def threads_table(repo: Repo) -> str:
+    """Markdown table of discovered thread roots (``--threads``)."""
+    topo = build_topology(repo)
+    lines = ["| root | kind | daemon | entry | spawned at |",
+             "|---|---|---|---|---|"]
+    for r in sorted(topo.roots, key=lambda r: (r.kind, r.name)):
+        daemon = "yes" if r.daemon else "no"
+        lines.append(f"| {r.name} | {r.kind} | {daemon} | {r.entry} "
+                     f"| {r.path} |")
+    return "\n".join(lines)
+
+
+def suggest_locks(repo: Repo) -> str:
+    """Ready-to-paste ``_lock_protected_`` declarations inferred from
+    the TH001 topology (``--suggest-locks``).  Ignores the baseline on
+    purpose: suggestions should show suppressed fields too."""
+    findings: List[Violation] = []
+    topo = build_topology(repo)
+    _th001(topo, findings)
+    by_cls: Dict[str, List[Tuple[str, str, str]]] = {}
+    cls_path: Dict[str, str] = {}
+    for v in findings:
+        cls, fieldname = v.symbol.split(".", 1)
+        m = re.search(r'\{"[^"]+": "([^"]+)"\}', v.message)
+        lock = m.group(1) if m else "_lock"
+        roots = ""
+        mroots = re.search(r"\[([^\]]*)\]", v.message)
+        if mroots:
+            roots = mroots.group(1)
+        by_cls.setdefault(cls, []).append((fieldname, lock, roots))
+        cls_path[cls] = v.path
+    if not by_cls:
+        return "no unprotected shared fields inferred — nothing to do\n"
+    chunks: List[str] = []
+    for cls in sorted(by_cls):
+        chunks.append(f"# {cls_path[cls]}: class {cls}")
+        chunks.append(f"{ANNOTATION} = {{")
+        for fieldname, lock, roots in sorted(by_cls[cls]):
+            chunks.append(f'    "{fieldname}": "{lock}",'
+                          f'  # written from: {roots}')
+        chunks.append("}")
+        chunks.append("")
+    return "\n".join(chunks)

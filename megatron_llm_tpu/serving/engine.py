@@ -187,10 +187,21 @@ class InferenceEngine:
     prefill -> decode -> completion.  Tokenization stays with the
     caller — the engine speaks token ids only."""
 
-    # lint-enforced (graft-lint locks/LD002): the state-object swap is
-    # the restart path's linearization point — only restart() (under
-    # _restart_lock) may publish a new _EngineState
-    _lock_protected_ = {"_st": "_restart_lock"}
+    # lint-enforced (graft-lint locks/LD002 + threads/TH001): the
+    # state-object swap is the restart path's linearization point —
+    # only restart() (under _restart_lock) may publish a new
+    # _EngineState, and the thread/watchdog lifecycle fields share
+    # that lock so stop() cannot race a watchdog-driven restart into
+    # respawning a loop thread after shutdown.  finished is counted
+    # from both the engine loop and restart (watchdog thread), so it
+    # gets its own tiny lock.
+    _lock_protected_ = {
+        "_st": "_restart_lock",
+        "_running": "_restart_lock",
+        "_thread": "_restart_lock",
+        "_watchdog": "_restart_lock",
+        "finished": "_finished_lock",
+    }
 
     def __init__(self, model, params, config: Optional[EngineConfig] = None):
         self.model = model
@@ -283,6 +294,7 @@ class InferenceEngine:
         self.prefill_secs = 0.0
         self.decode_secs = 0.0
         self.finished: Dict[str, int] = {}
+        self._finished_lock = threading.Lock()
         self.warmed_up = False
         # resilience counters + machinery (serving/resilience.py)
         self.engine_restarts = 0
@@ -527,27 +539,37 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def start(self) -> "InferenceEngine":
-        assert self._thread is None, "engine already started"
-        self._running = True
-        if self.config.watchdog_secs > 0 and self._watchdog is None:
-            self._watchdog = EngineWatchdog(
-                timeout_secs=self.config.watchdog_secs,
-                has_work=lambda: self._st.scheduler.has_work(),
-                on_fire=lambda: self.restart("watchdog")).start()
-        self._thread = threading.Thread(target=self._loop,
-                                        name="serving-engine", daemon=True)
-        self._thread.start()
+        with self._restart_lock:
+            assert self._thread is None, "engine already started"
+            self._running = True
+            if self.config.watchdog_secs > 0 and self._watchdog is None:
+                self._watchdog = EngineWatchdog(
+                    timeout_secs=self.config.watchdog_secs,
+                    has_work=lambda: self._st.scheduler.has_work(),
+                    on_fire=lambda: self.restart("watchdog")).start()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="serving-engine",
+                                            daemon=True)
+            self._thread.start()
         return self
 
     def stop(self, timeout: float = 30.0) -> None:
-        self._running = False
-        if self._watchdog is not None:
-            self._watchdog.stop()
-            self._watchdog = None
-        self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout)
-            self._thread = None
+        # Lifecycle writes happen under _restart_lock so a concurrent
+        # watchdog restart() either completes first (we then join the
+        # thread it spawned) or observes _running False and stands
+        # down — it can never respawn the loop after shutdown.
+        with self._restart_lock:
+            self._running = False
+            watchdog, self._watchdog = self._watchdog, None
+            thread, self._thread = self._thread, None
+            self._wake.set()
+        # join OUTSIDE the lock: the watchdog's on_fire path takes
+        # _restart_lock, so joining it while holding the lock is the
+        # classic drain/watchdog deadlock (threads/TH003 shape)
+        if watchdog is not None:
+            watchdog.stop()
+        if thread is not None:
+            thread.join(timeout)
         st = self._st
         for req in self.queue.drain():
             req._finish(FINISH_ABORTED)
@@ -1126,8 +1148,10 @@ class InferenceEngine:
                 pass    # metrics must never take down the engine loop
 
     def _count_finish(self, reason: Optional[str]) -> None:
+        # engine loop and restart (watchdog thread) both count here
         if reason:
-            self.finished[reason] = self.finished.get(reason, 0) + 1
+            with self._finished_lock:
+                self.finished[reason] = self.finished.get(reason, 0) + 1
 
     # ------------------------------------------------------------------
     # warmup / stats
@@ -1168,7 +1192,8 @@ class InferenceEngine:
         """Rough queue wait for a newly rejected request: queued depth
         times mean per-request engine time, divided across slots.  Cheap
         and monotone in load — meant for 429 bodies, not SLOs."""
-        done = sum(self.finished.values())
+        with self._finished_lock:
+            done = sum(self.finished.values())
         if done <= 0:
             return 1.0
         per_req = (self.prefill_secs + self.decode_secs) / done
@@ -1177,6 +1202,8 @@ class InferenceEngine:
 
     def stats(self) -> Dict[str, Any]:
         s: Dict[str, Any] = dict(self.scheduler.stats())
+        with self._finished_lock:
+            finished = dict(self.finished)
         dec = max(self.decode_steps, 1)
         s.update({
             "decode_steps": self.decode_steps,
@@ -1188,7 +1215,7 @@ class InferenceEngine:
             "mean_batch_occupancy": self.occupancy_sum / dec,
             "prefill_secs": round(self.prefill_secs, 6),
             "decode_secs": round(self.decode_secs, 6),
-            "finished": dict(self.finished),
+            "finished": finished,
             "warmed_up": self.warmed_up,
             "paged_kernel": self.paged_kernel,
             "prefill_kernel": self.prefill_kernel,

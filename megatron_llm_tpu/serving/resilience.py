@@ -146,6 +146,12 @@ class EngineWatchdog:
     ``restart()``.  Unlike the training ``HangWatchdog`` it then
     re-arms: the restarted engine gets the same protection."""
 
+    # lint-enforced (graft-lint threads/TH001): the heartbeat is
+    # written by the engine loop (progress()) and read/re-armed by the
+    # watchdog's own daemon thread — a torn/stale read here is a
+    # spurious restart of a healthy engine
+    _lock_protected_ = {"_last_progress": "_lock"}
+
     def __init__(self, timeout_secs: float,
                  has_work: Callable[[], bool],
                  on_fire: Callable[[], None],
@@ -156,6 +162,7 @@ class EngineWatchdog:
         self.on_fire = on_fire
         self.printer = printer
         self.fires = 0
+        self._lock = threading.Lock()
         self._last_progress = time.monotonic()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -172,7 +179,9 @@ class EngineWatchdog:
     def progress(self) -> None:
         """Engine loop heartbeat: called after every completed dispatch
         (and on restart, to re-arm)."""
-        self._last_progress = time.monotonic()
+        now = time.monotonic()
+        with self._lock:
+            self._last_progress = now
 
     def stop(self) -> None:
         self._stop.set()
@@ -189,7 +198,9 @@ class EngineWatchdog:
                     continue
             except Exception:
                 continue
-            stalled = time.monotonic() - self._last_progress
+            with self._lock:
+                last = self._last_progress
+            stalled = time.monotonic() - last
             if stalled > self.timeout_secs:
                 self._fire(stalled)
                 self.progress()         # re-arm for the restarted engine

@@ -428,7 +428,7 @@ class FleetSupervisor:
 
     # lint-enforced (graft-lint locks/LD002): stats() is called from the
     # router's HTTP threads while the control loop mutates these
-    _lock_protected_ = ("replicas", "counters", "events")
+    _lock_protected_ = ("replicas", "counters", "events", "_slot_seq")
 
     def __init__(self, router, backend: ReplicaBackend,
                  config: Optional[PolicyConfig] = None,
@@ -483,8 +483,12 @@ class FleetSupervisor:
     # -- lifecycle -------------------------------------------------------
 
     def _new_slot(self) -> str:
-        slot = f"replica-{self._slot_seq}"
-        self._slot_seq += 1
+        # under the lock for the same reason as the counters: called
+        # from the control loop, but spawn_initial() runs on the main
+        # thread and a chaos harness may drive run_once() directly
+        with self._lock:
+            slot = f"replica-{self._slot_seq}"
+            self._slot_seq += 1
         return slot
 
     def _spawn(self, slot: Optional[str] = None, respawn: bool = False

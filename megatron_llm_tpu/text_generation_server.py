@@ -65,6 +65,12 @@ class ServerMetrics:
     carries its counters (queue depth, batch occupancy, prefill vs
     decode time, per-reason completions) under ``"engine"``."""
 
+    # lint-enforced (graft-lint threads/TH001): the SLO histograms are
+    # fed from the engine loop (request_done hook) and read by HTTP
+    # handler threads; drained is bumped from signal context and HTTP
+    # threads and read by /metrics
+    _lock_protected_ = {"histograms": "_lock", "drained": "_lock"}
+
     def __init__(self, window: int = 512):
         self._lock = threading.Lock()
         self._window = max(int(window), 1)
@@ -93,14 +99,24 @@ class ServerMetrics:
         latency phases into the SLO histograms.  Never raises (the
         engine guards it too, but belt and braces)."""
         try:
-            self.histograms["ttft_secs"].observe(record.get("ttft_secs"))
-            self.histograms["tpot_secs"].observe(record.get("tpot_secs"))
-            self.histograms["e2e_secs"].observe(record.get("latency_secs"))
-            phases = record.get("phases") or {}
-            self.histograms["queue_wait_secs"].observe(
-                phases.get("queue_secs"))
+            with self._lock:
+                self.histograms["ttft_secs"].observe(
+                    record.get("ttft_secs"))
+                self.histograms["tpot_secs"].observe(
+                    record.get("tpot_secs"))
+                self.histograms["e2e_secs"].observe(
+                    record.get("latency_secs"))
+                phases = record.get("phases") or {}
+                self.histograms["queue_wait_secs"].observe(
+                    phases.get("queue_secs"))
         except Exception:
             pass
+
+    def note_drained(self) -> None:
+        """Count one graceful-drain initiation (called from HTTP
+        handler threads and the SIGTERM handler)."""
+        with self._lock:
+            self.drained += 1
 
     def observe(self, secs: float, status: int, tokens: int = 0,
                 streamed: bool = False) -> None:
@@ -134,17 +150,20 @@ class ServerMetrics:
                 "drained": self.drained,
                 "tokens_generated": self.tokens_generated,
             }
+            # histogram snapshots under the same lock that orders the
+            # request_done writes (engine loop) — a snapshot taken
+            # mid-observe would tear count vs. bucket sums
+            hist_snaps = {name: h.snapshot()
+                          for name, h in self.histograms.items()}
         out["latency_p50_secs"] = self._percentile(lat, 0.50) if lat else None
         out["latency_p95_secs"] = self._percentile(lat, 0.95) if lat else None
         # histogram snapshots are additive across replicas (the router
         # bucket-sums them); the derived slo percentiles ride alongside
         # as plain (non-summable) gauges and are recomputed fleet-wide
         # from the merged buckets by the router
-        out["histograms"] = {name: h.snapshot()
-                             for name, h in self.histograms.items()}
+        out["histograms"] = hist_snaps
         out["slo"] = {}
-        for name, h in self.histograms.items():
-            snap = out["histograms"][name]
+        for name, snap in hist_snaps.items():
             for q, tag in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
                 out["slo"][f"{name}_{tag}"] = histogram_percentile(snap, q)
         fn = self.engine_stats_fn
@@ -508,7 +527,7 @@ class MegatronServer:
             if self.draining:
                 return False
             self.draining = True
-        self.metrics.drained += 1
+        self.metrics.note_drained()
         try:
             from megatron_llm_tpu.telemetry import get_stream
             stream = get_stream()

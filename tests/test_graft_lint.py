@@ -28,6 +28,7 @@ from megatron_llm_tpu.analysis import (
     run_checkers,
     stdlib_gate,
     telemetry_schema,
+    threads,
 )
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -308,6 +309,385 @@ def test_locks_clean_class_without_annotation(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# threads (graft-race)
+# ---------------------------------------------------------------------------
+
+_TH001_REPO = {"megatron_llm_tpu/shared.py": """\
+    import threading
+
+    class Shared:
+        def __init__(self):
+            self.count = 0
+            threading.Thread(target=self._a, name="writer-a",
+                             daemon=True).start()
+            threading.Thread(target=self._b, name="writer-b",
+                             daemon=True).start()
+
+        def _a(self):
+            while True:
+                self.count += 1
+
+        def _b(self):
+            while True:
+                self.count += 1
+"""}
+
+
+def test_th001_two_roots_no_lock(tmp_path):
+    repo = _mk(tmp_path, _TH001_REPO)
+    vs = threads.check(repo)
+    assert "TH001" in _codes(vs)
+    v = next(v for v in vs if v.code == "TH001")
+    assert v.symbol == "Shared.count"
+    assert "writer-a" in v.message and "writer-b" in v.message
+    # the fix-hint is a paste-able annotation
+    assert '_lock_protected_ = {"count": "_lock"}' in v.message
+
+
+def test_th001_clean_under_common_lock(tmp_path):
+    repo = _mk(tmp_path, {"megatron_llm_tpu/shared.py": """\
+        import threading
+
+        class Shared:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                threading.Thread(target=self._a, name="writer-a",
+                                 daemon=True).start()
+                threading.Thread(target=self._b, name="writer-b",
+                                 daemon=True).start()
+
+            def _a(self):
+                while True:
+                    with self._lock:
+                        self.count += 1
+
+            def _b(self):
+                while True:
+                    with self._lock:
+                        self.count += 1
+    """})
+    assert [v for v in threads.check(repo) if v.code == "TH001"] == []
+
+
+def test_th001_single_writer_root_is_clean(tmp_path):
+    # one thread publishes, others only read: scalar publish is fine
+    repo = _mk(tmp_path, {"megatron_llm_tpu/shared.py": """\
+        import threading
+
+        class Shared:
+            def __init__(self):
+                self.count = 0
+                threading.Thread(target=self._a, name="writer-a",
+                                 daemon=True).start()
+                threading.Thread(target=self._b, name="reader-b",
+                                 daemon=True).start()
+
+            def _a(self):
+                while True:
+                    self.count += 1
+
+            def _b(self):
+                while True:
+                    print(self.count)
+    """})
+    assert [v for v in threads.check(repo) if v.code == "TH001"] == []
+
+
+def test_th002_deliberate_lock_order_cycle(tmp_path):
+    repo = _mk(tmp_path, {"megatron_llm_tpu/ab.py": """\
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+                threading.Thread(target=self.fwd, name="fwd",
+                                 daemon=True).start()
+                threading.Thread(target=self.rev, name="rev",
+                                 daemon=True).start()
+
+            def fwd(self):
+                with self._alock:
+                    with self._block:
+                        pass
+
+            def rev(self):
+                with self._block:
+                    with self._alock:
+                        pass
+    """})
+    vs = [v for v in threads.check(repo) if v.code == "TH002"]
+    assert vs, "lock-order inversion not detected"
+    assert "AB._alock" in vs[0].symbol and "AB._block" in vs[0].symbol
+
+
+def test_th002_nonreentrant_self_acquire(tmp_path):
+    repo = _mk(tmp_path, {"megatron_llm_tpu/ab.py": """\
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._alock = threading.Lock()
+                threading.Thread(target=self.outer, name="w",
+                                 daemon=True).start()
+
+            def outer(self):
+                with self._alock:
+                    self.inner()
+
+            def inner(self):
+                with self._alock:
+                    pass
+    """})
+    vs = [v for v in threads.check(repo) if v.code == "TH002"]
+    assert vs and "AB._alock->AB._alock" in vs[0].symbol
+    # an RLock makes the same shape legal
+    repo2 = _mk(tmp_path / "r", {"megatron_llm_tpu/ab.py": """\
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._alock = threading.RLock()
+                threading.Thread(target=self.outer, name="w",
+                                 daemon=True).start()
+
+            def outer(self):
+                with self._alock:
+                    self.inner()
+
+            def inner(self):
+                with self._alock:
+                    pass
+    """})
+    assert [v for v in threads.check(repo2) if v.code == "TH002"] == []
+
+
+def test_th002_consistent_order_is_clean(tmp_path):
+    repo = _mk(tmp_path, {"megatron_llm_tpu/ab.py": """\
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+                threading.Thread(target=self.fwd, name="fwd",
+                                 daemon=True).start()
+                threading.Thread(target=self.fwd2, name="fwd2",
+                                 daemon=True).start()
+
+            def fwd(self):
+                with self._alock:
+                    with self._block:
+                        pass
+
+            def fwd2(self):
+                with self._alock:
+                    with self._block:
+                        pass
+    """})
+    assert [v for v in threads.check(repo) if v.code == "TH002"] == []
+
+
+def test_th003_blocking_under_contested_lock(tmp_path):
+    repo = _mk(tmp_path, {"megatron_llm_tpu/svc.py": """\
+        import threading
+        import time
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                threading.Thread(target=self.worker, name="worker",
+                                 daemon=True).start()
+                threading.Thread(target=self.poller, name="poller",
+                                 daemon=True).start()
+
+            def worker(self):
+                with self._lock:
+                    time.sleep(1.0)
+
+            def poller(self):
+                while True:
+                    with self._lock:
+                        pass
+    """})
+    vs = [v for v in threads.check(repo) if v.code == "TH003"]
+    assert vs, "blocking under contested lock not detected"
+    assert "time.sleep" in vs[0].message
+    assert "poller" in vs[0].message
+
+
+def test_th003_clean_when_sleep_is_outside_lock(tmp_path):
+    repo = _mk(tmp_path, {"megatron_llm_tpu/svc.py": """\
+        import threading
+        import time
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                threading.Thread(target=self.worker, name="worker",
+                                 daemon=True).start()
+                threading.Thread(target=self.poller, name="poller",
+                                 daemon=True).start()
+
+            def worker(self):
+                with self._lock:
+                    pass
+                time.sleep(1.0)
+
+            def poller(self):
+                while True:
+                    with self._lock:
+                        pass
+    """})
+    assert [v for v in threads.check(repo) if v.code == "TH003"] == []
+
+
+def test_th004_use_after_drain_daemon(tmp_path):
+    repo = _mk(tmp_path, {"megatron_llm_tpu/pump.py": """\
+        import threading
+        import time
+
+        class Pump:
+            _lock_protected_ = {"total": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stop = False
+                self.total = 0
+                threading.Thread(target=self._run, name="pump",
+                                 daemon=True).start()
+
+            def _run(self):
+                while not self._stop:
+                    time.sleep(0.05)
+                    self.total += 1
+    """})
+    vs = [v for v in threads.check(repo) if v.code == "TH004"]
+    assert vs, "use-after-drain not detected"
+    assert "total" in vs[0].symbol
+    assert "time.sleep" in vs[0].message
+
+
+def test_th004_clean_when_flag_rechecked(tmp_path):
+    repo = _mk(tmp_path, {"megatron_llm_tpu/pump.py": """\
+        import threading
+        import time
+
+        class Pump:
+            _lock_protected_ = {"total": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stop = False
+                self.total = 0
+                threading.Thread(target=self._run, name="pump",
+                                 daemon=True).start()
+
+            def _run(self):
+                while not self._stop:
+                    time.sleep(0.05)
+                    if self._stop:
+                        return
+                    self.total += 1
+    """})
+    assert [v for v in threads.check(repo) if v.code == "TH004"] == []
+
+
+def test_threads_baseline_roundtrip(tmp_path):
+    repo = _mk(tmp_path, _TH001_REPO)
+    vs = [v for v in threads.check(repo) if v.code == "TH001"]
+    assert vs
+    b = Baseline()
+    for v in vs:
+        b.add(v.fingerprint, "fixture: deliberate race for the test")
+    path = str(tmp_path / ".graftlint.json")
+    b.save(path)
+    loaded = Baseline.load(path)
+    unsuppressed, suppressed, stale = run_checkers(repo, loaded,
+                                                   names=["threads"])
+    assert unsuppressed == []
+    assert len(suppressed) == len(vs)
+    assert stale == []
+
+
+def test_threads_fingerprint_is_line_number_free(tmp_path):
+    repo = _mk(tmp_path, _TH001_REPO)
+    fp1 = {v.fingerprint for v in threads.check(repo)}
+    shifted = {"megatron_llm_tpu/shared.py":
+               "# comment pushing every line down\n\n"
+               + textwrap.dedent(_TH001_REPO["megatron_llm_tpu/shared.py"])}
+    repo2 = _mk(tmp_path / "shifted", shifted)
+    assert fp1 == {v.fingerprint for v in threads.check(repo2)}
+
+
+def test_suggest_locks_emits_annotation(tmp_path):
+    repo = _mk(tmp_path, _TH001_REPO)
+    text = threads.suggest_locks(repo)
+    assert "class Shared" in text
+    assert '"count": "_lock"' in text
+    assert "writer-a" in text
+
+
+# ---------------------------------------------------------------------------
+# the real concurrency fixes are regression-guarded by the checker:
+# a synthetic copy of the drain-counter pattern with the fix deleted
+# must turn graft_lint red (TH001), and the fixed shape stays green
+# ---------------------------------------------------------------------------
+
+_DRAIN_FIXED = """\
+    import signal
+    import threading
+    from http.server import BaseHTTPRequestHandler
+
+    class Metrics:
+        _lock_protected_ = {"drained": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.drained = 0
+
+        def note_drained(self):
+            with self._lock:
+                self.drained += 1
+
+    class Server:
+        def __init__(self):
+            self.metrics = Metrics()
+
+        def begin_drain(self):
+            self.metrics.note_drained()
+
+        def run(self):
+            server = self
+
+            class Handler(BaseHTTPRequestHandler):
+                def do_PUT(self):
+                    server.begin_drain()
+
+            signal.signal(signal.SIGTERM,
+                          lambda *_: server.begin_drain())
+"""
+
+
+def test_deleting_the_drain_fix_turns_lint_red(tmp_path):
+    # fixed shape (mirrors ServerMetrics.note_drained): green
+    _mk(tmp_path, {"megatron_llm_tpu/server_sim.py": _DRAIN_FIXED})
+    res = _cli(tmp_path, "--checkers", "threads")
+    assert res.returncode == 0, res.stdout + res.stderr
+    # delete the fix: bump the counter directly, without the lock —
+    # the signal and HTTP-handler roots now race on Metrics.drained
+    broken = _DRAIN_FIXED.replace("self.metrics.note_drained()",
+                                  "self.metrics.drained += 1")
+    _mk(tmp_path / "broken",
+        {"megatron_llm_tpu/server_sim.py": broken})
+    res = _cli(tmp_path / "broken", "--checkers", "threads")
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "TH001" in res.stdout
+    assert "Metrics.drained" in res.stdout
+
+
+# ---------------------------------------------------------------------------
 # markers
 # ---------------------------------------------------------------------------
 
@@ -448,9 +828,107 @@ def test_graft_lint_is_green_over_this_repo():
     """Tier-1 acceptance: the checked-in baseline keeps the real repo
     clean — every violation is either fixed or suppressed with a
     justification.  A red run here means a hot-path host sync, a dead
-    flag, a schema drift, a jax import in a stdlib tool, or a lock
-    violation landed since the last ratchet."""
-    res = subprocess.run([sys.executable, LINT_CLI], capture_output=True,
-                         text=True, timeout=300, cwd=REPO_ROOT)
+    flag, a schema drift, a jax import in a stdlib tool, a lock
+    violation, or a thread-topology race landed since the last
+    ratchet.  --expect-checkers pins the full set (incl. threads) so
+    the gate cannot silently narrow."""
+    res = subprocess.run([sys.executable, LINT_CLI,
+                          "--expect-checkers", "7"],
+                         capture_output=True, text=True, timeout=300,
+                         cwd=REPO_ROOT)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "0 violation(s)" in res.stdout
+    assert "7 checker(s) ran" in res.stdout
+
+
+def test_cli_expect_checkers_guards_narrowed_set(tmp_path):
+    _mk(tmp_path, {"megatron_llm_tpu/empty.py": "x = 1\n"})
+    res = _cli(tmp_path, "--checkers", "locks", "--expect-checkers", "7")
+    assert res.returncode == 2
+    assert "expected >= 7" in res.stderr
+
+
+def test_cli_threads_table_and_doc_agree(tmp_path):
+    """--threads output is embedded verbatim in docs/guide/serving.md
+    ("Threading model"); diffing doc against tool keeps the doc honest
+    when a thread root is added, renamed, or removed."""
+    table = threads.threads_table(Repo(REPO_ROOT))
+    doc = open(os.path.join(REPO_ROOT, "docs", "guide",
+                            "serving.md")).read()
+    missing = [row for row in table.splitlines() if row not in doc]
+    assert not missing, (
+        "docs/guide/serving.md 'Threading model' table is stale; "
+        "regenerate with `python tools/graft_lint.py --threads` and "
+        "paste.  Missing rows:\n" + "\n".join(missing))
+    # CLI smoke on a small fixture root: a second full-repo parse in a
+    # subprocess would add no coverage over the in-process table above.
+    repo = _mk(tmp_path, _TH001_REPO)
+    res = subprocess.run([sys.executable, LINT_CLI, "--threads",
+                          "--root", str(tmp_path)],
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0
+    assert res.stdout.strip() == threads.threads_table(repo).strip()
+
+
+def test_cli_changed_only_reports_only_changed_files(tmp_path):
+    """--changed-only parity: the reported set is exactly the full
+    run's violations intersected with the files changed vs the ref
+    (checkers still analyze the whole repo)."""
+    _mk(tmp_path, {
+        "megatron_llm_tpu/serving/engine.py":
+            _LOCKS_REPO["megatron_llm_tpu/serving/engine.py"],
+        "tools/serve_report.py": "import jax\n",
+    })
+    git = ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    subprocess.run([*git, "add", "-A"], cwd=tmp_path, check=True)
+    subprocess.run([*git, "commit", "-q", "-m", "seed"], cwd=tmp_path,
+                   check=True)
+    # touch only the locks fixture
+    p = tmp_path / "megatron_llm_tpu" / "serving" / "engine.py"
+    p.write_text(p.read_text() + "\n# touched\n")
+
+    full = _cli(tmp_path, "--checkers", "locks,stdlib")
+    assert full.returncode == 1
+    assert "LD001" in full.stdout and "SG001" in full.stdout
+
+    res = _cli(tmp_path, "--checkers", "locks,stdlib",
+               "--changed-only", "HEAD")
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "LD001" in res.stdout and "LD002" in res.stdout
+    assert "SG001" not in res.stdout     # unchanged file not reported
+    # parity: reported lines == full-run lines for the changed file
+    want = sorted(ln for ln in full.stdout.splitlines()
+                  if ln.startswith("megatron_llm_tpu/serving/engine.py"))
+    got = sorted(ln for ln in res.stdout.splitlines()
+                 if ": LD" in ln or ": SG" in ln)
+    assert got == want
+
+
+def test_cli_changed_only_clean_when_no_violating_file_changed(tmp_path):
+    _mk(tmp_path, {"tools/serve_report.py": "import jax\n",
+                   "megatron_llm_tpu/ok.py": "x = 1\n"})
+    git = ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    subprocess.run([*git, "add", "-A"], cwd=tmp_path, check=True)
+    subprocess.run([*git, "commit", "-q", "-m", "seed"], cwd=tmp_path,
+                   check=True)
+    p = tmp_path / "megatron_llm_tpu" / "ok.py"
+    p.write_text("x = 2\n")
+    res = _cli(tmp_path, "--checkers", "stdlib",
+               "--changed-only", "HEAD")
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_sweep_wave0_pins_the_checker_count():
+    """tools/tpu_sweep.py's wave-0 static gate must assert the full
+    checker set ran — a narrowed set silently skipping the threads
+    checker would pass an otherwise red sweep."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    try:
+        import tpu_sweep
+    finally:
+        sys.path.pop(0)
+    step = next(s for s in tpu_sweep.MANIFEST if s.name == "graft_lint")
+    assert step.wave == 0
+    assert "--expect-checkers 7" in step.cmd

@@ -381,7 +381,9 @@ def test_supervisor_registers_and_reports_fleet_stats(fleet, tmp_path):
     assert [e["event"] for e in events] == \
         ["replica_spawned", "replica_spawned"]
     for e in events:
-        assert e["kind"] == "fleet" and e["schema"] == 7
+        from megatron_llm_tpu.telemetry import TELEMETRY_SCHEMA_VERSION
+        assert e["kind"] == "fleet" and \
+            e["schema"] == TELEMETRY_SCHEMA_VERSION
         assert e["slot"].startswith("replica-")
         assert e["url"].startswith("http://127.0.0.1:")
 

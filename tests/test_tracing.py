@@ -546,6 +546,84 @@ def test_server_metrics_accounting():
     assert ServerMetrics().snapshot()["latency_p50_secs"] is None
 
 
+def test_server_metrics_concurrent_hooks_and_drain():
+    """ServerMetrics is fed by the engine loop (request_done hook),
+    bumped from HTTP-handler/signal contexts (note_drained), and read
+    by /metrics threads — graft-lint threads/TH001 forced all three
+    under ``_lock``.  Hammer them concurrently and require exact
+    totals plus internally consistent snapshots."""
+    m = ServerMetrics()
+    rec = {"ttft_secs": 0.01, "tpot_secs": 0.002, "latency_secs": 0.05,
+           "phases": {"queue_secs": 0.001}}
+    n, feeders = 200, 4
+    snaps = []
+
+    def feed():
+        for _ in range(n):
+            m.observe_request_done(rec)
+
+    def drain():
+        for _ in range(n):
+            m.note_drained()
+
+    def read():
+        for _ in range(50):
+            snaps.append(m.snapshot())
+
+    workers = [threading.Thread(target=feed) for _ in range(feeders)]
+    workers += [threading.Thread(target=drain),
+                threading.Thread(target=read)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    s = m.snapshot()
+    assert s["drained"] == n
+    for name in ("ttft_secs", "tpot_secs", "e2e_secs",
+                 "queue_wait_secs"):
+        assert s["histograms"][name]["count"] == n * feeders
+    # every mid-flight snapshot saw a consistent histogram: the bucket
+    # counts it carries sum to the count it reports
+    for sn in snaps:
+        h = sn["histograms"]["e2e_secs"]
+        assert sum(h["buckets"].values()) == h["count"]
+
+
+def test_engine_watchdog_heartbeat_is_cross_thread_safe():
+    """EngineWatchdog._last_progress is written by the engine loop and
+    read by the watchdog's own thread (TH001 fix: both sides under
+    ``_lock``).  A heartbeating 'engine' must never trip the watchdog;
+    silencing the heartbeat must."""
+    from megatron_llm_tpu.serving.resilience import EngineWatchdog
+
+    fired = threading.Event()
+    wd = EngineWatchdog(timeout_secs=0.2, has_work=lambda: True,
+                        on_fire=fired.set, printer=lambda *_: None)
+    wd.start()
+    beating = threading.Event()
+    beating.set()
+
+    def engine_loop():
+        while beating.is_set():
+            wd.progress()
+            time.sleep(0.01)
+
+    t = threading.Thread(target=engine_loop, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.6)
+        assert not fired.is_set(), \
+            "watchdog fired despite a live heartbeat"
+        beating.clear()
+        t.join()
+        assert fired.wait(timeout=5.0), \
+            "watchdog never fired after the heartbeat stopped"
+        assert wd.fires >= 1
+    finally:
+        beating.clear()
+        wd.stop()
+
+
 def test_server_health_and_metrics_endpoints():
     """GET /health and /metrics answer without touching the model (the
     generator is never invoked), so a None model is fine."""

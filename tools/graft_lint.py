@@ -10,17 +10,22 @@ justification).  Green at HEAD by construction; new violations ratchet.
     python tools/graft_lint.py --checkers locks,flags
     python tools/graft_lint.py --list              # checker catalogue
     python tools/graft_lint.py --record-schema     # after a schema bump
+    python tools/graft_lint.py --threads           # thread topology table
+    python tools/graft_lint.py --suggest-locks     # TH001 -> annotations
+    python tools/graft_lint.py --changed-only main # report changed files
 
 Checkers: recompile (host-sync/retrace hazards reachable from
 jax.jit/shard_map), flags (arguments.py wiring + dead config fields),
 telemetry (request_done/JSON_SCHEMA_KEYS/golden-test agreement +
 version-bump ratchet), stdlib (stdlib-only gate for tools/), locks
-(serving lock discipline), markers (pytest marker registration).
+(serving lock discipline), threads (thread-topology races/deadlocks),
+markers (pytest marker registration).
 See docs/guide/static_analysis.md.
 """
 
 import argparse
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -51,9 +56,41 @@ def parse_args(argv=None):
                    help="re-record the telemetry (version, keys) "
                         "snapshot into the baseline after a conscious "
                         "TELEMETRY_SCHEMA_VERSION bump, then lint")
+    p.add_argument("--threads", action="store_true",
+                   help="print the discovered thread topology table "
+                        "and exit (docs/guide/serving.md embeds it)")
+    p.add_argument("--suggest-locks", action="store_true",
+                   help="print ready-to-paste _lock_protected_ "
+                        "annotations for every TH001 finding "
+                        "(baseline ignored) and exit")
+    p.add_argument("--changed-only", metavar="REF", default=None,
+                   help="only REPORT violations in files changed vs "
+                        "the given git ref (checkers still analyze "
+                        "the whole repo — cross-file topology needs "
+                        "it); suppressed/stale accounting unchanged")
+    p.add_argument("--expect-checkers", type=int, default=None,
+                   metavar="N",
+                   help="exit 2 unless at least N checkers ran "
+                        "(sweep guard against a silently-narrowed "
+                        "checker set)")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="violations only, no summary")
     return p.parse_args(argv)
+
+
+def _changed_files(root: str, ref: str):
+    """Repo-relative paths changed vs ``ref`` (committed + worktree).
+    Returns None (= report everything) when git is unavailable."""
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    return {ln.strip().replace(os.sep, "/")
+            for ln in out.stdout.splitlines() if ln.strip()}
 
 
 def main(argv=None) -> int:
@@ -75,6 +112,16 @@ def main(argv=None) -> int:
         print(f"graft-lint: baseline error: {e}", file=sys.stderr)
         return 2
 
+    if args.threads:
+        from megatron_llm_tpu.analysis import threads as threads_mod
+        print(threads_mod.threads_table(repo))
+        return 0
+
+    if args.suggest_locks:
+        from megatron_llm_tpu.analysis import threads as threads_mod
+        print(threads_mod.suggest_locks(repo))
+        return 0
+
     if args.record_schema:
         snap = telemetry_schema.record_snapshot(repo, baseline)
         baseline.save(baseline_path)
@@ -93,6 +140,21 @@ def main(argv=None) -> int:
         print(f"graft-lint: {e}", file=sys.stderr)
         return 2
 
+    ran = len(names) if names else len(CHECKERS)
+    if args.expect_checkers is not None and ran < args.expect_checkers:
+        print(f"graft-lint: only {ran} checker(s) ran, expected "
+              f">= {args.expect_checkers}", file=sys.stderr)
+        return 2
+
+    if args.changed_only:
+        changed = _changed_files(root, args.changed_only)
+        if changed is None:
+            print(f"graft-lint: cannot diff against "
+                  f"{args.changed_only!r}; reporting everything",
+                  file=sys.stderr)
+        else:
+            unsuppressed = [v for v in unsuppressed if v.path in changed]
+
     for v in repo.parse_errors:
         print(v.render())
     for v in unsuppressed:
@@ -101,9 +163,10 @@ def main(argv=None) -> int:
         for fp in stale:
             print(f"note: stale suppression (matched nothing): {fp}")
         n = len(unsuppressed) + len(repo.parse_errors)
+        scope = ",".join(names) if names else "all checkers"
         print(f"graft-lint: {n} violation(s), {len(suppressed)} "
               f"suppressed, {len(stale)} stale suppression(s) "
-              f"[{','.join(names) if names else 'all checkers'}]")
+              f"[{scope}; {ran} checker(s) ran]")
     return 1 if (unsuppressed or repo.parse_errors) else 0
 
 

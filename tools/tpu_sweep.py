@@ -76,8 +76,8 @@ MANIFEST: List[Step] = [
     # wave 0: static gates — no model, no accelerator, seconds not
     # minutes; a red lint fails the sweep before any compile budget
     # is spent
-    Step("graft_lint", "python tools/graft_lint.py", 120,
-         wave=0, needs_tpu=False),
+    Step("graft_lint", "python tools/graft_lint.py --expect-checkers 7",
+         120, wave=0, needs_tpu=False),
     Step("fusedbwd", "python tools/mfu_sweep.py fusedbwd", 1500, wave=1),
     Step("seq4096", "python tools/mfu_sweep.py seq4096", 1800, wave=1),
     Step("bigvocab", "python tools/mfu_sweep.py bigvocab", 2100, wave=1),
