@@ -14,6 +14,8 @@ from megatron_llm_tpu.serving.kv_blocks import (
     NoCapacity,
     chain_block_digests,
     derive_num_blocks,
+    digest_link,
+    prompt_affinity_digest,
 )
 from megatron_llm_tpu.serving.request import (
     FINISH_NONFINITE,
@@ -43,6 +45,9 @@ from megatron_llm_tpu.serving.supervisor import (
     ReplicaBackend,
     ReplicaInfo,
     Respawn,
+    RouterScaleDown,
+    RouterScaleUp,
+    RouterTierClient,
     ScaleDown,
     ScaleUp,
     ScalingPolicy,
@@ -70,7 +75,10 @@ __all__ = [
     "Request",
     "RequestQueue",
     "Respawn",
+    "RouterScaleDown",
+    "RouterScaleUp",
     "RouterServer",
+    "RouterTierClient",
     "SamplingParams",
     "ScaleDown",
     "ScaleUp",
@@ -79,4 +87,6 @@ __all__ = [
     "ServingFaultInjector",
     "chain_block_digests",
     "derive_num_blocks",
+    "digest_link",
+    "prompt_affinity_digest",
 ]

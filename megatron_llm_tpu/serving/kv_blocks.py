@@ -65,6 +65,21 @@ class NoCapacity(Exception):
     """Not enough free blocks / slots for the requested admission."""
 
 
+def digest_link(prev: bytes, payload: bytes) -> bytes:
+    """One link of the rolling 128-bit blake2b chain: the new digest
+    commits to everything ``prev`` committed to plus ``payload``.
+
+    This is the ONE hash construction shared by the prefix cache (over
+    token-id blocks, below) and the router tier's prompt-affinity digest
+    (over character blocks — ``serving/router.py`` carries a stdlib-only
+    structural twin of this function so it can stay numpy-free; a test
+    pins the two byte-identical)."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(prev)
+    h.update(payload)
+    return h.digest()
+
+
 def chain_block_digests(token_ids: Sequence[int], block_size: int,
                         n_blocks: int) -> List[bytes]:
     """Rolling 128-bit digests for the first ``n_blocks`` full blocks of
@@ -74,12 +89,31 @@ def chain_block_digests(token_ids: Sequence[int], block_size: int,
     prev = b""
     for i in range(n_blocks):
         chunk = token_ids[i * block_size:(i + 1) * block_size]
-        h = hashlib.blake2b(digest_size=16)
-        h.update(prev)
-        h.update(np.asarray(list(chunk), np.int64).tobytes())
-        prev = h.digest()
+        prev = digest_link(
+            prev, np.asarray(list(chunk), np.int64).tobytes())
         out.append(prev)
     return out
+
+
+AFFINITY_CHAR_BLOCK = 64
+
+
+def prompt_affinity_digest(prompt: str, max_chars: int = 256,
+                           char_block: int = AFFINITY_CHAR_BLOCK) -> str:
+    """Chained digest of a prompt's leading characters, for router-tier
+    session affinity.
+
+    The chain walks ``char_block``-sized chunks of ``prompt[:max_chars]``
+    with the same :func:`digest_link` construction the prefix cache uses
+    over token blocks, so two prompts share an affinity digest exactly
+    when they share the hashed prefix — keeping router stickiness and
+    replica prefix-cache locality aligned by construction.  Returns the
+    final digest as hex (stable across processes and hosts)."""
+    prefix = prompt[:max_chars]
+    prev = b""
+    for i in range(0, max(len(prefix), 1), char_block):
+        prev = digest_link(prev, prefix[i:i + char_block].encode("utf-8"))
+    return prev.hex()
 
 
 class BlockManager:

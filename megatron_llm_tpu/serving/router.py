@@ -4,14 +4,30 @@ A stdlib HTTP front-end over N backend engine processes (each a
 ``MegatronServer`` started by ``tools/run_text_generation_server.py``),
 turning single-replica serving into a fleet:
 
-* **Least-loaded dispatch** — requests go to the live backend with the
-  fewest in-flight requests (ties broken by lifetime request count).
-* **Sticky session affinity** — the leading characters of the first
-  prompt key an affinity map, so repeated prefixes (system prompts, chat
-  sessions) return to the replica whose BlockManager already holds their
-  KV pages in its prefix cache (kv_blocks.py).  Affinity is a routing
-  *preference*, not a pin: a dead or throttled sticky backend falls back
-  to least-loaded.
+* **Least-loaded dispatch** — keyless requests go to the live backend
+  with the fewest in-flight requests (ties broken by lifetime request
+  count).
+* **Rendezvous (HRW) prefix affinity** — the leading characters of the
+  first prompt are folded into the same chained blake2b digest the
+  replica-side prefix cache keys its KV pages by (kv_blocks.py), and the
+  digest picks a replica by highest-random-weight hashing over the live
+  set.  Repeated prefixes (system prompts, chat sessions) return to the
+  replica whose BlockManager already holds their pages — and because
+  HRW is a pure function of (digest, live URLs), **N routers agree on
+  the sticky replica with no shared state**: the front door shards
+  horizontally without an affinity gossip protocol.  When a replica
+  joins or leaves, only ~1/N of keys move.  An LRU caches prefix ->
+  digest so the hash chain runs once per distinct prefix (warm path).
+  Affinity is a routing *preference*, not a pin: a dead or throttled
+  sticky backend falls over to the next replica in HRW order (also
+  agreed upon by every router).
+* **Peer awareness** — each router can carry a list of sibling-router
+  URLs (``set_peers``); any one of them answers fleet-wide ``/metrics``
+  by querying its siblings' router-local snapshots and merging
+  histograms bucket-wise (percentiles recomputed from merged buckets,
+  never summed).  Breaker/load/draining state stays per-router,
+  derived independently by each probe thread — eventual agreement, no
+  consensus traffic on the dispatch path.
 * **Circuit breaking** — K consecutive transport failures mark a replica
   dead for an exponentially growing cooldown (capped); the background
   health thread probes ``/health`` and revives it on first success.
@@ -32,14 +48,16 @@ deploys anywhere the backends do, with no extra dependencies.
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import json
+import random
 import threading
 import time
 import uuid
 from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 # request trace header (mirrors text_generation_server.TRACE_HEADER —
 # redeclared so the router stays importable with stdlib alone)
@@ -114,9 +132,10 @@ class AllBackendsThrottled(Exception):
         self.body = body
 
 
-def _affinity_key(body: bytes, max_chars: int) -> Optional[str]:
-    """Sticky key: leading characters of the first prompt.  Shared
-    prefixes map to the same key -> same replica -> its prefix cache."""
+def _affinity_prefix(body: bytes, max_chars: int) -> Optional[str]:
+    """Leading characters of the first prompt — the raw material of the
+    sticky key.  Shared prefixes map to the same digest -> same replica
+    -> its prefix cache."""
     try:
         prompts = json.loads(body or b"{}").get("prompts")
         if isinstance(prompts, list) and prompts \
@@ -125,6 +144,120 @@ def _affinity_key(body: bytes, max_chars: int) -> Optional[str]:
     except (ValueError, AttributeError):
         pass
     return None
+
+
+# --- prompt-affinity digest -------------------------------------------------
+# Structural twin of kv_blocks.digest_link / prompt_affinity_digest, kept
+# local so the router imports nothing beyond stdlib (kv_blocks pulls in
+# numpy).  tests/test_router_rendezvous.py pins the two byte-identical.
+
+_AFFINITY_CHAR_BLOCK = 64
+
+
+def _digest_link(prev: bytes, payload: bytes) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(prev)
+    h.update(payload)
+    return h.digest()
+
+
+def _prompt_affinity_digest(prompt: str, max_chars: int = 256,
+                            char_block: int = _AFFINITY_CHAR_BLOCK) -> str:
+    """Chained 128-bit digest over char-blocks of the prompt prefix —
+    the same rolling construction BlockManager keys its prefix cache
+    with, so router stickiness and replica cache locality stay aligned
+    by construction.  Hex output: stable across processes and hosts."""
+    prefix = prompt[:max_chars]
+    prev = b""
+    for i in range(0, max(len(prefix), 1), char_block):
+        prev = _digest_link(prev, prefix[i:i + char_block].encode("utf-8"))
+    return prev.hex()
+
+
+def rendezvous_order(digest_hex: str, urls: Sequence[str]) -> List[str]:
+    """Highest-random-weight order of ``urls`` for one affinity digest.
+
+    Every router computes this identically from (digest, URL) alone —
+    no shared state, no coordination — so N routers send a given prefix
+    to the same replica AND agree on the failover order.  Removing a URL
+    leaves the relative order of the rest untouched (the HRW property:
+    only the removed replica's keys move, ~1/N of the keyspace)."""
+    raw = bytes.fromhex(digest_hex)
+
+    def score(url: str) -> Tuple[int, str]:
+        h = hashlib.blake2b(digest_size=8)
+        h.update(raw)
+        h.update(url.encode("utf-8"))
+        return int.from_bytes(h.digest(), "big"), url
+
+    return sorted(urls, key=score, reverse=True)
+
+
+# Twin of telemetry.DEFAULT_LATENCY_BUCKETS / Histogram (non-cumulative
+# per-bucket counts keyed by format(bound, "g") + "+Inf"), so the
+# router-side dispatch-latency histogram merges bucket-wise with the
+# replica histograms under _sum_numeric and telemetry.histogram_percentile
+# reads it unchanged.
+_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+_INF_LABEL = "+Inf"
+
+
+class _Hist:
+    """Stdlib histogram with a telemetry-compatible snapshot shape."""
+
+    def __init__(self, bounds: Sequence[float] = _LATENCY_BUCKETS):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.labels = [format(b, "g") for b in self.bounds] + [_INF_LABEL]
+        self.counts = [0] * len(self.labels)
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, value: float) -> None:
+        i = 0
+        while i < len(self.bounds) and value > self.bounds[i]:
+            i += 1
+        self.counts[i] += 1
+        self.count += 1
+        self.total += value
+
+    def snapshot(self) -> Dict[str, object]:
+        return {"count": self.count, "sum": self.total,
+                "buckets": dict(zip(self.labels, self.counts))}
+
+
+def _histogram_percentile(snap: Optional[dict], q: float
+                          ) -> Optional[float]:
+    """Structural twin of telemetry.histogram_percentile (linear
+    interpolation in the winning bucket, +Inf answers its lower edge),
+    redeclared — like supervisor.py's copy — so stdlib-only deployments
+    still get recomputed (never summed) fleet percentiles."""
+    if not _is_histogram(snap):
+        return None
+    total = snap.get("count") or 0
+    if total <= 0:
+        return None
+    items = []
+    for k, v in snap["buckets"].items():
+        try:
+            bound = float(k)
+        except ValueError:
+            bound = float("inf")
+        items.append((bound, int(v)))
+    items.sort()
+    target = max(min(float(q), 1.0), 0.0) * total
+    cum = 0
+    lo = 0.0
+    for bound, c in items:
+        if c > 0 and cum + c >= target:
+            if bound == float("inf"):
+                return lo
+            frac = (target - cum) / c if c else 1.0
+            return lo + (bound - lo) * max(min(frac, 1.0), 0.0)
+        cum += c
+        if bound != float("inf"):
+            lo = bound
+    return lo
 
 
 def _sum_numeric(dst: Dict[str, object], src: Dict[str, object]) -> None:
@@ -185,12 +318,14 @@ class ReplicaRouter:
     against stub backends)."""
 
     # lint-enforced (graft-lint locks/LD002): the HTTP worker threads,
-    # the relay generators, the health prober and the fleet supervisor
-    # all touch these; every mutation must hold self._lock
+    # the relay generators, the health prober, the peer gossip paths and
+    # the fleet supervisor all touch these; every mutation must hold
+    # self._lock
     _lock_protected_ = (
         "requests_total", "failovers_total", "mid_stream_failures_total",
         "throttled_total", "no_backend_total", "affinity_hits",
         "_affinity", "backends", "_brownout_until", "brownout_429s_total",
+        "peers", "_fleet_stats_data", "_dispatch_hist",
     )
 
     def __init__(self, backend_urls: Sequence[str],
@@ -201,6 +336,7 @@ class ReplicaRouter:
                  affinity_max: int = 4096,
                  health_interval_secs: float = 2.0,
                  request_timeout_secs: float = 600.0,
+                 router_id: Optional[str] = None,
                  tracer=None):
         # an empty initial list is legal: a fleet supervisor registers
         # replicas at runtime via add_backend (tools/serve_router.py
@@ -217,7 +353,16 @@ class ReplicaRouter:
         self.affinity_max = int(affinity_max)
         self.health_interval_secs = float(health_interval_secs)
         self.request_timeout_secs = float(request_timeout_secs)
-        self._affinity: "OrderedDict[str, Backend]" = OrderedDict()
+        # stable identity in fleet events / peer-merged metrics; routers
+        # are stateless, so the id is purely observational
+        self.router_id = router_id or f"router-{_new_trace_id()[:8]}"
+        # warm-path LRU: prompt prefix -> affinity digest hex (the chain
+        # runs once per distinct prefix; routing itself derives from the
+        # digest, so the cache is an optimization, never the truth)
+        self._affinity: "OrderedDict[str, str]" = OrderedDict()
+        # sibling-router URLs (never containing this router) for the
+        # peer-merged fleet /metrics view
+        self.peers: List[str] = []
         self._lock = threading.Lock()
         self.requests_total = 0
         self.failovers_total = 0
@@ -234,6 +379,13 @@ class ReplicaRouter:
         # merged into snapshot()["fleet"], so supervisor counters ride
         # the router's /metrics (JSON and Prometheus) for free
         self._fleet_stats_fn = None
+        # out-of-process variant: a supervisor running elsewhere pushes
+        # its stats dict via POST /admin/fleet_stats instead of a hook
+        self._fleet_stats_data: Optional[Dict[str, object]] = None
+        # dispatch-loop latency (request arrival -> response headers /
+        # first stream byte): the front-door saturation signal the
+        # supervisor scales the router tier on
+        self._dispatch_hist = _Hist()
         self._health_thread: Optional[threading.Thread] = None
         self._health_stop = threading.Event()
 
@@ -255,9 +407,11 @@ class ReplicaRouter:
     def remove_backend(self, url: str) -> bool:
         """Deregister a replica (scale-down after drain, or a dead
         process reaped by the supervisor).  In-flight relays holding the
-        Backend object finish against it harmlessly; affinity entries
-        pointing at it are purged so sticky routing never resurrects a
-        removed address.  Returns False when the URL is unknown."""
+        Backend object finish against it harmlessly; sticky keys remap
+        by themselves — rendezvous hashing over the remaining URLs never
+        resurrects a removed address, and only the removed replica's
+        share (~1/N) of keys moves.  Returns False when the URL is
+        unknown."""
         nb = Backend(url)
         with self._lock:
             victim = None
@@ -268,9 +422,6 @@ class ReplicaRouter:
             if victim is None:
                 return False
             self.backends.remove(victim)
-            for key in [k for k, v in self._affinity.items()
-                        if v is victim]:
-                del self._affinity[key]
         return True
 
     def backends_list(self) -> List[Backend]:
@@ -278,6 +429,24 @@ class ReplicaRouter:
         probe/metrics paths — add/remove may reshape the list mid-walk."""
         with self._lock:
             return list(self.backends)
+
+    # -- peer awareness -------------------------------------------------
+
+    def set_peers(self, urls: Sequence[str]) -> List[str]:
+        """Replace the sibling-router list (supervisor rebroadcasts it
+        whenever the tier reshapes).  URLs are normalized the same way
+        backend URLs are, so comparisons are canonical."""
+        normalized = []
+        for u in urls:
+            if u and u.strip():
+                normalized.append(Backend(u.strip()).url)
+        with self._lock:
+            self.peers = normalized
+        return normalized
+
+    def peers_list(self) -> List[str]:
+        with self._lock:
+            return list(self.peers)
 
     # -- brownout --------------------------------------------------------
 
@@ -303,36 +472,60 @@ class ReplicaRouter:
         appear under ``snapshot()["fleet"]`` on /metrics."""
         self._fleet_stats_fn = fn
 
+    def set_fleet_stats_data(self, data: Optional[Dict[str, object]]
+                             ) -> None:
+        """Out-of-process variant of ``set_fleet_stats``: a supervisor
+        running in another process pushes its stats dict here (POST
+        /admin/fleet_stats) so this router's /metrics still carries the
+        fleet block.  The in-process hook, when set, wins."""
+        with self._lock:
+            self._fleet_stats_data = dict(data) if data else None
+
     # -- candidate selection --------------------------------------------
 
-    def _candidates(self, affinity_key: Optional[str]) -> List[Backend]:
-        """Live backends, sticky replica first, rest least-loaded.
-        Draining replicas are alive but excluded — they are finishing
-        their in-flight work on the way to a clean exit."""
+    def _affinity_digest(self, body: Optional[bytes]) -> Optional[str]:
+        """Affinity digest of a request body, through the warm-path LRU
+        (prefix -> digest; the chain runs once per distinct prefix).
+        ``affinity_hits`` counts cache hits — i.e. repeated prefixes —
+        which is what makes affinity-hit parity comparable across
+        independently-running routers."""
+        prefix = _affinity_prefix(body or b"", self.affinity_chars)
+        if prefix is None:
+            return None
+        with self._lock:
+            cached = self._affinity.get(prefix)
+            if cached is not None:
+                self.affinity_hits += 1
+                self._affinity.move_to_end(prefix)
+                return cached
+        digest = _prompt_affinity_digest(prefix, self.affinity_chars)
+        with self._lock:
+            self._affinity[prefix] = digest
+            self._affinity.move_to_end(prefix)
+            while len(self._affinity) > self.affinity_max:
+                self._affinity.popitem(last=False)
+        return digest
+
+    def _candidates(self, digest: Optional[str]) -> List[Backend]:
+        """Live backends in dispatch order.  Keyed requests follow the
+        rendezvous order of the affinity digest — a pure function of
+        (digest, live URLs), so every router in the tier independently
+        agrees on both the sticky replica and the failover sequence.
+        Keyless requests stay least-loaded.  Draining replicas are alive
+        but excluded — they are finishing their in-flight work on the
+        way to a clean exit."""
         now = time.monotonic()
         with self._lock:
             live = [b for b in self.backends
                     if b.available(self.fail_threshold, now)
                     and not b.draining]
-            live.sort(key=lambda b: (b.in_flight, b.requests))
-            sticky = (self._affinity.get(affinity_key)
-                      if affinity_key else None)
-            if sticky is not None and sticky in live:
-                live.remove(sticky)
-                live.insert(0, sticky)
-                self.affinity_hits += 1
-                self._affinity.move_to_end(affinity_key)
+            if digest is not None and live:
+                order = {u: i for i, u in enumerate(
+                    rendezvous_order(digest, [b.url for b in live]))}
+                live.sort(key=lambda b: order[b.url])
+            else:
+                live.sort(key=lambda b: (b.in_flight, b.requests))
         return live
-
-    def _remember_affinity(self, key: Optional[str], backend: Backend
-                           ) -> None:
-        if key is None:
-            return
-        with self._lock:
-            self._affinity[key] = backend
-            self._affinity.move_to_end(key)
-            while len(self._affinity) > self.affinity_max:
-                self._affinity.popitem(last=False)
 
     # -- breaker --------------------------------------------------------
 
@@ -385,9 +578,9 @@ class ReplicaRouter:
             trace_id = _new_trace_id()
         t_route = time.perf_counter()
         attempts = 0
-        key = _affinity_key(body or b"", self.affinity_chars) \
+        digest = self._affinity_digest(body) \
             if method in ("PUT", "POST") else None
-        cands = self._candidates(key)
+        cands = self._candidates(digest)
         throttle_bodies: List[dict] = []
         for b in cands:
             attempts += 1
@@ -428,12 +621,14 @@ class ReplicaRouter:
                 except ValueError:
                     throttle_bodies.append({})
                 continue
-            self._remember_affinity(key, b)
+            secs = time.perf_counter() - t_route
+            with self._lock:
+                self._dispatch_hist.observe(secs)
             if self.tracer is not None:
                 self.tracer.completed(
-                    "route_request", "serve", t_route,
-                    time.perf_counter() - t_route, trace=trace_id,
-                    backend=b.url, status=status, attempts=attempts)
+                    "route_request", "serve", t_route, secs,
+                    trace=trace_id, backend=b.url, status=status,
+                    attempts=attempts)
             return status, headers, data
         if throttle_bodies:
             raise AllBackendsThrottled(
@@ -491,8 +686,8 @@ class ReplicaRouter:
             trace_id = _new_trace_id()
         t_route = time.perf_counter()
         attempts = 0
-        key = _affinity_key(body or b"", self.affinity_chars)
-        cands = self._candidates(key)
+        digest = self._affinity_digest(body)
+        cands = self._candidates(digest)
         throttle_bodies: List[dict] = []
         for b in cands:
             attempts += 1
@@ -526,7 +721,12 @@ class ReplicaRouter:
                     throttle_bodies.append({})
                 continue
             headers = dict(resp.getheaders())
-            self._remember_affinity(key, b)
+            # headers are out: first-byte latency is the router's
+            # dispatch cost for a stream (the relay itself is replica
+            # decode time, not front-door saturation)
+            with self._lock:
+                self._dispatch_hist.observe(
+                    time.perf_counter() - t_route)
             tracer = self.tracer
             n_attempts = attempts
 
@@ -631,7 +831,13 @@ class ReplicaRouter:
             return
 
         def loop():
-            while not self._health_stop.wait(self.health_interval_secs):
+            # jittered period (±50%): N routers each probe every replica,
+            # and identical intervals would lock their probe bursts into
+            # a thundering herd hitting all replicas at once — desynced
+            # phases spread the load and the detection latency stays
+            # health_interval_secs in expectation
+            while not self._health_stop.wait(
+                    self.health_interval_secs * random.uniform(0.5, 1.5)):
                 try:
                     self.probe_once()
                 except Exception:   # noqa: BLE001 - probe must survive
@@ -656,13 +862,21 @@ class ReplicaRouter:
                        for b in self.backends)
 
     def affinity_counts(self) -> Dict[str, int]:
-        """Sticky-prefix entries per backend URL — the supervisor's
-        coldness signal (fewest entries = coldest, cheapest to drain)."""
+        """Sticky keys per backend URL — the supervisor's coldness
+        signal (fewest entries = coldest, cheapest to drain).  Derived,
+        not stored: each warm digest is assigned to its current
+        rendezvous winner among the live backends, so the counts track
+        membership changes the way real dispatches would."""
+        now = time.monotonic()
         with self._lock:
             counts: Dict[str, int] = {b.url: 0 for b in self.backends}
-            for bk in self._affinity.values():
-                if bk.url in counts:
-                    counts[bk.url] += 1
+            live = [b.url for b in self.backends
+                    if b.available(self.fail_threshold, now)
+                    and not b.draining]
+            for digest in self._affinity.values():
+                if not live:
+                    break
+                counts[rendezvous_order(digest, live)[0]] += 1
         return counts
 
     def snapshot(self) -> Dict[str, object]:
@@ -672,7 +886,11 @@ class ReplicaRouter:
             affinity_entries = len(self._affinity)
             brownout_remaining = max(
                 self._brownout_until - time.monotonic(), 0.0)
+            dispatch_hist = self._dispatch_hist.snapshot()
+            peers_total = len(self.peers)
         snap = {
+            "router_id": self.router_id,
+            "peers_total": peers_total,
             "backends_total": len(backends),
             "backends_alive": self.alive_count(),
             "backends_draining": sum(int(b.draining) for b in backends),
@@ -686,6 +904,11 @@ class ReplicaRouter:
             "brownout_active": int(brownout_remaining > 0),
             "brownout_remaining_secs": round(brownout_remaining, 3),
             "brownout_429s_total": self.brownout_429s_total,
+            "inflight_requests": sum(b.in_flight for b in backends),
+            # telemetry-shaped, so a peer merge sums these bucket-wise
+            # exactly like replica histograms (and percentiles get
+            # recomputed from the merged buckets, never summed)
+            "histograms": {"router_dispatch_secs": dispatch_hist},
             "backends": {
                 f"backend_{i}": dict(
                     b.snapshot(self.fail_threshold),
@@ -700,6 +923,11 @@ class ReplicaRouter:
                 fleet = None
             if isinstance(fleet, dict):
                 snap["fleet"] = fleet
+        if "fleet" not in snap:
+            with self._lock:
+                pushed = self._fleet_stats_data
+            if isinstance(pushed, dict):
+                snap["fleet"] = pushed
         return snap
 
     def aggregated_metrics(self) -> Dict[str, object]:
@@ -752,6 +980,85 @@ class ReplicaRouter:
             aggregate["per_replica"] = per_replica
         return {"router": self.snapshot(), "aggregate": aggregate,
                 "backends": per_backend}
+
+    def _get_json(self, url: str, path: str) -> Optional[dict]:
+        """GET a JSON document from a sibling router; None on any
+        transport/parse trouble (a dead peer must not fail the view)."""
+        p = urlparse(url)
+        conn = http.client.HTTPConnection(
+            p.hostname, p.port,
+            timeout=min(self.request_timeout_secs, 5.0))
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                resp.read()
+                return None
+            return json.loads(resp.read() or b"{}")
+        except (OSError, http.client.HTTPException, ValueError):
+            return None
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _tier_view(rsnap: Dict[str, object]) -> Dict[str, object]:
+        """A router snapshot reduced to what merges meaningfully across
+        the tier: counters and histograms.  Per-backend breaker detail
+        stays in the per-router views — every router watches the SAME
+        replicas, so summing those across siblings would double-count."""
+        return {k: v for k, v in rsnap.items()
+                if k not in ("backends", "fleet")}
+
+    def fleet_metrics(self) -> Dict[str, object]:
+        """Fleet-wide view answerable at ANY single router.
+
+        The replica ``aggregate`` is computed locally — every router
+        probes every replica, so the block is identical at each sibling
+        (up to probe skew) and merging it across peers would
+        double-count.  What DOES merge is the router tier itself: each
+        sibling's router-local snapshot (``?scope=router`` — one hop,
+        never fans out again, so there is no gossip recursion), counters
+        summed and histograms merged bucket-wise with the same
+        ``_sum_numeric`` the replica aggregate uses, tier percentiles
+        recomputed from the merged buckets (PR 9 semantics: percentiles
+        never sum)."""
+        out = self.aggregated_metrics()
+        local = out["router"]
+        per_router: Dict[str, object] = {"router_0": local}
+        merged: Dict[str, object] = {}
+        _sum_numeric(merged, self._tier_view(local))
+        peers = self.peers_list()
+        reporting = 1
+        for i, url in enumerate(peers):
+            snap = self._get_json(url, "/metrics?scope=router")
+            rsnap = snap.get("router") if isinstance(snap, dict) else None
+            per_router[f"router_{i + 1}"] = rsnap
+            if isinstance(rsnap, dict):
+                _sum_numeric(merged, self._tier_view(rsnap))
+                reporting += 1
+        hists = merged.get("histograms")
+        if isinstance(hists, dict):
+            try:
+                from megatron_llm_tpu.telemetry import (
+                    histogram_percentile as pctl,
+                )
+            except ImportError:
+                pctl = _histogram_percentile
+            slo: Dict[str, object] = {}
+            for name, h in hists.items():
+                if not _is_histogram(h):
+                    continue
+                for q, tag in ((0.50, "p50"), (0.95, "p95"),
+                               (0.99, "p99")):
+                    slo[f"{name}_{tag}"] = pctl(h, q)
+            merged["slo"] = slo
+        out["router_tier"] = {
+            "routers_total": 1 + len(peers),
+            "routers_reporting": reporting,
+            "merged": merged,
+            "per_router": per_router,
+        }
+        return out
 
 
 class RouterServer:
@@ -865,7 +1172,50 @@ class RouterServer:
                     for _ in chunks:    # drain so counters settle
                         pass
 
-            do_POST = do_PUT
+            def do_POST(self):
+                if self.path.startswith("/admin/"):
+                    self._do_admin()
+                    return
+                self.do_PUT()
+
+            def _do_admin(self):
+                """Control surface for an out-of-process supervisor:
+                replica membership, sibling-peer list, brownout, and
+                pushed fleet stats.  Same-trust-domain tooling — the
+                router has no auth story, as with /metrics."""
+                try:
+                    body = json.loads(self._body() or b"{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                except ValueError as exc:
+                    self._send_json(400, {"message": str(exc)})
+                    return
+                if self.path == "/admin/backends":
+                    added = [router.add_backend(u).url
+                             for u in body.get("add", [])]
+                    removed = [u for u in body.get("remove", [])
+                               if router.remove_backend(u)]
+                    self._send_json(200, {
+                        "added": added, "removed": removed,
+                        "backends": [b.url
+                                     for b in router.backends_list()]})
+                elif self.path == "/admin/peers":
+                    peers = router.set_peers(body.get("peers", []))
+                    self._send_json(200, {"peers": peers})
+                elif self.path == "/admin/brownout":
+                    if body.get("end"):
+                        router.end_brownout()
+                    else:
+                        router.begin_brownout(
+                            float(body.get("eta_secs", 0.0)))
+                    self._send_json(200, {
+                        "brownout_remaining_secs": round(
+                            router.brownout_remaining(), 3)})
+                elif self.path == "/admin/fleet_stats":
+                    router.set_fleet_stats_data(body or None)
+                    self._send_json(200, {"ok": 1})
+                else:
+                    self.send_error(404)
 
             def do_GET(self):
                 if self.path == "/health":
@@ -880,12 +1230,21 @@ class RouterServer:
                         "backends_total": len(backends)})
                 elif self.path == "/metrics" \
                         or self.path.startswith("/metrics?"):
-                    snap = router.aggregated_metrics()
+                    scope = parse_qs(urlparse(self.path).query).get(
+                        "scope", [""])[0]
+                    if scope == "router":
+                        # one-hop sibling query: the router's own
+                        # snapshot only, no replica probing, no fan-out
+                        snap = {"router": router.snapshot()}
+                    elif scope == "local" or not router.peers_list():
+                        snap = router.aggregated_metrics()
+                    else:
+                        snap = router.fleet_metrics()
                     if _wants_prometheus(self.path,
                                          self.headers.get("Accept", "")):
                         flat = {"router": _numeric_only(snap["router"]),
                                 "aggregate": _numeric_only(
-                                    snap["aggregate"])}
+                                    snap.get("aggregate", {}))}
                         data = prometheus_exposition(
                             flat, prefix="megatron_router_").encode()
                         self.send_response(200)
@@ -906,6 +1265,11 @@ class RouterServer:
         server = ThreadingHTTPServer((host, port), Handler)
         self.httpd = server     # exposed for tests (port may be 0)
         router.start_health_thread()
-        print(f" * routing {len(router.backends)} backends on "
-              f"http://{host}:{server.server_address[1]}/api", flush=True)
+        # one atomic PORT line: the same handshake replicas speak, so a
+        # supervisor can spawn routers with --port 0 through
+        # LocalProcessBackend and scrape the chosen port from stdout
+        print(f"PORT {server.server_address[1]}\n"
+              f" * routing {len(router.backends)} backends on "
+              f"http://{host}:{server.server_address[1]}/api",
+              flush=True)
         server.serve_forever()

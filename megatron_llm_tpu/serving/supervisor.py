@@ -25,6 +25,15 @@ module drives them:
   an honest ``retry_after`` derived from the spawn ETA (see
   ``ReplicaRouter.begin_brownout``), shedding load deterministically
   instead of letting streams time out.
+* **Router tier** — the front door itself is supervised (PR 16): with
+  ``router_backend`` set, the supervisor spawns N router *processes*
+  (stateless by construction — rendezvous affinity needs no shared
+  state), respawns dead ones with the same storm-capped per-slot
+  backoff replicas get, scales the tier on front-door saturation
+  (windowed dispatch-latency p95 / summed router in-flight), and talks
+  to the tier through :class:`RouterTierClient`, which fans the same
+  add/remove/brownout surface out over every live router's ``/admin``
+  endpoints and keeps peer lists + replica membership synchronized.
 
 Everything here is host-side policy over already-running engines: the
 zero-steady-state-recompile property of the serving stack is untouched,
@@ -48,6 +57,7 @@ from urllib.parse import urlparse
 __all__ = [
     "FleetSnapshot", "FleetSupervisor", "LocalProcessBackend",
     "PolicyConfig", "ReplicaBackend", "ReplicaInfo", "Respawn",
+    "RouterScaleDown", "RouterScaleUp", "RouterTierClient",
     "ScaleDown", "ScaleUp", "ScalingPolicy",
 ]
 
@@ -156,6 +166,11 @@ class FleetSnapshot:
     ttft_p95_secs: Optional[float] = None   # windowed (last poll delta)
     queue_depth: int = 0                    # fleet-summed engine queues
     spawns_in_flight: int = 0
+    # router tier (empty / defaults when the tier is unmanaged)
+    routers: List[ReplicaInfo] = field(default_factory=list)
+    router_dispatch_p95_secs: Optional[float] = None  # windowed
+    router_inflight: int = 0            # summed across live routers
+    router_spawns_in_flight: int = 0
 
 
 @dataclass
@@ -175,6 +190,14 @@ class PolicyConfig:
     respawn_storm_window_secs: float = 60.0
     dead_confirmation_secs: float = 3.0  # breaker-open grace before a
     #                                      live-process replica is dead
+    # router tier (max_routers == 0 leaves the tier unmanaged — the
+    # legacy single in-process router of tools/serve_fleet.py)
+    min_routers: int = 0
+    max_routers: int = 0
+    router_dispatch_p95_slo_secs: float = 0.25  # scale up when the
+    #   windowed router dispatch-loop p95 sustains above this...
+    router_inflight_high: int = 64      # ...or the summed router
+    #   in-flight (connection-queue proxy) sustains at/above this
 
 
 @dataclass
@@ -189,8 +212,20 @@ class ScaleDown:
 
 @dataclass
 class Respawn:
+    """Replace a dead replica OR router under its stable slot (router
+    slots are ``router-N``); both share the storm-capped backoff."""
     slot: str
     backoff_secs: float = 0.0
+
+
+@dataclass
+class RouterScaleUp:
+    reason: str
+
+
+@dataclass
+class RouterScaleDown:
+    victim: str     # slot of the emptiest ready router
 
 
 @dataclass
@@ -211,6 +246,13 @@ class ScalingPolicy:
         self._breach_since: Optional[float] = None
         self._idle_since: Optional[float] = None
         self._last_scale: Optional[float] = None
+        # router tier runs its own breach/idle/cooldown timeline; the
+        # respawn backoff map is shared on purpose — "router-N" and
+        # "replica-N" slots never collide and both deserve the same
+        # storm capping
+        self._router_breach_since: Optional[float] = None
+        self._router_idle_since: Optional[float] = None
+        self._last_router_scale: Optional[float] = None
         self._respawn: Dict[str, _RespawnState] = {}
 
     # -- respawn backoff ------------------------------------------------
@@ -304,6 +346,78 @@ class ScalingPolicy:
             actions.append(ScaleDown(coldest.slot))
             self._last_scale = now
             self._idle_since = None
+
+        if cfg.max_routers > 0:
+            actions.extend(self._decide_routers(snap))
+        return actions
+
+    def _decide_routers(self, snap: FleetSnapshot) -> List[object]:
+        """Router-tier decisions: same shape as the replica logic —
+        respawn first (per-slot storm-capped backoff, never throttled by
+        the scale cooldown), then sustained-breach scale-up / sustained-
+        idle scale-down with hysteresis.  Routers have no breaker or
+        drain phase: a router is dead exactly when its process is, and
+        scale-down just deregisters it (stateless by construction — the
+        keys it owned re-rendezvous nowhere, affinity lives on the
+        replicas)."""
+        cfg = self.cfg
+        now = snap.now
+        actions: List[object] = []
+        for r in snap.routers:
+            if r.state == "dead" and r.process_dead \
+                    and self._respawn_due(r.slot, now):
+                actions.append(Respawn(
+                    r.slot, self._note_respawn(r.slot, now)))
+
+        ready = [r for r in snap.routers if r.state == "ready"]
+        population = len(ready) + snap.router_spawns_in_flight
+
+        p95 = snap.router_dispatch_p95_secs
+        breach = (p95 is not None
+                  and p95 > cfg.router_dispatch_p95_slo_secs) \
+            or snap.router_inflight >= cfg.router_inflight_high
+        idle = snap.router_inflight == 0 and (
+            p95 is None
+            or p95 < cfg.scale_down_ttft_frac
+            * cfg.router_dispatch_p95_slo_secs)
+
+        if breach:
+            self._router_breach_since = self._router_breach_since \
+                if self._router_breach_since is not None else now
+            self._router_idle_since = None
+        elif idle:
+            self._router_idle_since = self._router_idle_since \
+                if self._router_idle_since is not None else now
+            self._router_breach_since = None
+        else:
+            self._router_breach_since = None
+            self._router_idle_since = None
+
+        cooled = self._last_router_scale is None \
+            or now - self._last_router_scale >= cfg.scale_cooldown_secs
+
+        if self._router_breach_since is not None \
+                and now - self._router_breach_since >= cfg.breach_secs \
+                and snap.router_spawns_in_flight == 0 \
+                and population < cfg.max_routers \
+                and cooled:
+            actions.append(RouterScaleUp(
+                "router_dispatch_p95" if (
+                    p95 is not None
+                    and p95 > cfg.router_dispatch_p95_slo_secs)
+                else "router_inflight"))
+            self._last_router_scale = now
+            self._router_breach_since = None
+        elif self._router_idle_since is not None \
+                and now - self._router_idle_since \
+                >= cfg.scale_down_idle_secs \
+                and snap.router_spawns_in_flight == 0 \
+                and len(ready) > max(cfg.min_routers, 1) \
+                and cooled:
+            emptiest = min(ready, key=lambda r: (r.in_flight, r.slot))
+            actions.append(RouterScaleDown(emptiest.slot))
+            self._last_router_scale = now
+            self._router_idle_since = None
         return actions
 
 
@@ -399,6 +513,180 @@ class LocalProcessBackend(ReplicaBackend):
 
 
 # ---------------------------------------------------------------------------
+# router-tier client: the supervisor's view of N router processes
+# ---------------------------------------------------------------------------
+
+def _normalize_url(u: str) -> str:
+    """Canonical http://host:port form (mirrors router.Backend's
+    normalization so membership comparisons never miss on formatting)."""
+    if "//" not in u:
+        u = "http://" + u
+    p = urlparse(u)
+    return f"http://{p.hostname}:{p.port}"
+
+
+class RouterTierClient:
+    """Duck-types the ``ReplicaRouter`` surface the supervisor drives
+    (add/remove backend, brownout, snapshot/aggregated_metrics, the
+    fleet-stats hook) against a tier of router *processes*, by fanning
+    each call out over HTTP to every live router's ``/admin`` endpoints.
+
+    The client holds only desired state (which routers are live, which
+    replicas should be registered) — the routers themselves stay
+    stateless and independently derive breaker/load/draining state from
+    their own probe threads.  ``sync()`` runs once per control-loop turn
+    and is idempotent: peers, membership, and pushed fleet stats
+    converge even if an earlier fan-out half-failed.
+
+    All HTTP happens outside ``self._lock`` (graft-lint locks/LD001)."""
+
+    # lint-enforced (graft-lint locks/LD002): the supervisor control
+    # loop and chaos harnesses may drive this concurrently
+    _lock_protected_ = ("router_urls", "backend_urls", "_brownout_eta")
+
+    def __init__(self, timeout_secs: float = 5.0):
+        self.timeout_secs = float(timeout_secs)
+        self.router_urls: List[str] = []
+        self.backend_urls: List[str] = []
+        self._brownout_eta: Optional[float] = None
+        self._stats_fn: Optional[Callable[[], dict]] = None
+        self._lock = threading.Lock()
+
+    # -- plumbing -------------------------------------------------------
+
+    def _request(self, url: str, method: str, path: str,
+                 payload: Optional[dict] = None) -> Optional[dict]:
+        p = urlparse(url)
+        body = json.dumps(payload).encode() if payload is not None \
+            else None
+        try:
+            conn = http.client.HTTPConnection(
+                p.hostname, p.port, timeout=self.timeout_secs)
+            conn.request(method, path, body=body,
+                         headers={"Content-Type": "application/json"}
+                         if body else {})
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            if resp.status != 200:
+                return None
+            return json.loads(data or b"{}")
+        except (OSError, http.client.HTTPException, ValueError):
+            return None
+
+    def _fanout(self, method: str, path: str,
+                payload: Optional[dict] = None) -> int:
+        """Send to every live router; returns how many acknowledged."""
+        with self._lock:
+            routers = list(self.router_urls)
+        return sum(self._request(u, method, path, payload) is not None
+                   for u in routers)
+
+    # -- desired state --------------------------------------------------
+
+    def set_routers(self, urls: Sequence[str]) -> None:
+        """Replace the live-router list (the supervisor reconciles it
+        from process reality every turn)."""
+        with self._lock:
+            self.router_urls = [_normalize_url(u) for u in urls]
+
+    def routers_list(self) -> List[str]:
+        with self._lock:
+            return list(self.router_urls)
+
+    def sync(self) -> None:
+        """Converge every live router onto the desired state: sibling
+        peer lists, replica membership (adds AND removal of stale
+        entries a router learned before a half-failed turn), brownout,
+        and the pushed fleet-stats block for /metrics."""
+        with self._lock:
+            routers = list(self.router_urls)
+            backends = list(self.backend_urls)
+            brownout = self._brownout_eta
+        stats = None
+        if self._stats_fn is not None:
+            try:
+                stats = self._stats_fn()
+            except Exception:   # noqa: BLE001 - stats must not kill sync
+                stats = None
+        for u in routers:
+            self._request(u, "POST", "/admin/peers",
+                          {"peers": [v for v in routers if v != u]})
+            resp = self._request(u, "POST", "/admin/backends",
+                                 {"add": backends})
+            if isinstance(resp, dict):
+                stale = [x for x in resp.get("backends", [])
+                         if x not in backends]
+                if stale:
+                    self._request(u, "POST", "/admin/backends",
+                                  {"remove": stale})
+            if brownout is not None:
+                self._request(u, "POST", "/admin/brownout",
+                              {"eta_secs": brownout})
+            if isinstance(stats, dict):
+                self._request(u, "POST", "/admin/fleet_stats", stats)
+
+    # -- the ReplicaRouter surface the supervisor drives ----------------
+
+    def set_fleet_stats(self, fn: Callable[[], dict]) -> None:
+        self._stats_fn = fn
+
+    def add_backend(self, url: str) -> None:
+        norm = _normalize_url(url)
+        with self._lock:
+            if norm not in self.backend_urls:
+                self.backend_urls.append(norm)
+        self._fanout("POST", "/admin/backends", {"add": [norm]})
+
+    def remove_backend(self, url: str) -> bool:
+        norm = _normalize_url(url)
+        with self._lock:
+            known = norm in self.backend_urls
+            if known:
+                self.backend_urls.remove(norm)
+        self._fanout("POST", "/admin/backends", {"remove": [norm]})
+        return known
+
+    def begin_brownout(self, eta_secs: float) -> None:
+        with self._lock:
+            self._brownout_eta = float(eta_secs)
+        self._fanout("POST", "/admin/brownout",
+                     {"eta_secs": float(eta_secs)})
+
+    def end_brownout(self) -> None:
+        with self._lock:
+            active = self._brownout_eta is not None
+            self._brownout_eta = None
+        if active:      # avoid a per-turn fan-out in the steady state
+            self._fanout("POST", "/admin/brownout", {"end": True})
+
+    def aggregated_metrics(self) -> Dict[str, object]:
+        """The replica-fleet view from the first router that answers —
+        every router probes every replica, so any one of them speaks
+        for the fleet (eventual agreement)."""
+        for u in self.routers_list():
+            snap = self._request(u, "GET", "/metrics?scope=local")
+            if isinstance(snap, dict):
+                return snap
+        return {"router": {}, "aggregate": {}, "backends": {}}
+
+    def snapshot(self) -> Dict[str, object]:
+        router = self.aggregated_metrics().get("router")
+        return router if isinstance(router, dict) else {}
+
+    def router_snapshots(self) -> Dict[str, Optional[dict]]:
+        """Each live router's own one-hop snapshot (``?scope=router``),
+        keyed by URL; None for routers that did not answer."""
+        out: Dict[str, Optional[dict]] = {}
+        for u in self.routers_list():
+            snap = self._request(u, "GET", "/metrics?scope=router")
+            router = snap.get("router") if isinstance(snap, dict) \
+                else None
+            out[u] = router if isinstance(router, dict) else None
+        return out
+
+
+# ---------------------------------------------------------------------------
 # the supervisor
 # ---------------------------------------------------------------------------
 
@@ -428,7 +716,8 @@ class FleetSupervisor:
 
     # lint-enforced (graft-lint locks/LD002): stats() is called from the
     # router's HTTP threads while the control loop mutates these
-    _lock_protected_ = ("replicas", "counters", "events", "_slot_seq")
+    _lock_protected_ = ("replicas", "routers", "counters", "events",
+                        "_slot_seq", "_router_slot_seq")
 
     def __init__(self, router, backend: ReplicaBackend,
                  config: Optional[PolicyConfig] = None,
@@ -436,18 +725,24 @@ class FleetSupervisor:
                  poll_interval_secs: float = 1.0,
                  event_log_path: Optional[str] = None,
                  event_sink: Optional[Callable[[dict], None]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 router_backend: Optional[ReplicaBackend] = None):
         self.router = router
         self.backend = backend
+        self.router_backend = router_backend
         self.config = config or PolicyConfig()
         self.policy = policy or ScalingPolicy(self.config)
         self.poll_interval_secs = float(poll_interval_secs)
         self.clock = clock
         self.replicas: Dict[str, _Replica] = {}
+        self.routers: Dict[str, _Replica] = {}
         self.counters = {
             "spawns_total": 0, "respawns_total": 0, "deaths_total": 0,
             "scale_ups_total": 0, "scale_downs_total": 0,
             "brownouts_total": 0,
+            "router_spawns_total": 0, "router_respawns_total": 0,
+            "router_deaths_total": 0, "router_scale_ups_total": 0,
+            "router_scale_downs_total": 0,
         }
         self.events: "deque[dict]" = deque(maxlen=256)
         self._event_sink = event_sink
@@ -455,7 +750,9 @@ class FleetSupervisor:
             if event_log_path else None
         self._lock = threading.Lock()
         self._slot_seq = 0
+        self._router_slot_seq = 0
         self._prev_ttft_hist: Optional[dict] = None
+        self._prev_router_hist: Optional[dict] = None
         self._spawn_secs_ema: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -514,6 +811,38 @@ class FleetSupervisor:
         return max(ema if ema is not None else self.backend.spawn_eta_secs,
                    1.0)
 
+    # -- router-tier lifecycle -------------------------------------------
+
+    def _new_router_slot(self) -> str:
+        with self._lock:
+            slot = f"router-{self._router_slot_seq}"
+            self._router_slot_seq += 1
+        return slot
+
+    def _spawn_router(self, slot: Optional[str] = None,
+                      respawn: bool = False) -> _Replica:
+        handle = self.router_backend.spawn()    # outside the lock
+        rep = _Replica(slot or self._new_router_slot(), handle,
+                       self.clock(), respawn=respawn)
+        with self._lock:
+            self.routers[rep.slot] = rep
+            self.counters["router_spawns_total"] += 1
+        return rep
+
+    def spawn_initial_routers(self, n: int) -> None:
+        """Bootstrap the router tier (requires ``router_backend``);
+        readiness + peer wiring happen in the control loop."""
+        if self.router_backend is None:
+            raise RuntimeError("no router_backend configured")
+        for _ in range(max(int(n), 0)):
+            self._spawn_router()
+
+    def router_urls(self) -> List[str]:
+        """Live (ready) router front-door URLs, for clients."""
+        with self._lock:
+            reps = list(self.routers.values())
+        return [r.url for r in reps if r.state == "ready" and r.url]
+
     # -- one control-loop turn -------------------------------------------
 
     def run_once(self) -> List[object]:
@@ -522,6 +851,11 @@ class FleetSupervisor:
         now = self.clock()
         with self._lock:
             reps = list(self.replicas.values())
+
+        # 0. reconcile the router tier first, so replica registration
+        # below fans out to every router that just became ready
+        if self.router_backend is not None:
+            self._reconcile_routers(now)
 
         # 1. reconcile process reality with our records
         for rep in reps:
@@ -576,9 +910,53 @@ class FleetSupervisor:
                 self._scale_up(act, snap)
             elif isinstance(act, ScaleDown):
                 self._scale_down(act)
+            elif isinstance(act, RouterScaleUp):
+                self._scale_up_router(act, snap)
+            elif isinstance(act, RouterScaleDown):
+                self._scale_down_router(act)
             elif isinstance(act, Respawn):
-                self._respawn(act)
+                if act.slot.startswith("router-"):
+                    self._respawn_router(act)
+                else:
+                    self._respawn(act)
         return actions
+
+    def _reconcile_routers(self, now: float) -> None:
+        """Poll router processes, mark deaths, and converge the tier
+        client (live list, peer lists, membership, pushed stats)."""
+        with self._lock:
+            routers = list(self.routers.values())
+        for rep in routers:
+            state, url = self.router_backend.poll(rep.handle)
+            if rep.state == "starting":
+                if state == "ready":
+                    rep.url = url
+                    rep.state = "ready"
+                    spawn_secs = now - rep.spawned_at
+                    event = "router_respawned" if rep.respawn \
+                        else "router_spawned"
+                    if rep.respawn:
+                        with self._lock:
+                            self.counters["router_respawns_total"] += 1
+                    self._emit(event, slot=rep.slot, url=url,
+                               spawn_secs=round(spawn_secs, 3))
+                elif state == "dead":
+                    self._mark_router_dead(rep, exited_while="starting")
+            elif rep.state == "ready" and state == "dead":
+                self._mark_router_dead(rep, exited_while="ready")
+        if hasattr(self.router, "set_routers"):
+            self.router.set_routers(
+                [r.url for r in routers
+                 if r.state == "ready" and r.url])
+            self.router.sync()
+
+    def _mark_router_dead(self, rep: _Replica,
+                          exited_while: str) -> None:
+        rep.state = "dead"
+        with self._lock:
+            self.counters["router_deaths_total"] += 1
+        self._emit("router_died", slot=rep.slot, url=rep.url,
+                   exited_while=exited_while)
 
     def _mark_dead(self, rep: _Replica, now: float,
                    exited_while: str) -> None:
@@ -651,10 +1029,58 @@ class FleetSupervisor:
                     info.state = "dead"
                     info.dead_since = rep.breaker_dead_since
             infos.append(info)
-        return FleetSnapshot(now=now, replicas=infos,
+        snap = FleetSnapshot(now=now, replicas=infos,
                              ttft_p95_secs=ttft_p95,
                              queue_depth=queue_depth,
                              spawns_in_flight=spawns_in_flight)
+        if self.router_backend is not None:
+            self._observe_routers(snap)
+        return snap
+
+    def _observe_routers(self, snap: FleetSnapshot) -> None:
+        """Router-tier half of the world view: per-router process state
+        + in-flight, and a *windowed* dispatch-loop p95 over the bucket-
+        wise sum of every live router's ``router_dispatch_secs``."""
+        per_router: Dict[str, Optional[dict]] = {}
+        if hasattr(self.router, "router_snapshots"):
+            try:
+                per_router = self.router.router_snapshots()
+            except Exception:   # noqa: BLE001 - observation must not die
+                per_router = {}
+        merged: Dict[str, object] = {"buckets": {}, "count": 0,
+                                     "sum": 0.0}
+        inflight = 0
+        for rsnap in per_router.values():
+            if not isinstance(rsnap, dict):
+                continue
+            inflight += int(rsnap.get("inflight_requests", 0))
+            hist = rsnap.get("histograms", {}).get(
+                "router_dispatch_secs") \
+                if isinstance(rsnap.get("histograms"), dict) else None
+            if isinstance(hist, dict) \
+                    and isinstance(hist.get("buckets"), dict):
+                for k, v in hist["buckets"].items():
+                    merged["buckets"][k] = \
+                        merged["buckets"].get(k, 0) + int(v)
+                merged["count"] += int(hist.get("count", 0))
+                merged["sum"] += float(hist.get("sum", 0.0))
+        window = _hist_delta(merged, self._prev_router_hist)
+        self._prev_router_hist = merged
+        snap.router_dispatch_p95_secs = _histogram_percentile(
+            window, 0.95)
+        snap.router_inflight = inflight
+        with self._lock:
+            routers = list(self.routers.values())
+        for rep in routers:
+            info = ReplicaInfo(slot=rep.slot, url=rep.url,
+                               state=rep.state,
+                               process_dead=rep.state == "dead")
+            if rep.state == "starting":
+                snap.router_spawns_in_flight += 1
+            rsnap = per_router.get(rep.url) if rep.url else None
+            if isinstance(rsnap, dict):
+                info.in_flight = int(rsnap.get("inflight_requests", 0))
+            snap.routers.append(info)
 
     # -- actions ---------------------------------------------------------
 
@@ -699,6 +1125,45 @@ class FleetSupervisor:
             self.replicas[act.slot] = rep
             self.counters["spawns_total"] += 1
 
+    def _scale_up_router(self, act: RouterScaleUp,
+                         snap: FleetSnapshot) -> None:
+        rep = self._spawn_router()
+        with self._lock:
+            self.counters["router_scale_ups_total"] += 1
+        self._emit("router_scale_up", slot=rep.slot, reason=act.reason,
+                   router_dispatch_p95_secs=snap.router_dispatch_p95_secs,
+                   router_inflight=snap.router_inflight)
+
+    def _scale_down_router(self, act: RouterScaleDown) -> None:
+        """Routers are stateless: deregister from the peer lists (next
+        sync), then kill — no drain phase.  In-flight streams on the
+        victim break and clients retry a sibling, the same contract as
+        a router crash."""
+        with self._lock:
+            rep = self.routers.get(act.victim)
+            if rep is None or rep.state != "ready":
+                return
+            self.routers.pop(act.victim, None)
+            self.counters["router_scale_downs_total"] += 1
+        if hasattr(self.router, "set_routers"):
+            self.router.set_routers(self.router_urls())
+            self.router.sync()
+        self._emit("router_scale_down", slot=rep.slot, url=rep.url)
+        self.router_backend.kill(rep.handle)
+
+    def _respawn_router(self, act: Respawn) -> None:
+        with self._lock:
+            old = self.routers.get(act.slot)
+        if old is None or old.state != "dead":
+            return
+        self.router_backend.kill(old.handle)   # reap (idempotent)
+        handle = self.router_backend.spawn()
+        now = self.clock()
+        with self._lock:
+            rep = _Replica(act.slot, handle, now, respawn=True)
+            self.routers[act.slot] = rep
+            self.counters["router_spawns_total"] += 1
+
     def _post_drain(self, url: str) -> None:
         p = urlparse(url)
         try:
@@ -735,8 +1200,12 @@ class FleetSupervisor:
         if kill_replicas:
             with self._lock:
                 reps = list(self.replicas.values())
+                routers = list(self.routers.values())
             for rep in reps:
                 self.backend.kill(rep.handle)
+            if self.router_backend is not None:
+                for rep in routers:
+                    self.router_backend.kill(rep.handle)
         if self._event_file is not None:
             self._event_file.close()
             self._event_file = None
@@ -748,6 +1217,7 @@ class FleetSupervisor:
         Prometheus) via the fleet-stats hook."""
         with self._lock:
             reps = list(self.replicas.values())
+            routers = list(self.routers.values())
             counters = dict(self.counters)
         out: Dict[str, object] = {
             "replicas_total": len(reps),
@@ -756,6 +1226,8 @@ class FleetSupervisor:
                                      for r in reps),
             "replicas_retiring": sum(r.state == "retiring"
                                      for r in reps),
+            "routers_total": len(routers),
+            "routers_ready": sum(r.state == "ready" for r in routers),
         }
         out.update(counters)
         return out

@@ -429,7 +429,11 @@ def _wants_prometheus(path: str, accept: str) -> bool:
 #    request rode into verify steps and the subset verification
 #    committed) and accept_rate (accepted/drafted, null when the request
 #    never drafted) — see serving/engine.py and serving/drafter.py
-TELEMETRY_SCHEMA_VERSION = 8
+# 9: + router-tier fleet events (router_spawned / router_died /
+#    router_respawned / router_scale_up / router_scale_down, with
+#    slot/url and the dispatch-p95/in-flight readings behind scaling
+#    decisions) — see serving/supervisor.py's sharded front door
+TELEMETRY_SCHEMA_VERSION = 9
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 

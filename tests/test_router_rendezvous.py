@@ -1,0 +1,349 @@
+"""Sharded front door: rendezvous affinity + peer awareness.
+
+Property coverage for the HRW (highest-random-weight) routing that lets
+N stateless routers agree on prefix affinity with no shared state:
+
+* the router's stdlib digest twins are byte-identical to the
+  kv_blocks chained-blake2b construction they mirror
+* removing a backend moves ~1/N of the keyspace and ONLY the removed
+  backend's keys; adding one steals only what it wins
+* independent routers (different membership list order, no
+  communication) send the same prompt to the same replica
+* the keyspace spreads near-uniformly across backends
+* the health-probe period is jittered so N routers don't probe the
+  fleet in lockstep
+* any single router answers a fleet-wide /metrics by merging its
+  siblings' histograms bucket-wise (percentiles recomputed, never
+  summed)
+* serve_bench's client half: multi --url failover on transport errors
+
+Pure-function tests run with zero sockets; the peer/bench tests reuse
+the stub replicas of tests/test_serve_router.py.
+"""
+
+import json
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from megatron_llm_tpu.serving.kv_blocks import (
+    digest_link,
+    prompt_affinity_digest,
+)
+from megatron_llm_tpu.serving.router import (
+    Backend,
+    ReplicaRouter,
+    RouterServer,
+    _digest_link,
+    _prompt_affinity_digest,
+    rendezvous_order,
+)
+
+# the stub replicas (and their factory fixture) from the router tests
+from test_serve_router import (  # noqa: F401  (stubs is a fixture)
+    _free_port,
+    _payload,
+    _prompt_on,
+    stubs,
+)
+
+
+# ---------------------------------------------------------------------------
+# digest twins: the stdlib-pure router must hash exactly like kv_blocks
+# ---------------------------------------------------------------------------
+
+def test_digest_twins_match_kv_blocks():
+    prev = b""
+    for payload in (b"", b"a", b"chunk-1", b"\x00" * 64):
+        assert _digest_link(prev, payload) == digest_link(prev, payload)
+        prev = digest_link(prev, payload)
+    for prompt in ("", "7 7 7 session-x", "x" * 300, "é" * 70):
+        assert _prompt_affinity_digest(prompt) \
+            == prompt_affinity_digest(prompt)
+    # the digest keys the *prefix*: tails beyond max_chars don't matter
+    assert _prompt_affinity_digest("a" * 256 + "x") \
+        == _prompt_affinity_digest("a" * 256 + "y")
+    assert _prompt_affinity_digest("a") != _prompt_affinity_digest("b")
+
+
+# ---------------------------------------------------------------------------
+# rendezvous properties (pure, no sockets)
+# ---------------------------------------------------------------------------
+
+def _urls(n):
+    return [f"http://10.0.0.{i + 1}:5000" for i in range(n)]
+
+
+def _digests(n):
+    return [_prompt_affinity_digest(f"prompt {i}") for i in range(n)]
+
+
+def test_rendezvous_total_order_and_determinism():
+    urls = _urls(4)
+    d = _digests(1)[0]
+    order = rendezvous_order(d, urls)
+    assert sorted(order) == sorted(urls)        # a permutation
+    assert order == rendezvous_order(d, list(reversed(urls)))
+    assert order == rendezvous_order(d, urls)   # stable across calls
+
+
+def test_rendezvous_remove_moves_only_the_victims_keys():
+    urls = _urls(5)
+    digests = _digests(2000)
+    before = {d: rendezvous_order(d, urls)[0] for d in digests}
+    victim = urls[2]
+    survivors = [u for u in urls if u != victim]
+    moved = 0
+    for d in digests:
+        after = rendezvous_order(d, survivors)[0]
+        if before[d] == victim:
+            moved += 1
+        else:
+            # keys NOT owned by the victim never move: their survivor
+            # scores are untouched by the removal
+            assert after == before[d]
+    # the victim owned ~1/5 of the keyspace
+    assert 0.10 < moved / len(digests) < 0.30
+
+
+def test_rendezvous_add_steals_only_what_it_wins():
+    urls = _urls(4)
+    digests = _digests(2000)
+    before = {d: rendezvous_order(d, urls)[0] for d in digests}
+    grown = urls + ["http://10.0.0.99:5000"]
+    stolen = 0
+    for d in digests:
+        after = rendezvous_order(d, grown)[0]
+        if after != before[d]:
+            assert after == grown[-1]   # only the newcomer takes keys
+            stolen += 1
+    # ~1/5 of the keyspace lands on the 5th backend
+    assert 0.10 < stolen / len(digests) < 0.30
+
+
+def test_rendezvous_distribution_uniformity():
+    urls = _urls(3)
+    counts = {u: 0 for u in urls}
+    for d in _digests(3000):
+        counts[rendezvous_order(d, urls)[0]] += 1
+    for u, c in counts.items():
+        frac = c / 3000
+        assert 0.23 < frac < 0.44, f"{u} got {frac:.3f} of the keyspace"
+
+
+def test_independent_routers_agree_on_affinity(stubs):
+    """Two routers with no shared state and different membership list
+    ORDER still route the same prompt to the same replica."""
+    a, b, c = stubs("a"), stubs("b"), stubs("c")
+    r1 = ReplicaRouter([a.url, b.url, c.url], health_interval_secs=999)
+    r2 = ReplicaRouter([c.url, a.url, b.url], health_interval_secs=999)
+    for i in range(8):
+        prompt = f"session {i} prompt"
+        r1.dispatch("PUT", "/api", _payload(prompt))
+        r2.dispatch("PUT", "/api", _payload(prompt))
+    for stub in (a, b, c):
+        assert len(stub.hits) % 2 == 0, \
+            f"routers disagreed: {stub.name} saw {len(stub.hits)} hits"
+    assert len(a.hits) + len(b.hits) + len(c.hits) == 16
+
+
+# ---------------------------------------------------------------------------
+# jittered health probing
+# ---------------------------------------------------------------------------
+
+class _RecordingStop:
+    """Event stand-in: records each wait interval, releases the loop
+    after ``n`` periods."""
+
+    def __init__(self, n):
+        self.waits = []
+        self.n = n
+
+    def wait(self, timeout):
+        self.waits.append(timeout)
+        return len(self.waits) >= self.n
+
+    def set(self):
+        self.n = 0
+
+    def is_set(self):
+        return len(self.waits) >= self.n
+
+
+def test_health_probe_interval_is_jittered():
+    router = ReplicaRouter([], health_interval_secs=2.0)
+    stop = _RecordingStop(12)
+    router._health_stop = stop
+    router.start_health_thread()
+    router._health_thread.join(timeout=10.0)
+    assert not router._health_thread.is_alive()
+    router._health_thread = None
+    assert len(stop.waits) == 12
+    # every period inside the +/-50% band, and not phase-locked: N
+    # routers probing every replica must not form a thundering herd
+    for w in stop.waits:
+        assert 1.0 <= w <= 3.0
+    assert len(set(stop.waits)) > 1, "no jitter: identical periods"
+
+
+# ---------------------------------------------------------------------------
+# peer awareness: fleet /metrics at any router
+# ---------------------------------------------------------------------------
+
+def _start_server(router):
+    srv = RouterServer(router)
+    t = threading.Thread(target=srv.run,
+                         kwargs={"host": "127.0.0.1", "port": 0},
+                         daemon=True)
+    t.start()
+    for _ in range(100):
+        if srv.httpd is not None:
+            break
+        time.sleep(0.05)
+    assert srv.httpd is not None
+    return srv, f"http://127.0.0.1:{srv.httpd.server_address[1]}"
+
+
+def test_fleet_metrics_merge_across_peers(stubs):
+    a, b = stubs("a"), stubs("b")
+    backends = [a.url, b.url]
+    r1 = ReplicaRouter(backends, health_interval_secs=999,
+                       router_id="router-one")
+    r2 = ReplicaRouter(backends, health_interval_secs=999,
+                       router_id="router-two")
+    s1, url1 = _start_server(r1)
+    s2, url2 = _start_server(r2)
+    try:
+        r1.set_peers([url2])
+        r2.set_peers([url1])
+        # independent traffic through each router
+        for i in range(3):
+            r1.dispatch("PUT", "/api", _payload(f"via r1 {i}"))
+        for i in range(5):
+            r2.dispatch("PUT", "/api", _payload(f"via r2 {i}"))
+
+        for url, here in ((url1, r1), (url2, r2)):
+            with urllib.request.urlopen(url + "/metrics",
+                                        timeout=30) as resp:
+                m = json.loads(resp.read())
+            tier = m["router_tier"]
+            assert tier["routers_total"] == 2
+            assert tier["routers_reporting"] == 2
+            merged = tier["merged"]
+            # counters sum across the tier...
+            assert merged["requests_total"] == 8
+            # ...histograms merge bucket-wise...
+            hist = merged["histograms"]["router_dispatch_secs"]
+            assert hist["count"] == 8
+            assert sum(hist["buckets"].values()) == 8
+            # ...and tier percentiles are recomputed from the merged
+            # buckets, never summed: the p95 must sit inside the
+            # observed latency range, not at ~2x of it
+            p95 = merged["slo"]["router_dispatch_secs_p95"]
+            assert p95 is not None and 0 < p95 <= hist["sum"]
+            # the replica aggregate stays the LOCAL fleet view (every
+            # router probes every replica; merging would double-count)
+            assert m["aggregate"]["requests"] == 8
+            assert here.router_id in str(tier["per_router"])
+    finally:
+        for srv, r in ((s1, r1), (s2, r2)):
+            r.stop()
+            srv.httpd.shutdown()
+
+
+def test_one_hop_scope_router_never_fans_out(stubs):
+    """?scope=router answers from the local snapshot only — the peer
+    query a sibling makes must not recurse into another fan-out."""
+    a = stubs("a")
+    router = ReplicaRouter([a.url], health_interval_secs=999)
+    # a peer pointing at a dead port: a recursive fan-out would hang or
+    # shrink reporting; one-hop must not even try to reach it
+    router.set_peers([f"http://127.0.0.1:{_free_port()}"])
+    srv, url = _start_server(router)
+    try:
+        t0 = time.monotonic()
+        with urllib.request.urlopen(url + "/metrics?scope=router",
+                                    timeout=30) as resp:
+            m = json.loads(resp.read())
+        assert time.monotonic() - t0 < 5.0
+        assert set(m) == {"router"}     # snapshot only: no aggregate,
+        assert "router_tier" not in m   # no tier merge, no fan-out
+    finally:
+        router.stop()
+        srv.httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# serve_bench: the client half of the crash contract
+# ---------------------------------------------------------------------------
+
+def test_serve_bench_multi_url_failover(stubs):
+    import os
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import serve_bench
+
+    live = stubs("live")
+    dead_url = f"http://127.0.0.1:{_free_port()}"
+    live_url = f"http://{live.url}"
+    r = serve_bench.run_bench([dead_url, live_url], clients=2,
+                              requests=6, tokens=2, timeout=30.0)
+    # every request completed exactly once despite half the front door
+    # being down: transport errors fail over to the sibling URL
+    assert r["ok"] == 6 and r["errors"] == 0
+    assert len(live.hits) == 6
+    assert r["urls"] == [dead_url, live_url]
+    assert r["per_url_requests"][live_url] == 6
+    assert r["per_url_requests"][dead_url] == 0
+    # the ~half of tickets that started at the dead URL needed a retry
+    assert r["failovers"] >= 3
+    # schema keys hold for multi-URL runs too
+    for key in serve_bench.JSON_SCHEMA_KEYS:
+        assert key in r, f"missing {key}"
+
+
+def test_serve_bench_http_errors_are_not_failed_over(stubs):
+    """A 429 is an answer (brownout with honest retry_after), not a
+    transport error — the bench must not hammer the sibling with it."""
+    import serve_bench
+
+    throttled = stubs("throttled",
+                      throttle_body={"message": "busy",
+                                     "retry_after_secs": 1})
+    ok = stubs("ok")
+    r = serve_bench.run_bench(
+        [f"http://{throttled.url}", f"http://{ok.url}"],
+        clients=1, requests=2, tokens=2, timeout=30.0)
+    # ticket 0 starts at the throttled router and keeps its 429;
+    # ticket 1 starts at the ok router and succeeds
+    assert r["ok"] == 1 and r["errors"] == 1
+    assert r["status_counts"].get("429") == 1
+    assert r["failovers"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serve_router CLI: empty fleet is a usage error unless --dynamic
+# ---------------------------------------------------------------------------
+
+def test_router_cli_zero_backends_exit_code(capsys):
+    import os
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import serve_router as tool
+
+    with pytest.raises(SystemExit) as exc:
+        tool.main(["--backends", " ,  ,", "--port", "0"])
+    assert exc.value.code == 2
+    assert "--dynamic" in capsys.readouterr().err
+
+    # the new tier flags parse (serve_fleet spawns routers with these)
+    a = tool.parse_args(["--dynamic", "--peers", "h:1, h:2,",
+                         "--router_id", "router-7", "--port", "0"])
+    assert a.dynamic and a.router_id == "router-7"
+    assert a.backends == ""

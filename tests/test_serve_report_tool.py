@@ -198,6 +198,12 @@ def _fleet_fixture_events():
                      url="http://127.0.0.1:5002", spawn_secs=4.0),
         _fleet_event("scale_down", 80.0, slot="replica-1",
                      url="http://127.0.0.1:5001"),
+        _fleet_event("router_spawned", 90.0, slot="router-0",
+                     url="http://127.0.0.1:6000", spawn_secs=1.5),
+        _fleet_event("router_died", 95.0, slot="router-0",
+                     url="http://127.0.0.1:6000", exited_while="ready"),
+        _fleet_event("router_respawned", 99.0, slot="router-0",
+                     url="http://127.0.0.1:6001", spawn_secs=1.0),
     ]
 
 
@@ -208,21 +214,24 @@ def test_fleet_summary_counters_and_timeline(tmp_path):
         # out of order on disk: the timeline must sort by time_unix
         for e in reversed(_fleet_fixture_events()):
             f.write(json.dumps(e) + "\n")
-    assert len(serve_report.load_fleet_events(str(log))) == 7
+    assert len(serve_report.load_fleet_events(str(log))) == 10
     r = serve_report.analyze([str(log)])
     fs = r["fleet"]
     assert fs["events"] == {
         "replica_spawned": 2, "replica_died": 1,
         "replica_respawned": 1, "scale_up": 1, "scale_down": 1,
-        "brownout": 1}
+        "brownout": 1, "router_spawned": 1, "router_died": 1,
+        "router_respawned": 1, "router_scale_up": 0,
+        "router_scale_down": 0}
     tl = fs["timeline"]
     assert [e["event"] for e in tl] == [
         "replica_spawned", "scale_up", "brownout", "replica_spawned",
-        "replica_died", "replica_respawned", "scale_down"]
+        "replica_died", "replica_respawned", "scale_down",
+        "router_spawned", "router_died", "router_respawned"]
     # offsets relative to the first fleet event
     assert tl[0]["t_secs"] == pytest.approx(0.0)
     assert tl[1]["t_secs"] == pytest.approx(10.0)
-    assert tl[-1]["t_secs"] == pytest.approx(80.0)
+    assert tl[-1]["t_secs"] == pytest.approx(99.0)
     # per-event detail fields survive when present
     assert tl[1]["reason"] == "ttft_p95"
     assert tl[2]["eta_secs"] == 12.0
@@ -254,6 +263,9 @@ def test_cli_fleet_only_log_renders_timeline(tmp_path):
     assert out.returncode == 0, out.stderr
     assert "fleet events:" in out.stdout
     assert "scale_up=1" in out.stdout
+    assert "router_respawned=1" in out.stdout
+    # zero-count event names stay out of the rendered counters
+    assert "router_scale_up" not in out.stdout
     assert "reason=ttft_p95" in out.stdout
     assert "exited_while=ready" in out.stdout
 

@@ -30,7 +30,9 @@ from megatron_llm_tpu.serving.router import (
     NoBackendAvailable,
     ReplicaRouter,
     RouterServer,
+    _prompt_affinity_digest,
     _sum_numeric,
+    rendezvous_order,
 )
 
 
@@ -40,6 +42,22 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _prompt_on(target_url, all_urls, tail="t"):
+    """A prompt whose rendezvous order puts ``target_url`` first.
+
+    Routing is a pure function of (prompt digest, live URLs), so tests
+    that need a specific backend tried first (e.g. the dead one, to
+    exercise failover) pick their prompt with the same function the
+    router uses instead of relying on list order."""
+    urls = [Backend(u).url for u in all_urls]
+    want = Backend(target_url).url
+    for i in range(4096):
+        p = f"{i} {tail}"
+        if rendezvous_order(_prompt_affinity_digest(p), urls)[0] == want:
+            return p
+    raise AssertionError("no prompt rendezvoused onto " + want)
 
 
 class _Stub:
@@ -186,15 +204,20 @@ def test_backend_url_parsing():
         Backend("nonsense")
 
 
-def test_least_loaded_spread_across_backends(stubs):
+def test_rendezvous_spread_across_backends(stubs):
     a, b = stubs("a", sleep=0.05), stubs("b", sleep=0.05)
     router = ReplicaRouter([a.url, b.url], health_interval_secs=999)
     errs = []
+    # distinct prompts spread by rendezvous hash, not by load; pick 4
+    # landing on each backend so the expected split is exact
+    prompts = ([_prompt_on(a.url, [a.url, b.url], tail=f"a{i}")
+                for i in range(4)]
+               + [_prompt_on(b.url, [a.url, b.url], tail=f"b{i}")
+                  for i in range(4)])
 
     def client(i):
         try:
-            # distinct prompts: no affinity funneling
-            router.dispatch("PUT", "/api", _payload(f"{i} 2 3"))
+            router.dispatch("PUT", "/api", _payload(prompts[i]))
         except Exception as e:  # noqa: BLE001
             errs.append(e)
 
@@ -205,10 +228,21 @@ def test_least_loaded_spread_across_backends(stubs):
     for t in threads:
         t.join()
     assert not errs
-    assert len(a.hits) > 0 and len(b.hits) > 0, \
+    assert len(a.hits) == 4 and len(b.hits) == 4, \
         f"no spread: a={len(a.hits)} b={len(b.hits)}"
-    assert len(a.hits) + len(b.hits) == 8
     assert router.requests_total == 8
+
+
+def test_keyless_requests_stay_least_loaded(stubs):
+    a, b = stubs("a"), stubs("b")
+    router = ReplicaRouter([a.url, b.url], health_interval_secs=999)
+    # no "prompts" field -> no affinity digest -> least-loaded rotation
+    for _ in range(6):
+        status, _, _ = router.dispatch(
+            "PUT", "/api", json.dumps({"tokens_to_generate": 1}).encode())
+        assert status == 200
+    assert len(a.hits) == 3 and len(b.hits) == 3, \
+        f"least-loaded rotation broken: a={len(a.hits)} b={len(b.hits)}"
 
 
 def test_sticky_affinity_routes_repeats_to_same_backend(stubs):
@@ -229,10 +263,13 @@ def test_failover_and_circuit_breaker(stubs):
     dead_url = f"127.0.0.1:{_free_port()}"
     router = ReplicaRouter([dead_url, live.url], fail_threshold=2,
                            cooldown_secs=30.0, health_interval_secs=999)
-    # dead backend sorts first (0 requests) until the breaker opens
+    # prompts that rendezvous onto the dead backend: it is tried first
+    # (and fails over) until the breaker opens
     for i in range(4):
-        status, _, data = router.dispatch("PUT", "/api",
-                                          _payload(f"{i} 1"))
+        status, _, data = router.dispatch(
+            "PUT", "/api",
+            _payload(_prompt_on(dead_url, [dead_url, live.url],
+                                tail=f"cb{i}")))
         assert status == 200
         assert json.loads(data)["backend"] == "live"
     dead = router.backends[0]
@@ -355,13 +392,20 @@ def test_router_server_stream_passthrough(router_server):
 
 def test_linear_scaling_over_serial_stubs(stubs):
     """Each stub serializes its requests (a lock + sleep models one
-    engine's capacity); two replicas should cut wall time ~in half."""
+    engine's capacity); two replicas should cut wall time ~in half.
+    Prompts are picked to rendezvous 4/4 across the pair so the
+    measured speedup reflects capacity, not hash luck."""
     def run_fleet(urls, n=8):
+        if len(urls) == 1:
+            prompts = [f"{i} 9" for i in range(n)]
+        else:
+            prompts = [_prompt_on(urls[i % len(urls)], urls,
+                                  tail=f"sc{i}") for i in range(n)]
         router = ReplicaRouter(urls, health_interval_secs=999)
         t0 = time.perf_counter()
         threads = [threading.Thread(
             target=router.dispatch,
-            args=("PUT", "/api", _payload(f"{i} 9"))) for i in range(n)]
+            args=("PUT", "/api", _payload(prompts[i]))) for i in range(n)]
         for t in threads:
             t.start()
         for t in threads:
@@ -435,8 +479,10 @@ def test_trace_id_survives_failover_with_spans(stubs):
                            cooldown_secs=30.0, health_interval_secs=999,
                            tracer=tracer)
     tid = "feedface01234567"
-    status, _, _ = router.dispatch("PUT", "/api", _payload("1 2"),
-                                   trace_id=tid)
+    status, _, _ = router.dispatch(
+        "PUT", "/api",
+        _payload(_prompt_on(dead_url, [dead_url, live.url], tail="tr")),
+        trace_id=tid)
     assert status == 200
     assert router.failovers_total >= 1
     assert live.trace_headers[-1] == tid       # replay kept its identity
@@ -457,7 +503,9 @@ def test_stream_failover_before_first_byte_keeps_trace_id(stubs):
                            tracer=tracer)
     tid = "beefbeefbeefbeef"
     status, headers, body_iter = router.dispatch_stream(
-        "PUT", "/api/stream", _payload("5 6"), trace_id=tid)
+        "PUT", "/api/stream",
+        _payload(_prompt_on(dead_url, [dead_url, live.url], tail="st")),
+        trace_id=tid)
     assert status == 200
     b"".join(body_iter)                        # drain -> span closes
     assert live.trace_headers[-1] == tid

@@ -473,7 +473,7 @@ def test_scale_down_drains_coldest_and_reaps_without_healing(fleet):
     assert "replica_died" not in [e["event"] for e in sup.events]
 
 
-def test_router_runtime_membership_and_affinity_purge():
+def test_router_runtime_membership_and_affinity_remap():
     a, b = _MiniReplica("a"), _MiniReplica("b")
     router = ReplicaRouter([], health_interval_secs=3600.0)
     try:
@@ -488,8 +488,9 @@ def test_router_runtime_membership_and_affinity_purge():
         assert router.snapshot()["backends_total"] == 2
         assert router.remove_backend(a.url) is True
         assert router.remove_backend(a.url) is False     # unknown now
-        # sticky entries pointing at the removed replica are purged
-        assert router.affinity_counts() == {b.url: 0}
+        # sticky keys remap by rendezvous onto the survivors — nothing
+        # ever points at the removed address again
+        assert router.affinity_counts() == {b.url: 1}
         status, _, body = router.dispatch("PUT", "/api",
                                           _payload("1 2 3"))
         assert status == 200

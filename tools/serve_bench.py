@@ -16,6 +16,14 @@ the server's own /metrics delta; ``--json`` emits one machine-readable
 object instead (every key in ``JSON_SCHEMA_KEYS`` is always present —
 asserted by tests/test_serve_bench_tool.py).
 
+Repeat ``--url`` to spread load over a sharded front door (several
+``serve_router.py`` processes over one replica fleet): each request
+starts at a round-robin-chosen router and fails over to the next URL on
+a transport error before the first body byte, so SIGKILLing a router
+mid-run costs a retry, not a failed request.  The summary reports
+per-router dispatch counts (``per_url_requests``) and how many requests
+needed a sibling (``failovers``).
+
 Repeated-prefix workloads (``--prefix_tokens N``) measure the engine's
 prefix cache: a fraction of requests (``--shared_prefix_frac``) share an
 N-word prompt header and differ only in a short unique tail, so cache
@@ -61,7 +69,8 @@ import urllib.request
 # keys guaranteed in the --json output (value may be None when a
 # measurement is unavailable, e.g. no engine /metrics to delta)
 JSON_SCHEMA_KEYS = (
-    "url", "clients", "requests", "ok", "errors", "status_counts",
+    "url", "urls", "per_url_requests", "failovers",
+    "clients", "requests", "ok", "errors", "status_counts",
     "wall_secs", "requests_per_sec", "tokens_total", "tokens_per_sec",
     "latency_mean_secs", "latency_p50_secs", "latency_p95_secs",
     "latency_p99_secs", "ttft_mean_secs", "ttft_p50_secs",
@@ -133,17 +142,45 @@ def _percentile(values, q: float):
     return s[min(int(q * (len(s) - 1) + 0.5), len(s) - 1)]
 
 
-def _fetch_metrics(base_url: str, timeout: float = 10.0):
-    try:
-        with urllib.request.urlopen(base_url + "/metrics",
-                                    timeout=timeout) as resp:
-            return json.loads(resp.read())
-    except Exception:
-        return None
+def _fetch_metrics(base_urls, timeout: float = 10.0):
+    """First URL that answers /metrics wins (with a sharded front door
+    any router speaks for the fleet)."""
+    if isinstance(base_urls, str):
+        base_urls = [base_urls]
+    for base_url in base_urls:
+        try:
+            with urllib.request.urlopen(base_url + "/metrics",
+                                        timeout=timeout) as resp:
+                return json.loads(resp.read())
+        except Exception:
+            continue
+    return None
 
 
-def _one_request(base_url: str, payload: dict, stream: bool,
-                 timeout: float) -> dict:
+def _one_request(base_urls, payload: dict, stream: bool,
+                 timeout: float, start: int = 0) -> dict:
+    """One request with client-side front-door failover: URLs are tried
+    round-robin from ``start``, moving to the next ONLY on a transport
+    error before the first body byte (status 0, nothing streamed).  An
+    HTTP error means the server answered (429 brownout etc.) and a
+    mid-stream death means tokens were already consumed — neither is
+    retried here, so no request is ever issued twice past first byte.
+    The winning URL lands in ``served_by`` and the number of siblings
+    tried in ``failovers``."""
+    urls = [base_urls] if isinstance(base_urls, str) else list(base_urls)
+    r = {}
+    for k in range(max(len(urls), 1)):
+        url = urls[(start + k) % len(urls)]
+        r = _one_request_to(url, payload, stream, timeout)
+        r["served_by"] = url
+        r["failovers"] = k
+        if r["ok"] or r["status"] != 0 or r.get("mid_stream"):
+            break
+    return r
+
+
+def _one_request_to(base_url: str, payload: dict, stream: bool,
+                    timeout: float) -> dict:
     """Returns {ok, status, secs, ttft_secs, tpot_secs, tokens, error?}.
     TPOT (time per output token) is client-observed inter-token latency
     — (last token - first token) / (tokens - 1) — measurable only on the
@@ -195,6 +232,8 @@ def _one_request(base_url: str, payload: dict, stream: bool,
         return {"ok": False, "status": 0,
                 "secs": time.perf_counter() - t0, "ttft_secs": None,
                 "tpot_secs": None, "tokens": 0,
+                # tokens already streamed: failover must NOT re-issue
+                "mid_stream": ttft is not None,
                 "error": f"{type(e).__name__}: {e}"}
 
 
@@ -233,7 +272,12 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
     With ``rate_schedule`` ("r1:t1,r2:t2,...") the request count and
     arrival times come from the piecewise Poisson schedule —
     ``requests`` and ``rate`` are ignored — and the summary gains a
-    per-segment breakdown (``segments``)."""
+    per-segment breakdown (``segments``).
+
+    ``base_url`` may be a list of front-door URLs (a sharded router
+    tier): requests round-robin across them and fail over to the next
+    on a transport error before first byte."""
+    urls = [base_url] if isinstance(base_url, str) else list(base_url)
     results = []
     results_lock = threading.Lock()
     schedule = parse_rate_schedule(rate_schedule) if rate_schedule \
@@ -281,13 +325,14 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                 # 0.0 = greedy — the workload speculative decoding
                 # drafts on (sampled slots never draft)
                 payload["temperature"] = float(temperature)
-            r = _one_request(base_url, payload, stream, timeout)
+            r = _one_request(urls, payload, stream, timeout,
+                             start=ticket % len(urls))
             if segment is not None:
                 r["segment"] = segment
             with results_lock:
                 results.append(r)
 
-    m0 = _fetch_metrics(base_url)
+    m0 = _fetch_metrics(urls)
     threads = [threading.Thread(target=client_loop, daemon=True)
                for _ in range(max(int(clients), 1))]
     for t in threads:
@@ -297,7 +342,7 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
-    m1 = _fetch_metrics(base_url)
+    m1 = _fetch_metrics(urls)
 
     ok = [r for r in results if r["ok"]]
     lat = [r["secs"] for r in ok]
@@ -307,8 +352,18 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
     by_status = {}
     for r in results:
         by_status[str(r["status"])] = by_status.get(str(r["status"]), 0) + 1
+    per_url = {u: 0 for u in urls}
+    for r in results:
+        served = r.get("served_by")
+        if served in per_url:
+            per_url[served] += 1
     out = {
-        "url": base_url,
+        "url": urls[0],
+        # sharded front door: every URL tried, per-router dispatch
+        # counts, and how many requests needed a sibling router
+        "urls": urls,
+        "per_url_requests": per_url,
+        "failovers": sum(r.get("failovers", 0) for r in results),
         "clients": clients,
         "requests": len(results),
         "ok": len(ok),
@@ -477,6 +532,11 @@ def print_table(r: dict) -> None:
         ("tpot p50", _fmt(r["tpot_p50_secs"], "s")),
         ("tpot p95", _fmt(r["tpot_p95_secs"], "s")),
     ]
+    if len(r.get("urls") or ()) > 1:
+        rows[1:1] = [
+            ("router dispatch", json.dumps(r["per_url_requests"])),
+            ("router failovers", _fmt(r["failovers"])),
+        ]
     eng = r.get("server_engine")
     if eng:
         rows += [
@@ -533,8 +593,11 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=5000)
-    p.add_argument("--url", default=None,
-                   help="full base URL (overrides --host/--port)")
+    p.add_argument("--url", default=None, action="append",
+                   help="full base URL (overrides --host/--port); "
+                        "repeat for a sharded front door — requests "
+                        "round-robin over the URLs and fail over to the "
+                        "next on a transport error before first byte")
     p.add_argument("--clients", type=int, default=4)
     p.add_argument("--requests", type=int, default=16,
                    help="total requests across all clients")
@@ -577,7 +640,7 @@ def main(argv=None):
                    help="base URL of the second (flag-OFF) server for "
                         "--ab")
     args = p.parse_args(argv)
-    base_url = args.url or f"http://{args.host}:{args.port}"
+    base_url = args.url or [f"http://{args.host}:{args.port}"]
     kw = dict(clients=args.clients, requests=args.requests,
               tokens=args.tokens, prompt=args.prompt, rate=args.rate,
               stream=args.stream, timeout=args.timeout, seed=args.seed,

@@ -24,6 +24,16 @@ GET /metrics (which now includes a ``fleet`` block and per-event JSONL
 via --fleet_event_log).  See docs/guide/fault_tolerance.md, "Fleet
 supervision & autoscaling".
 
+With ``--routers N`` the front door itself is sharded: instead of one
+in-process router, the supervisor spawns N ``tools/serve_router.py
+--dynamic`` subprocesses, keeps their peer lists + replica membership
+synchronized through ``RouterTierClient``, respawns dead routers with
+the same storm-capped backoff replicas get, and scales the tier on
+front-door saturation.  Each router prints ``ROUTER <url>`` on our
+stdout as it becomes ready; clients hold the whole list and retry a
+sibling on transport error (``serve_bench.py --url ... --url ...``).
+See docs/guide/serving.md, "Sharded front door".
+
 For real orchestrators (k8s, GCE MIGs), implement
 ``serving.supervisor.ReplicaBackend`` (spawn/poll/kill) and reuse
 ``FleetSupervisor`` unchanged — the policy never knows what a process
@@ -94,12 +104,46 @@ def parse_args(argv=None):
     p.add_argument("--affinity_chars", type=int, default=256)
     p.add_argument("--affinity_max", type=int, default=4096)
     p.add_argument("--request_timeout_secs", type=float, default=600.0)
+    # sharded front door (0 = legacy single in-process router)
+    p.add_argument("--routers", type=int, default=0,
+                   help="run N stateless router subprocesses instead of "
+                        "one in-process router; they agree on affinity "
+                        "via rendezvous hashing and any of them answers "
+                        "fleet-wide /metrics")
+    p.add_argument("--max_routers", type=int, default=0,
+                   help="router-tier scale-up ceiling (default: "
+                        "--routers, i.e. a fixed-size tier)")
+    p.add_argument("--router_dispatch_p95_slo_secs", type=float,
+                   default=0.25,
+                   help="scale the router tier up when the windowed "
+                        "dispatch-loop p95 sustains above this")
+    p.add_argument("--router_inflight_high", type=int, default=64,
+                   help="...or when the summed router in-flight "
+                        "(connection-queue proxy) sustains at/above "
+                        "this")
     # observability
     p.add_argument("--fleet_event_log", default=None,
                    help="append fleet events (replica_spawned/died/"
                         "respawned, scale_up/down, brownout) as JSONL "
                         "here; tools/serve_report.py renders a timeline")
     return p.parse_args(argv)
+
+
+def _router_tier_argv(args):
+    """Command for ONE router subprocess (free port, supervisor-managed
+    membership), forwarding the shared router knobs."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [
+        sys.executable, os.path.join(root, "tools", "serve_router.py"),
+        "--dynamic", "--host", "127.0.0.1", "--port", "0",
+        "--fail_threshold", str(args.fail_threshold),
+        "--breaker_backoff_secs", str(args.cooldown_secs),
+        "--max_cooldown_secs", str(args.max_cooldown_secs),
+        "--probe_interval_secs", str(args.probe_interval_secs),
+        "--affinity_chars", str(args.affinity_chars),
+        "--affinity_max", str(args.affinity_max),
+        "--request_timeout_secs", str(args.request_timeout_secs),
+    ]
 
 
 def main(argv=None):
@@ -109,18 +153,29 @@ def main(argv=None):
         FleetSupervisor,
         LocalProcessBackend,
         PolicyConfig,
+        RouterTierClient,
     )
 
-    router = ReplicaRouter(
-        [],                             # membership is the supervisor's
-        fail_threshold=args.fail_threshold,
-        cooldown_secs=args.cooldown_secs,
-        max_cooldown_secs=args.max_cooldown_secs,
-        affinity_chars=args.affinity_chars,
-        affinity_max=args.affinity_max,
-        health_interval_secs=args.probe_interval_secs,
-        request_timeout_secs=args.request_timeout_secs,
-    )
+    tier = max(args.routers, 0)
+    router_backend = None
+    if tier > 0:
+        router = RouterTierClient()
+        router_backend = LocalProcessBackend(
+            _router_tier_argv(args),
+            spawn_eta_secs=30.0,
+            stderr=None,                # routers share our stderr
+        )
+    else:
+        router = ReplicaRouter(
+            [],                         # membership is the supervisor's
+            fail_threshold=args.fail_threshold,
+            cooldown_secs=args.cooldown_secs,
+            max_cooldown_secs=args.max_cooldown_secs,
+            affinity_chars=args.affinity_chars,
+            affinity_max=args.affinity_max,
+            health_interval_secs=args.probe_interval_secs,
+            request_timeout_secs=args.request_timeout_secs,
+        )
     backend = LocalProcessBackend(
         shlex.split(args.replica_cmd),
         spawn_eta_secs=args.spawn_eta_secs,
@@ -139,14 +194,40 @@ def main(argv=None):
         respawn_backoff_max_secs=args.respawn_backoff_max_secs,
         respawn_storm_window_secs=args.respawn_storm_window_secs,
         dead_confirmation_secs=args.dead_confirmation_secs,
+        min_routers=tier,
+        max_routers=max(args.max_routers, tier),
+        router_dispatch_p95_slo_secs=args.router_dispatch_p95_slo_secs,
+        router_inflight_high=args.router_inflight_high,
     )
     supervisor = FleetSupervisor(
         router, backend, config=cfg,
         poll_interval_secs=args.poll_interval_secs,
         event_log_path=args.fleet_event_log,
+        router_backend=router_backend,
     )
     supervisor.spawn_initial(args.initial_replicas or args.min_replicas)
+    if tier > 0:
+        supervisor.spawn_initial_routers(tier)
     supervisor.start()
+
+    if tier > 0:
+        # no local HTTP server: the subprocess routers ARE the front
+        # door.  Announce each as it becomes ready and block until a
+        # signal; clients keep the whole list and retry siblings.
+        import threading
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        announced = set()
+        try:
+            while not stop.wait(0.5):
+                for url in supervisor.router_urls():
+                    if url not in announced:
+                        announced.add(url)
+                        print(f"ROUTER {url}", flush=True)
+        finally:
+            supervisor.stop(kill_replicas=True)
+        return 0
 
     server = RouterServer(router)
 

@@ -57,7 +57,9 @@ RESILIENCE_EVENTS = ("engine_restart", "preemption", "drain")
 # supervisor control-loop events (kind "fleet", schema >= 7); the order
 # here is the counter order in the report
 FLEET_EVENTS = ("replica_spawned", "replica_died", "replica_respawned",
-                "scale_up", "scale_down", "brownout")
+                "scale_up", "scale_down", "brownout",
+                "router_spawned", "router_died", "router_respawned",
+                "router_scale_up", "router_scale_down")
 
 
 def load_records(path: str) -> List[Dict]:

@@ -13,6 +13,13 @@ Clients (and ``tools/serve_bench.py``) point at the router exactly as
 they would a single server: PUT /api, PUT /api/stream, GET /health,
 GET /metrics (JSON or Prometheus).  See docs/guide/serving.md,
 "Running a replica fleet".
+
+Routers are stateless and shard-nothing: run several of them over the
+same replicas (give each the others via ``--peers``, or let
+``tools/serve_fleet.py --routers N`` manage the tier) and they agree on
+prefix affinity through rendezvous hashing alone.  ``--dynamic`` starts
+with zero backends for supervisor-managed membership (POST
+/admin/backends).  See docs/guide/serving.md, "Sharded front door".
 """
 
 import argparse
@@ -25,9 +32,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--backends", required=True,
+    p.add_argument("--backends", default="",
                    help="comma-separated replica addresses "
                         "(host:port[,host:port...])")
+    p.add_argument("--peers", default="",
+                   help="comma-separated sibling-router addresses; any "
+                        "router then answers fleet-wide /metrics by "
+                        "merging its peers' histograms")
+    p.add_argument("--router_id", default=None,
+                   help="stable id stamped into /metrics and fleet "
+                        "events (default: random)")
+    p.add_argument("--dynamic", action="store_true",
+                   help="allow starting with zero backends; membership "
+                        "arrives via POST /admin/backends (the "
+                        "serve_fleet supervisor does this)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--fail_threshold", type=int, default=3,
@@ -67,11 +85,15 @@ def main(argv=None):
         start_trace_flusher(Tracing(tracer=tracer,
                                     trace_dir=args.trace_dir))
 
-    backends = [u for u in args.backends.split(",") if u.strip()]
-    if not backends:
-        raise SystemExit("serve_router: --backends needs at least one "
-                         "replica address (for a dynamic fleet use "
-                         "tools/serve_fleet.py)")
+    # whitespace-only entries ("a:1,, b:2 ,") are stripped, not passed
+    # through as malformed URLs
+    backends = [u.strip() for u in args.backends.split(",") if u.strip()]
+    if not backends and not args.dynamic:
+        print("serve_router: --backends needs at least one replica "
+              "address; pass --dynamic for supervisor-managed "
+              "membership, or use tools/serve_fleet.py",
+              file=sys.stderr)
+        raise SystemExit(2)
     router = ReplicaRouter(
         backends,
         fail_threshold=args.fail_threshold,
@@ -82,7 +104,11 @@ def main(argv=None):
         health_interval_secs=args.probe_interval_secs,
         request_timeout_secs=args.request_timeout_secs,
         tracer=tracer,
+        router_id=args.router_id,
     )
+    peers = [u.strip() for u in args.peers.split(",") if u.strip()]
+    if peers:
+        router.set_peers(peers)
     server = RouterServer(router)
 
     # deterministic teardown: stop the health prober, then break

@@ -124,6 +124,15 @@ MANIFEST: List[Step] = [
          "python -m pytest tests/test_serve_fleet.py "
          "-m slow -q -p no:cacheprovider",
          1200, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+    # sharded front door chaos: 2 supervisor-managed router processes
+    # over 2 replicas, SIGKILL a router mid-burst — clients retry the
+    # sibling from their multi-URL list (exactly-once end to end), the
+    # supervisor respawns the router under its slot, and the survivors'
+    # engines never restart or recompile
+    Step("router_kill_chaos",
+         "python -m pytest tests/test_router_tier_chaos.py "
+         "-m chaos -q -p no:cacheprovider",
+         1200, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
 ]
 
 
