@@ -17,6 +17,11 @@ from megatron_llm_tpu.serving.kv_blocks import (
     digest_link,
     prompt_affinity_digest,
 )
+from megatron_llm_tpu.serving.loop_profiler import (
+    LOOP_PHASES,
+    DispatchRecord,
+    LoopProfiler,
+)
 from megatron_llm_tpu.serving.request import (
     FINISH_NONFINITE,
     EngineError,
@@ -57,6 +62,7 @@ __all__ = [
     "AllBackendsThrottled",
     "Backend",
     "BlockManager",
+    "DispatchRecord",
     "EngineConfig",
     "EngineError",
     "EngineWatchdog",
@@ -64,7 +70,9 @@ __all__ = [
     "FleetSnapshot",
     "FleetSupervisor",
     "InferenceEngine",
+    "LOOP_PHASES",
     "LocalProcessBackend",
+    "LoopProfiler",
     "NoBackendAvailable",
     "NoCapacity",
     "PolicyConfig",

@@ -98,6 +98,10 @@ from megatron_llm_tpu.serving.request import (
     RequestState,
     SamplingParams,
 )
+from megatron_llm_tpu.serving.loop_profiler import (
+    DispatchRecord,
+    LoopProfiler,
+)
 from megatron_llm_tpu.serving.resilience import (
     EngineWatchdog,
     ServingFaultInjector,
@@ -300,6 +304,12 @@ class InferenceEngine:
         self.engine_restarts = 0
         self.slots_evicted_nonfinite = 0
         self.fault_injector = ServingFaultInjector.from_spec(cfg.fault_spec)
+        # engine-loop goodput attribution (serving/loop_profiler.py):
+        # host-phase vs device time per dispatch, surfaced as the 'loop'
+        # block of stats() and periodic engine_loop_stats JSONL records.
+        # Engine-lifetime (like the counters above): restarts swap the
+        # state object, not the loop accounting.
+        self.loop_profiler = LoopProfiler()
         self._dispatches = 0            # prefill chunks + decode steps
         self._watchdog: Optional[EngineWatchdog] = None
         self._restart_lock = threading.Lock()
@@ -576,6 +586,10 @@ class InferenceEngine:
         for req in list(st.scheduler.active.values()):
             req._finish(FINISH_ABORTED)
             st.scheduler.evict(req)
+        # final loop-goodput flush BEFORE engine_stop, so the last
+        # engine_loop_stats record and stats() agree exactly (no
+        # dispatches can land in between)
+        self.loop_profiler.maybe_emit(force=True)
         stream = telemetry.get_stream()
         if stream is not None:
             stream.emit({"kind": "serve", "event": "engine_stop",
@@ -673,6 +687,10 @@ class InferenceEngine:
         background thread."""
         st = st if st is not None else self._st
         sched = st.scheduler
+        # loop goodput: everything from here to the _run_* handoff is
+        # the 'schedule' phase (deadline sweep, admission, preemption,
+        # slot bookkeeping, the scheduling decision itself)
+        d = self.loop_profiler.begin()
         # fault injection stays disarmed through warmup — chaos specs
         # index steady-state dispatches
         inj = self.fault_injector if self.warmed_up else None
@@ -699,14 +717,19 @@ class InferenceEngine:
             self._dispatches += 1
             if inj is not None:
                 inj.before_dispatch(self._dispatches)
-            self._run_prefill_chunk(st, arg)
+            d.mark("schedule")
+            self._run_prefill_chunk(st, arg, d)
             return True
         if kind == "decode":
             self._dispatches += 1
             if inj is not None:
                 inj.before_dispatch(self._dispatches)
-            self._run_decode(st, arg)
+            d.mark("schedule")
+            self._run_decode(st, arg, d)
             return True
+        # no action: not a dispatch, and the wait for new work must not
+        # read as a dispatch gap
+        self.loop_profiler.idle()
         return False
 
     # -- admission ------------------------------------------------------
@@ -808,7 +831,9 @@ class InferenceEngine:
             st.pages = self._cow_copy(st.pages, np.int32(src_b),
                                       np.int32(new_b))
 
-    def _run_prefill_chunk(self, st: _EngineState, req: Request) -> None:
+    def _run_prefill_chunk(self, st: _EngineState, req: Request,
+                           d: DispatchRecord) -> None:
+        d.kind = "prefill"
         C = self.config.prefill_chunk
         # prefill over the full context — prompt plus anything generated
         # before a preemption/restart requeued this request (identical to
@@ -823,6 +848,7 @@ class InferenceEngine:
         for bi in range(start // bs, (start + valid - 1) // bs + 1):
             self._writable(st, req.slot, bi)
         table = st.blocks.tables[req.slot:req.slot + 1].copy()
+        d.mark("build_inputs")
         t0 = time.perf_counter()
         finite = True
         with tracing.span("prefill_chunk", "serve", request=req.id,
@@ -844,7 +870,9 @@ class InferenceEngine:
                 st.keys[req.slot] = np.asarray(new_key)
             else:
                 jax.block_until_ready(st.pages[0])
+        d.mark("device")
         if st is not self._st:
+            self.loop_profiler.finish(d)
             return          # engine restarted mid-dispatch: stale state
         chunk_secs = time.perf_counter() - t0
         self.prefill_secs += chunk_secs
@@ -856,12 +884,14 @@ class InferenceEngine:
         # burst of same-prefix requests hits even mid-prefill
         st.blocks.commit_prefix(req.slot, ptoks, req.prefill_pos)
         if not done:
+            self.loop_profiler.finish(d)
             return
         inj = self.fault_injector if self.warmed_up else None
         if inj is not None and inj.poison_nonfinite(self._dispatches):
             finite = False
         if not finite:
             self._evict_nonfinite(st, req)
+            self.loop_profiler.finish(d)
             return
         # prompt fully cached: request enters the decode batch
         s = req.slot
@@ -870,23 +900,27 @@ class InferenceEngine:
         st.active[s] = 1
         st.last_tokens[s] = tok
         self._emit_and_check(st, req, tok)
+        self.loop_profiler.finish(d)
 
     # -- decode ---------------------------------------------------------
 
-    def _run_decode(self, st: _EngineState, slots: List[int]) -> None:
+    def _run_decode(self, st: _EngineState, slots: List[int],
+                    d: DispatchRecord) -> None:
         if self.speculative:
             # one decode path: with speculation on EVERY decode step is
             # the [S, K+1] verify program — draft-less and sampled slots
             # ride it masked (vlen = 1), so the plain decode program is
             # never dispatched and cannot cause a late first compile
-            self._run_verify(st, slots)
+            self._run_verify(st, slots, d)
             return
+        d.kind = "decode"
         bs = self.config.block_size
         for s in slots:
             self._writable(st, s, int(st.context_lens[s]) // bs)
         decoding = [r for r in (st.scheduler.active.get(s) for s in slots)
                     if r is not None and r.state == RequestState.DECODE]
         traces = sorted({r.trace_id for r in decoding if r.trace_id})
+        d.mark("build_inputs")
         t0 = time.perf_counter()
         with tracing.span("decode_step", "serve", batch=len(slots),
                           traces=traces):
@@ -903,7 +937,9 @@ class InferenceEngine:
         finite = np.asarray(finite).copy()
         for s in slots:
             st.keys[s] = new_keys[s]
+        d.mark("device")
         if st is not self._st:
+            self.loop_profiler.finish(d)
             return          # engine restarted mid-dispatch: stale state
         inj = self.fault_injector if self.warmed_up else None
         if slots and inj is not None \
@@ -941,13 +977,16 @@ class InferenceEngine:
             if sp.top_p_decay > 0.0:
                 st.top_ps[s] = sp.top_p_at(len(req.out_tokens) + 1)
             self._emit_and_check(st, req, tok)
+        self.loop_profiler.finish(d)
 
-    def _run_verify(self, st: _EngineState, slots: List[int]) -> None:
+    def _run_verify(self, st: _EngineState, slots: List[int],
+                    disp: DispatchRecord) -> None:
         """Speculative decode step: draft on the host (prompt-lookup
         per slot), verify all slots in one [S, K+1] forward, then commit
         1..K+1 tokens per slot with rejected drafts rolled back by a
         cursor decrement (the pages are per-slot append-only; the next
         step's scatter overwrites the stale tail)."""
+        disp.kind = "verify"
         cfg = self.config
         K = self.draft_k
         bs = cfg.block_size
@@ -971,6 +1010,7 @@ class InferenceEngine:
             if d:
                 draft_lens[req.slot] = len(d)
                 draft_tokens[req.slot, :len(d)] = d
+        disp.mark("draft")
         vlens = np.where(st.active > 0, 1 + draft_lens, 0).astype(np.int32)
         verify_tokens = np.zeros((S, K + 1), np.int32)
         verify_tokens[:, 0] = st.last_tokens
@@ -981,6 +1021,7 @@ class InferenceEngine:
             for bi in range(ctx // bs, last // bs + 1):
                 self._writable(st, s, bi)
         traces = sorted({r.trace_id for r in decoding if r.trace_id})
+        disp.mark("build_inputs")
         t0 = time.perf_counter()
         with tracing.span("decode_step", "serve", batch=len(slots),
                           traces=traces,
@@ -997,7 +1038,9 @@ class InferenceEngine:
         finite = np.asarray(finite).copy()
         for s in slots:
             st.keys[s] = new_keys[s]
+        disp.mark("device")
         if st is not self._st:
+            self.loop_profiler.finish(disp)
             return          # engine restarted mid-dispatch: stale state
         inj = self.fault_injector if self.warmed_up else None
         if slots and inj is not None \
@@ -1050,6 +1093,7 @@ class InferenceEngine:
             # stale but unreachable (valid_lens gates every read)
             self.accepted_tokens += committed - 1
             req.spec_accepted += committed - 1
+        self.loop_profiler.finish(disp)
 
     # -- completion -----------------------------------------------------
 
@@ -1186,6 +1230,9 @@ class InferenceEngine:
         st.pages = self._cow_copy(st.pages, np.int32(0), np.int32(0))
         jax.block_until_ready(st.pages[0])
         self.warmed_up = True
+        # compile-time gaps between warmup dispatches are expected —
+        # only steady-state dispatch gaps count as loop stalls
+        self.loop_profiler.stall_armed = True
         tracing.instant("engine_warm", "serve")
 
     def estimate_wait_secs(self) -> float:
@@ -1225,5 +1272,6 @@ class InferenceEngine:
             "accepted_tokens": self.accepted_tokens,
             "engine_restarts": self.engine_restarts,
             "slots_evicted_nonfinite": self.slots_evicted_nonfinite,
+            "loop": self.loop_profiler.stats(),
         })
         return s

@@ -433,7 +433,13 @@ def _wants_prometheus(path: str, accept: str) -> bool:
 #    router_respawned / router_scale_up / router_scale_down, with
 #    slot/url and the dispatch-p95/in-flight readings behind scaling
 #    decisions) — see serving/supervisor.py's sharded front door
-TELEMETRY_SCHEMA_VERSION = 9
+# 10: + kind="serve" event="engine_loop_stats" records (periodic
+#    engine-loop goodput rollups: per-phase schedule / draft /
+#    build_inputs / device / emit seconds, device_busy_pct /
+#    host_bubble_pct, dispatch-gap stall count, windowed recents and
+#    phase p50/p95) — see serving/loop_profiler.py and
+#    tools/serve_report.py's loop-goodput section
+TELEMETRY_SCHEMA_VERSION = 10
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 

@@ -70,6 +70,14 @@ def _start_stub(paged_kernel="xla", prefill_kernel="xla"):
                     "accepted_tokens": 2 * n,
                     "paged_kernel": paged_kernel,
                     "prefill_kernel": prefill_kernel,
+                    # loop-goodput counters: 64% device busy by
+                    # construction (0.008 / (0.010 + 0.0025))
+                    "loop": {
+                        "dispatches": 5 * n,
+                        "wall_secs": 0.010 * n,
+                        "gap_secs": 0.0025 * n,
+                        "device_secs": 0.008 * n,
+                    },
                 }
                 self._json(200, body)
             else:
@@ -195,6 +203,15 @@ def test_bench_reports_speculative_deltas(stub_server):
     assert r["accept_rate"] == pytest.approx(8 / 12, abs=1e-4)
     assert r["accepted_tokens_per_sec"] == pytest.approx(
         8 / r["wall_secs"], rel=0.01)
+
+
+def test_bench_reports_loop_goodput_delta(stub_server):
+    """device_busy_pct / host_bubble_pct come from the engine's loop
+    counter deltas over the bench window (never from deltaing the
+    server's own percentages)."""
+    r = serve_bench.run_bench(stub_server, clients=2, requests=4, tokens=3)
+    assert r["device_busy_pct"] == pytest.approx(64.0, abs=0.01)
+    assert r["host_bubble_pct"] == pytest.approx(36.0, abs=0.01)
 
 
 def test_percentile_helper():

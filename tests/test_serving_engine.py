@@ -308,6 +308,12 @@ def test_engine_zero_recompiles_after_warmup(engine, model_and_params,
         assert metrics.histograms["ttft_secs"].count == 10
         snap = metrics.snapshot()
         assert snap["slo"]["e2e_secs_p95"] > 0
+        # the loop profiler tiled dispatch sub-spans onto the trace
+        # (category serve_loop), also without costing a compile
+        loop_evs = [e for e in tracer.chrome_trace()["traceEvents"]
+                    if str(e.get("name", "")).startswith("loop.")]
+        assert loop_evs
+        assert all(e["cat"] == "serve_loop" for e in loop_evs)
     finally:
         engine.request_done_hook = None
         tracing.install_tracing(None)
@@ -330,7 +336,7 @@ def test_request_done_schema_golden(engine, tmp_path):
     the schema history comment in telemetry.py)."""
     from megatron_llm_tpu import telemetry
 
-    assert telemetry.TELEMETRY_SCHEMA_VERSION == 9
+    assert telemetry.TELEMETRY_SCHEMA_VERSION == 10
     captured = []
     engine.request_done_hook = captured.append
     stream = telemetry.TelemetryStream(str(tmp_path))
@@ -365,7 +371,7 @@ def test_request_done_schema_golden(engine, tmp_path):
             (tmp_path / "telemetry.jsonl").read_text().splitlines()
             if "request_done" in ln][0]
     assert frozenset(line) == frozenset(rec) | {"schema", "time_unix"}
-    assert line["schema"] == 8
+    assert line["schema"] == telemetry.TELEMETRY_SCHEMA_VERSION
 
 
 def test_engine_int8_kv_cache_serves(model_and_params):
@@ -399,6 +405,23 @@ def test_engine_stats_shape(engine):
     assert s["paged_kernel"] in ("pallas", "xla")
     assert s["prefill_kernel"] in ("pallas", "xla")
     assert s["speculative"] is False and s["draft_k"] == 0
+    # the engine-loop goodput block (loop_profiler.py) rides along,
+    # populated by the traffic the earlier tests pushed through
+    loop = s["loop"]
+    assert loop["dispatches"] > 0
+    assert loop["dispatches_by_kind"]["prefill"] > 0
+    assert loop["dispatches_by_kind"]["decode"] > 0
+    assert set(loop["phase_secs"]) == {"schedule", "draft",
+                                       "build_inputs", "device", "emit"}
+    assert loop["device_secs"] > 0
+    # marks tile each dispatch: phases sum to dispatch wall-clock
+    assert sum(loop["phase_secs"].values()) == \
+        pytest.approx(loop["wall_secs"], rel=0.05)
+    assert 0.0 <= loop["device_busy_pct"] <= 100.0
+    assert loop["device_busy_pct"] + loop["host_bubble_pct"] == \
+        pytest.approx(100.0, abs=0.01)
+    assert loop["window"]["dispatches"] > 0
+    assert "loop_device_secs" in loop["histograms"]
 
 
 # ---------------------------------------------------------------------------
@@ -499,6 +522,11 @@ def test_engine_speculative_zero_recompiles(spec_engine, tmp_path):
             r.result(timeout=180)
         assert det.recompiles == 0, \
             f"{det.recompiles} recompiles after warmup: {list(det.events)}"
+        # the loop profiler ran through the same traffic (verify-step
+        # dispatches with a draft phase) without costing a compile
+        loop = spec_engine.stats()["loop"]
+        assert loop["dispatches_by_kind"]["verify"] > 0
+        assert loop["phase_secs"]["draft"] > 0
     finally:
         tracing.install_tracing(None)
         telemetry.install_stream(None)
@@ -549,6 +577,11 @@ def test_engine_paged_kernel_token_identity(model_and_params):
                                                    **GREEDY))
                       for p in prompts]
                 outs.append([r.result(timeout=180).tokens for r in rs])
+                if det is not None:
+                    # loop profiler accounted the kernel-path dispatches
+                    loop = eng.stats()["loop"]
+                    assert loop["dispatches_by_kind"]["decode"] > 0
+                    assert loop["device_secs"] > 0
             finally:
                 eng.stop()
                 if det is not None:
@@ -596,6 +629,12 @@ def test_engine_prefill_kernel_token_identity(model_and_params):
                                                    **GREEDY))
                       for p in prompts]
                 outs.append([r.result(timeout=180).tokens for r in rs])
+                if det is not None:
+                    # both kernels live: the loop profiler saw prefill
+                    # AND decode dispatches without costing a compile
+                    loop = eng.stats()["loop"]
+                    assert loop["dispatches_by_kind"]["prefill"] > 0
+                    assert loop["dispatches_by_kind"]["decode"] > 0
             finally:
                 eng.stop()
                 if det is not None:

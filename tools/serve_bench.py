@@ -90,6 +90,10 @@ JSON_SCHEMA_KEYS = (
     # "free" tokens over the run wall clock)
     "drafted_tokens", "accepted_tokens", "accept_rate",
     "accepted_tokens_per_sec",
+    # engine-loop goodput over the run (loop_profiler counter deltas):
+    # device-busy vs host-bubble share of the loop's busy time — the
+    # before/after line a host/device-overlap A/B reads
+    "device_busy_pct", "host_bubble_pct",
 )
 
 
@@ -421,6 +425,9 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         "accepted_tokens": None,
         "accept_rate": None,
         "accepted_tokens_per_sec": None,
+        # engine-loop goodput (loop_profiler deltas over the run)
+        "device_busy_pct": None,
+        "host_bubble_pct": None,
     }
     if schedule:
         segs = []
@@ -491,6 +498,29 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                 if accepted is not None and wall > 0:
                     out["accepted_tokens_per_sec"] = round(
                         accepted / wall, 3)
+                # engine-loop goodput: recompute the busy-time split
+                # from cumulative loop counter deltas (the percentages
+                # themselves never delta or sum; a router's aggregate
+                # sums the per-replica counters, which still deltas
+                # correctly)
+                l0 = e0.get("loop")
+                l1 = e1.get("loop")
+                if isinstance(l0, dict) and isinstance(l1, dict):
+                    def loop_delta(key):
+                        a, b = l0.get(key), l1.get(key)
+                        if isinstance(a, (int, float)) \
+                                and isinstance(b, (int, float)):
+                            return b - a
+                        return None
+                    dev = loop_delta("device_secs")
+                    busy = loop_delta("wall_secs")
+                    gap = loop_delta("gap_secs")
+                    if dev is not None and busy is not None:
+                        busy += gap or 0.0
+                        if busy > 0:
+                            pct = 100.0 * min(dev / busy, 1.0)
+                            out["device_busy_pct"] = round(pct, 3)
+                            out["host_bubble_pct"] = round(100.0 - pct, 3)
     return out
 
 
@@ -549,6 +579,10 @@ def print_table(r: dict) -> None:
     if r.get("prefill_tokens_per_sec") is not None:
         rows += [("prefill throughput",
                   _fmt(r["prefill_tokens_per_sec"], " tok/s"))]
+    if r.get("device_busy_pct") is not None:
+        rows += [("loop device busy / host bubble",
+                  f"{_fmt(r['device_busy_pct'], '%')} / "
+                  f"{_fmt(r['host_bubble_pct'], '%')}")]
     if r.get("drafted_tokens") is not None:
         rows += [
             ("spec accepted/drafted",
@@ -679,6 +713,16 @@ def main(argv=None):
                       f"{on['prefill_tokens_per_sec']:.3f} / "
                       f"{off['prefill_tokens_per_sec']:.3f} tok/s "
                       f"({on['prefill_tokens_per_sec'] / off['prefill_tokens_per_sec']:.2f}x)")
+            if on.get("device_busy_pct") is not None or \
+                    off.get("device_busy_pct") is not None:
+                # the loop-overlap A/B readout: did the flag move the
+                # host bubble, and did tokens/sec follow?
+                print(f"A/B loop device busy on/off: "
+                      f"{_fmt(on.get('device_busy_pct'), '%')} / "
+                      f"{_fmt(off.get('device_busy_pct'), '%')} "
+                      f"(host bubble "
+                      f"{_fmt(on.get('host_bubble_pct'), '%')} / "
+                      f"{_fmt(off.get('host_bubble_pct'), '%')})")
         return 0 if all(r["errors"] == 0 for r in rows) else 1
     r = run_bench(base_url, **kw)
     if args.as_json:

@@ -24,6 +24,13 @@ trace_id, per-request phase attribution, tpot_secs) and prints:
 * cache-hit stratification — the same latency table split by whether
   the request adopted prefix-cache pages (``cached_prompt_tokens > 0``),
   quantifying what the PR 6 prefix cache is worth end-to-end
+* engine-loop goodput — ``engine_loop_stats`` rollups (telemetry
+  schema >= 10, serving/loop_profiler.py): per-phase share of dispatch
+  wall-clock (schedule / draft / build_inputs / device / emit),
+  device-busy vs host-bubble percent, the windowed bubble trend, and
+  the dispatch-gap stall count — the offline twin of ``/metrics``'
+  ``engine.loop`` block; absent (and the report unchanged) on logs
+  written before schema 10
 * per-replica comparison — pass several JSONL files/dirs (one per
   replica) and each gets its own column plus the fleet total
 * fleet-event timeline — supervisor events (``kind: "fleet"``, schema
@@ -50,6 +57,10 @@ STREAM_FILENAME = "telemetry.jsonl"     # mirrors telemetry.STREAM_FILENAME
 
 PHASE_KEYS = ("queue_secs", "admission_secs", "prefill_secs",
               "decode_secs", "stream_write_secs")
+
+# engine-loop host phases; mirrors loop_profiler.LOOP_PHASES (this tool
+# must not import jax-adjacent modules)
+LOOP_PHASE_KEYS = ("schedule", "draft", "build_inputs", "device", "emit")
 
 
 RESILIENCE_EVENTS = ("engine_restart", "preemption", "drain")
@@ -78,12 +89,18 @@ def load_fleet_events(path: str) -> List[Dict]:
     return _load(path)[2]
 
 
+def load_loop_stats(path: str) -> List[Dict]:
+    """engine_loop_stats rollups (telemetry schema >= 10) from a serve
+    log, in file order (cumulative per engine lifetime)."""
+    return _load(path)[3]
+
+
 def _load(path: str):
     if os.path.isdir(path):
         path = os.path.join(path, STREAM_FILENAME)
     if not os.path.exists(path):
         raise FileNotFoundError(f"no serve log at {path}")
-    records, events, fleet = [], [], []
+    records, events, fleet, loop = [], [], [], []
     with open(path) as f:
         for line in f:
             try:
@@ -98,9 +115,11 @@ def _load(path: str):
                 continue
             if rec.get("event") == "request_done":
                 records.append(rec)
+            elif rec.get("event") == "engine_loop_stats":
+                loop.append(rec)
             elif rec.get("event") in RESILIENCE_EVENTS:
                 events.append(rec)
-    return records, events, fleet
+    return records, events, fleet, loop
 
 
 def _percentile(values: List[float], q: float) -> Optional[float]:
@@ -222,6 +241,63 @@ def speculative_summary(records: List[Dict]) -> Dict:
     }
 
 
+def loop_goodput_summary(per_path: List[List[Dict]]) -> Dict:
+    """Engine-loop goodput from ``engine_loop_stats`` rollups: where
+    dispatch wall-clock went per host phase, device-busy vs host-bubble
+    percent, the windowed bubble trend, and dispatch-gap stall count.
+
+    Rollups are cumulative per engine lifetime, so totals come from
+    each log's final record; the trend samples every record's recent
+    window (``window.host_bubble_pct``)."""
+    totals = {"dispatches": 0, "wall_secs": 0.0, "gap_secs": 0.0,
+              "device_secs": 0.0, "stalls": 0}
+    phase_secs = {k: 0.0 for k in LOOP_PHASE_KEYS}
+    for recs in per_path:
+        if not recs:
+            continue
+        final = recs[-1]
+        for key in totals:
+            v = final.get(key)
+            if isinstance(v, (int, float)):
+                totals[key] += v
+        ph = final.get("phase_secs") or {}
+        for key in LOOP_PHASE_KEYS:
+            if isinstance(ph.get(key), (int, float)):
+                phase_secs[key] += ph[key]
+    busy = totals["wall_secs"] + totals["gap_secs"]
+    device_busy = (100.0 * min(totals["device_secs"] / busy, 1.0)
+                   if busy > 0 else None)
+    out: Dict[str, object] = {
+        **totals,
+        "phase_secs": phase_secs,
+        "phase_share": {
+            key: (phase_secs[key] / totals["wall_secs"]
+                  if totals["wall_secs"] > 0 else None)
+            for key in LOOP_PHASE_KEYS},
+        "device_busy_pct": device_busy,
+        "host_bubble_pct": (100.0 - device_busy
+                            if device_busy is not None else None),
+    }
+    # windowed host-bubble trend, chronological across all logs
+    samples = []
+    for recs in per_path:
+        for rec in recs:
+            b = (rec.get("window") or {}).get("host_bubble_pct")
+            if not isinstance(b, (int, float)):
+                continue
+            t = rec.get("time_unix")
+            samples.append((t if isinstance(t, (int, float)) else 0.0, b))
+    samples.sort()
+    t0 = samples[0][0] if samples else None
+    out["bubble_trend"] = [
+        {"t_secs": round(t - t0, 3), "host_bubble_pct": round(b, 3)}
+        for t, b in samples]
+    vals = [b for _, b in samples]
+    out["bubble_window_p50_pct"] = _percentile(vals, 0.50)
+    out["bubble_window_p95_pct"] = _percentile(vals, 0.95)
+    return out
+
+
 def cache_stratified(records: List[Dict]) -> Dict:
     hits = [r for r in records
             if (r.get("cached_prompt_tokens") or 0) > 0]
@@ -238,11 +314,13 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
     all_records: List[Dict] = []
     all_events: List[Dict] = []
     all_fleet: List[Dict] = []
+    loop_per_path: List[List[Dict]] = []
     for p in paths:
-        records, events, fleet = _load(p)
+        records, events, fleet, loop = _load(p)
         all_records.extend(records)
         all_events.extend(events)
         all_fleet.extend(fleet)
+        loop_per_path.append(loop)
         if len(paths) > 1:
             per_replica[p] = {
                 **latency_summary(records),
@@ -281,6 +359,9 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
     for r in all_records:
         fr = r.get("finish_reason") or "?"
         out["finish_reasons"][fr] = out["finish_reasons"].get(fr, 0) + 1
+    if any(loop_per_path):
+        # only on schema >= 10 logs; older logs keep the old report shape
+        out["loop"] = loop_goodput_summary(loop_per_path)
     if all_fleet:
         out["fleet"] = fleet_summary(all_fleet)
     if per_replica:
@@ -405,6 +486,31 @@ def render(report: Dict) -> str:
                     "restart_failed", "preemptions", "drains",
                     "nonfinite_evictions"):
             lines.append(f"  {key:>20}: {res.get(key, 0)}")
+
+    lp = report.get("loop")
+    if lp:
+        db, hb = lp.get("device_busy_pct"), lp.get("host_bubble_pct")
+        lines.append(f"\nengine loop goodput "
+                     f"({lp['dispatches']} dispatches, "
+                     f"{lp['stalls']} stall(s)):")
+        lines.append("  device busy "
+                     + (f"{db:.1f}%" if db is not None else "-")
+                     + "  host bubble "
+                     + (f"{hb:.1f}%" if hb is not None else "-"))
+        for key in LOOP_PHASE_KEYS:
+            share = lp["phase_share"].get(key)
+            pct = f"{share * 100:5.1f}%" if share is not None else "    -"
+            lines.append(f"  {key:>18} "
+                         f"{_fmt(lp['phase_secs'].get(key)):>10} {pct}")
+        trend = lp.get("bubble_trend") or []
+        if trend:
+            p95 = lp.get("bubble_window_p95_pct")
+            lines.append(
+                f"  bubble trend: {trend[0]['host_bubble_pct']:.1f}% -> "
+                f"{trend[-1]['host_bubble_pct']:.1f}% over "
+                f"{len(trend)} window(s)"
+                + (f" (window p95 {p95:.1f}%)" if p95 is not None
+                   else ""))
 
     fleet = report.get("fleet")
     if fleet:

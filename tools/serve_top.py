@@ -1,0 +1,308 @@
+#!/usr/bin/env python3
+"""serve_top: live terminal flight deck for a serving fleet.
+
+Polls one endpoint's ``GET /metrics`` — point it at any router of a
+sharded front door for the peer-merged fleet view, or directly at a
+single replica — and renders a refreshing per-replica table:
+occupancy, tokens/sec, TTFT/TPOT p95, prefix-cache hit rate, the
+engine-loop ``host bubble %`` (serving/loop_profiler.py), engine
+restarts, and router brownout state.
+
+Stdlib only (no jax, no requests): runs on a laptop against a tunnel,
+like serve_bench / serve_report.
+
+    python tools/serve_top.py --url http://localhost:8000
+    python tools/serve_top.py --url http://localhost:8000 --once --json
+
+``--once`` prints a single snapshot and exits (with ``--json``, one
+machine-readable object — what the tests consume).  Tokens/sec needs
+two polls, so it is null on the first frame and in ``--once`` mode.
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def fetch_metrics(url: str, timeout: float) -> dict:
+    req = urllib.request.Request(
+        url.rstrip("/") + "/metrics",
+        headers={"Accept": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8", "replace"))
+
+
+def _hist_pct(snap, q):
+    """Percentile from a Histogram.snapshot() shape (linear
+    interpolation in the winning bucket — the telemetry.py estimator,
+    re-implemented here so this tool stays stdlib-only)."""
+    if not (isinstance(snap, dict) and isinstance(snap.get("buckets"), dict)):
+        return None
+    total = snap.get("count") or 0
+    if total <= 0:
+        return None
+    items = []
+    for k, v in snap["buckets"].items():
+        bound = float("inf") if k in ("+Inf", "inf") else float(k)
+        items.append((bound, int(v)))
+    items.sort()
+    target = max(min(float(q), 1.0), 0.0) * total
+    cum, lo = 0, 0.0
+    for bound, c in items:
+        if c > 0 and cum + c >= target:
+            if bound == float("inf"):
+                return lo
+            frac = (target - cum) / c if c else 1.0
+            return lo + (bound - lo) * max(min(frac, 1.0), 0.0)
+        cum += c
+        if bound != float("inf"):
+            lo = bound
+    return lo
+
+
+def _num(d, *path):
+    """Nested numeric lookup; None on any missing/non-numeric hop."""
+    cur = d
+    for p in path:
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(p)
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return cur
+
+
+def _replica_row(name: str, url, snap) -> dict:
+    """One table row from a replica's ServerMetrics snapshot (None when
+    the router could not reach it this probe)."""
+    row = {
+        "name": name,
+        "url": url,
+        "alive": snap is not None,
+        "requests": None, "tokens_generated": None,
+        "tokens_per_sec": None,
+        "occupancy": None, "queue_depth": None,
+        "ttft_p95_secs": None, "tpot_p95_secs": None,
+        "cache_hit_rate": None,
+        "device_busy_pct": None, "host_bubble_pct": None,
+        "loop_stalls": None, "engine_restarts": None,
+        "draining": False,
+    }
+    if snap is None:
+        return row
+    row["requests"] = _num(snap, "requests")
+    row["tokens_generated"] = _num(snap, "tokens_generated")
+    row["ttft_p95_secs"] = (
+        _num(snap, "slo", "ttft_secs_p95")
+        if _num(snap, "slo", "ttft_secs_p95") is not None
+        else _hist_pct((snap.get("histograms") or {}).get("ttft_secs"),
+                       0.95))
+    row["tpot_p95_secs"] = (
+        _num(snap, "slo", "tpot_secs_p95")
+        if _num(snap, "slo", "tpot_secs_p95") is not None
+        else _hist_pct((snap.get("histograms") or {}).get("tpot_secs"),
+                       0.95))
+    eng = snap.get("engine")
+    if isinstance(eng, dict):
+        row["occupancy"] = _num(eng, "mean_batch_occupancy")
+        row["queue_depth"] = _num(eng, "queue_depth")
+        hits = _num(eng, "prefix_cache_hits") or 0
+        misses = _num(eng, "prefix_cache_misses") or 0
+        if hits + misses > 0:
+            row["cache_hit_rate"] = round(hits / (hits + misses), 4)
+        row["device_busy_pct"] = _num(eng, "loop", "device_busy_pct")
+        row["host_bubble_pct"] = _num(eng, "loop", "host_bubble_pct")
+        row["loop_stalls"] = _num(eng, "loop", "stalls")
+        row["engine_restarts"] = _num(eng, "engine_restarts")
+    return row
+
+
+def build_snapshot(url: str, metrics: dict) -> dict:
+    """Reduce one /metrics document (router fleet view or a bare
+    replica snapshot) to the flight-deck schema."""
+    out = {
+        "time_unix": time.time(),
+        "url": url,
+        "source": "router" if "router" in metrics else "replica",
+        "router": None,
+        "router_tier": None,
+        "replicas": [],
+    }
+    if out["source"] == "router":
+        rsnap = metrics.get("router") or {}
+        out["router"] = {
+            "router_id": rsnap.get("router_id"),
+            "backends_total": _num(rsnap, "backends_total"),
+            "backends_alive": _num(rsnap, "backends_alive"),
+            "requests_total": _num(rsnap, "requests_total"),
+            "failovers_total": _num(rsnap, "failovers_total"),
+            "inflight_requests": _num(rsnap, "inflight_requests"),
+            "brownout_active": bool(rsnap.get("brownout_active")),
+            "brownout_remaining_secs": _num(
+                rsnap, "brownout_remaining_secs"),
+        }
+        tier = metrics.get("router_tier")
+        if isinstance(tier, dict):
+            out["router_tier"] = {
+                "routers_total": _num(tier, "routers_total"),
+                "routers_reporting": _num(tier, "routers_reporting"),
+            }
+        meta = rsnap.get("backends") or {}
+        snaps = metrics.get("backends") or {}
+        for name in sorted(set(meta) | set(snaps),
+                           key=lambda n: (len(n), n)):
+            m = meta.get(name) or {}
+            row = _replica_row(name, m.get("url"), snaps.get(name))
+            if m.get("draining"):
+                row["draining"] = True
+            if not m.get("alive", 1):
+                row["alive"] = False
+            out["replicas"].append(row)
+    else:
+        out["replicas"].append(_replica_row("replica_0", url, metrics))
+    alive = [r for r in out["replicas"] if r["alive"]]
+    out["fleet"] = {
+        "replicas_total": len(out["replicas"]),
+        "replicas_alive": len(alive),
+        "requests": sum(r["requests"] or 0 for r in alive),
+        "tokens_generated": sum(r["tokens_generated"] or 0 for r in alive),
+        "tokens_per_sec": None,
+    }
+    return out
+
+
+def add_rates(snapshot: dict, prev: dict) -> None:
+    """Fill per-replica and fleet tokens/sec from the previous frame's
+    (time, tokens) pairs; mutates ``snapshot`` in place."""
+    if not prev:
+        return
+    dt = snapshot["time_unix"] - prev.get("time_unix", 0)
+    if dt <= 0:
+        return
+    prev_rows = {r["name"]: r for r in prev.get("replicas", [])}
+    fleet_rate = 0.0
+    any_rate = False
+    for row in snapshot["replicas"]:
+        p = prev_rows.get(row["name"])
+        if (p is None or row["tokens_generated"] is None
+                or p.get("tokens_generated") is None):
+            continue
+        rate = max(row["tokens_generated"] - p["tokens_generated"], 0) / dt
+        row["tokens_per_sec"] = round(rate, 2)
+        fleet_rate += rate
+        any_rate = True
+    if any_rate:
+        snapshot["fleet"]["tokens_per_sec"] = round(fleet_rate, 2)
+
+
+def _fmt(v, spec="", dash="-"):
+    if v is None:
+        return dash
+    try:
+        return format(v, spec)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+COLUMNS = (
+    # header, width, row key, format spec
+    ("replica", 12, "name", ""),
+    ("up", 4, None, ""),
+    ("occ", 6, "occupancy", ".2f"),
+    ("queue", 6, "queue_depth", "d"),
+    ("tok/s", 9, "tokens_per_sec", ".1f"),
+    ("ttft_p95", 9, "ttft_p95_secs", ".3f"),
+    ("tpot_p95", 9, "tpot_p95_secs", ".4f"),
+    ("hit%", 7, None, ""),
+    ("bubble%", 8, "host_bubble_pct", ".1f"),
+    ("stalls", 7, "loop_stalls", "d"),
+    ("restarts", 8, "engine_restarts", "d"),
+)
+
+
+def render(snapshot: dict) -> str:
+    lines = []
+    r = snapshot.get("router")
+    tier = snapshot.get("router_tier")
+    fleet = snapshot["fleet"]
+    head = (f"serve_top  {snapshot['url']}  "
+            f"replicas {fleet['replicas_alive']}/{fleet['replicas_total']}")
+    if tier:
+        head += (f"  routers {_fmt(tier['routers_reporting'])}"
+                 f"/{_fmt(tier['routers_total'])}")
+    if r:
+        head += f"  inflight {_fmt(r['inflight_requests'])}"
+        if r["brownout_active"]:
+            head += (f"  BROWNOUT "
+                     f"({_fmt(r['brownout_remaining_secs'], '.1f')}s)")
+    head += (f"  fleet {_fmt(fleet['tokens_per_sec'], '.1f')} tok/s"
+             f"  {time.strftime('%H:%M:%S')}")
+    lines.append(head)
+    lines.append("")
+    lines.append("  ".join(h.ljust(w) for h, w, _, _ in COLUMNS))
+    for row in snapshot["replicas"]:
+        cells = []
+        for h, w, key, spec in COLUMNS:
+            if h == "up":
+                v = ("DRAIN" if row["draining"]
+                     else "up" if row["alive"] else "DOWN")
+            elif h == "hit%":
+                hr = row["cache_hit_rate"]
+                v = _fmt(100.0 * hr, ".1f") if hr is not None else "-"
+            else:
+                v = _fmt(row.get(key), spec)
+            cells.append(str(v).ljust(w))
+        lines.append("  ".join(cells))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live terminal dashboard over a serving fleet's "
+                    "/metrics (router or single replica)")
+    ap.add_argument("--url", required=True,
+                    help="router (fleet view) or replica base URL")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="one frame, then exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the snapshot as JSON instead of a table")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="per-poll HTTP timeout")
+    args = ap.parse_args(argv)
+
+    prev = {}
+    while True:
+        try:
+            metrics = fetch_metrics(args.url, args.timeout)
+        except (OSError, urllib.error.URLError, ValueError) as e:
+            print(f"serve_top: cannot fetch {args.url}/metrics: {e}",
+                  file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        snap = build_snapshot(args.url, metrics)
+        add_rates(snap, prev)
+        prev = snap
+        if args.json:
+            print(json.dumps(snap))
+        else:
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")     # clear + home
+            print(render(snap))
+        sys.stdout.flush()
+        if args.once:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

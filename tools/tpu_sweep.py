@@ -133,6 +133,15 @@ MANIFEST: List[Step] = [
          "python -m pytest tests/test_router_tier_chaos.py "
          "-m chaos -q -p no:cacheprovider",
          1200, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+    # engine-loop profiler overhead gate: per-dispatch goodput
+    # bookkeeping (begin + phase marks + finish) must stay under 2% of
+    # a measured CPU dispatch A/B'd against the engine running without
+    # it — the always-on attribution may not become the bubble it
+    # exists to measure
+    Step("serve_loop_overhead",
+         "python -m pytest tests/test_loop_profiler.py "
+         "-m slow -k loop_overhead -q -p no:cacheprovider",
+         900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
 ]
 
 
