@@ -8,6 +8,10 @@ front-end lives in ``megatron_llm_tpu.text_generation_server``; the
 multi-replica fleet front-end is ``router`` (``tools/serve_router.py``).
 """
 
+from megatron_llm_tpu.serving.cache_observatory import (
+    CacheObservatory,
+    merge_heat_tops,
+)
 from megatron_llm_tpu.serving.engine import EngineConfig, InferenceEngine
 from megatron_llm_tpu.serving.kv_blocks import (
     BlockManager,
@@ -62,6 +66,7 @@ __all__ = [
     "AllBackendsThrottled",
     "Backend",
     "BlockManager",
+    "CacheObservatory",
     "DispatchRecord",
     "EngineConfig",
     "EngineError",
@@ -96,5 +101,6 @@ __all__ = [
     "chain_block_digests",
     "derive_num_blocks",
     "digest_link",
+    "merge_heat_tops",
     "prompt_affinity_digest",
 ]

@@ -73,7 +73,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -81,6 +81,7 @@ import numpy as np
 
 from megatron_llm_tpu import telemetry, tracing
 from megatron_llm_tpu.models.language_model import language_model_forward
+from megatron_llm_tpu.serving.cache_observatory import CacheObservatory
 from megatron_llm_tpu.serving.drafter import draft_budget, lookup_draft
 from megatron_llm_tpu.serving.kv_blocks import (
     BlockManager,
@@ -148,6 +149,11 @@ class EngineConfig:
     preemption: bool = True         # pool-pressure preemption
     fault_spec: str = ""            # chaos injection, e.g. "nan@12,hang@30"
     restart_backoff_secs: float = 0.5   # restart-storm backoff base
+    # cache observatory (serving/cache_observatory.py): ghost-tier
+    # capacity multiples for the digest-only shadow LRUs predicting the
+    # prefix-cache hit rate at N x the pool ("cache" stats block,
+    # cache_stats JSONL records)
+    cache_ghost_multiples: Tuple[int, ...] = (2, 4, 10)
 
 
 def _key_from_seed(seed: int) -> np.ndarray:
@@ -277,6 +283,14 @@ class InferenceEngine:
                 "on" if self.prefill_kernel == "pallas" else "off"),
             paged_prefill_max_q=max(self.draft_k + 1, 2))
 
+        # cache observatory (serving/cache_observatory.py): per-prefix
+        # heat, eviction forensics, ghost capacity tiers.  Engine-
+        # lifetime like the loop profiler — restarts swap BlockManager
+        # instances, the observatory keeps the accounting.
+        self.cache_observatory = CacheObservatory(
+            self._num_blocks - 1, cfg.block_size,
+            ghost_multiples=cfg.cache_ghost_multiples)
+
         self._st = self._new_state(gen=0)
 
         self._decode_step = jax.jit(self._decode_impl)
@@ -330,9 +344,14 @@ class InferenceEngine:
         compiles nothing.  Scheduler counters carry across restarts (the
         fleet-visible totals must not reset)."""
         cfg = self.config
+        if carry is not None:
+            # the fresh pool starts empty: ghost slots release their
+            # blocks but digest residency survives the restart
+            self.cache_observatory.on_pool_reset()
         blocks = BlockManager(self._num_blocks, cfg.block_size,
                               cfg.num_slots, self._max_blocks_per_slot,
-                              prefix_cache=cfg.prefix_cache)
+                              prefix_cache=cfg.prefix_cache,
+                              observatory=self.cache_observatory)
         sched = Scheduler(self.queue, blocks, cfg.max_model_len,
                           draft_k=self.draft_k)
         if carry is not None:
@@ -341,6 +360,16 @@ class InferenceEngine:
             sched.rejected_len = old.rejected_len
             sched.deadline_evictions = old.deadline_evictions
             sched.preemptions = old.preemptions
+            # prefix-cache counters carry too: the observatory's shadow
+            # counters are cumulative across restarts (it is shared, see
+            # on_pool_reset above), and check_invariants asserts the
+            # manager's totals equal them
+            ob = carry.blocks
+            blocks.prefix_cache_hits = ob.prefix_cache_hits
+            blocks.prefix_cache_misses = ob.prefix_cache_misses
+            blocks.prefix_cache_evictions = ob.prefix_cache_evictions
+            blocks.prefix_cache_hit_tokens = ob.prefix_cache_hit_tokens
+            blocks.cow_copies = ob.cow_copies
         S = cfg.num_slots
         return _EngineState(
             gen=gen,
@@ -586,10 +615,12 @@ class InferenceEngine:
         for req in list(st.scheduler.active.values()):
             req._finish(FINISH_ABORTED)
             st.scheduler.evict(req)
-        # final loop-goodput flush BEFORE engine_stop, so the last
-        # engine_loop_stats record and stats() agree exactly (no
-        # dispatches can land in between)
+        # final loop-goodput + cache-observatory flush BEFORE
+        # engine_stop, so the last engine_loop_stats / cache_stats
+        # records and stats() agree exactly (no dispatches or
+        # admissions can land in between)
         self.loop_profiler.maybe_emit(force=True)
+        self.cache_observatory.maybe_emit(force=True)
         stream = telemetry.get_stream()
         if stream is not None:
             stream.emit({"kind": "serve", "event": "engine_stop",
@@ -712,6 +743,9 @@ class InferenceEngine:
             share = (time.perf_counter() - t_admit) / len(admitted)
             for req in admitted:
                 req.admission_secs += share
+        # periodic cache_stats JSONL (cadence logic keeps this a no-op
+        # almost always; a None stream returns before any lock)
+        self.cache_observatory.maybe_emit()
         kind, arg = sched.next_action()
         if kind == "prefill":
             self._dispatches += 1
@@ -1180,6 +1214,8 @@ class InferenceEngine:
             "blocks_free": bstats["blocks_free"],
             "blocks_in_use": bstats["blocks_in_use"],
             "blocks_cached_reusable": bstats["blocks_cached_reusable"],
+            "miss_cold_blocks": req.miss_cold_blocks,
+            "miss_evicted_blocks": req.miss_evicted_blocks,
         }
         stream = telemetry.get_stream()
         if stream is not None:
@@ -1273,5 +1309,6 @@ class InferenceEngine:
             "engine_restarts": self.engine_restarts,
             "slots_evicted_nonfinite": self.slots_evicted_nonfinite,
             "loop": self.loop_profiler.stats(),
+            "cache": self.cache_observatory.stats(),
         })
         return s

@@ -58,6 +58,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from megatron_llm_tpu.serving.cache_observatory import CacheObservatory
+
 GARBAGE_BLOCK = 0
 
 
@@ -126,12 +128,14 @@ class BlockManager:
     _lock_protected_ = (
         "_free_blocks", "_free_slots", "_slot_blocks", "tables",
         "_refcounts", "_cache", "_block_hash", "_lru", "_slot_cached",
+        "_slot_miss_causes",
         "prefix_cache_hits", "prefix_cache_misses",
         "prefix_cache_evictions", "prefix_cache_hit_tokens", "cow_copies",
     )
 
     def __init__(self, num_blocks: int, block_size: int, num_slots: int,
-                 max_blocks_per_slot: int, prefix_cache: bool = False):
+                 max_blocks_per_slot: int, prefix_cache: bool = False,
+                 observatory: Optional[CacheObservatory] = None):
         assert num_blocks >= 2, "need at least one block beyond the garbage"
         assert block_size >= 1 and num_slots >= 1
         self.num_blocks = int(num_blocks)
@@ -153,6 +157,16 @@ class BlockManager:
         self._block_hash: Dict[int, bytes] = {}     # block -> digest
         self._lru: "OrderedDict[int, None]" = OrderedDict()
         self._slot_cached: Dict[int, int] = {}      # slot -> cached tokens
+        # slot -> (cold, evicted) missed prefix blocks from its alloc
+        # match (the request_done miss-cause fields read these)
+        self._slot_miss_causes: Dict[int, Tuple[int, int]] = {}
+        # cache observatory (serving/cache_observatory.py): heat table,
+        # eviction forensics, ghost capacity tiers.  Hook calls happen
+        # inside this class's locked sections; the observatory has its
+        # own lock (order: self._lock -> observatory._lock) because the
+        # engine shares one across restarts' BlockManager instances.
+        self.observatory = observatory if observatory is not None else \
+            CacheObservatory(int(num_blocks) - 1, int(block_size))
         self.prefix_cache_hits = 0                  # block-granular
         self.prefix_cache_misses = 0
         self.prefix_cache_evictions = 0
@@ -179,21 +193,28 @@ class BlockManager:
         if self._free_blocks:
             return self._free_blocks.pop()
         if self._lru:
+            # forensics classifies this eviction from the pool balance
+            # at the moment of eviction (free list is empty here, so
+            # everything not parked in the LRU is live and refcounted)
+            lru_len = len(self._lru)
+            in_use = self.num_blocks - 1 - lru_len
             b, _ = self._lru.popitem(last=False)
             digest = self._block_hash.pop(b)
             del self._cache[digest]
             self.prefix_cache_evictions += 1
+            self.observatory.record_evict(digest, in_use, lru_len)
             return b
         raise NoCapacity("pool exhausted (no free or evictable blocks)")
 
-    def _match_prefix_locked(self, prompt_tokens: Sequence[int]
-                             ) -> List[int]:
+    def _match_prefix_locked(self, prompt_tokens: Sequence[int]):
         """Longest run of cached blocks covering the prompt, capped so at
         least one prompt token stays uncached (the engine needs a real
-        prefill step to produce the first-token logits)."""
+        prefill step to produce the first-token logits).  Returns the
+        matched blocks plus the observatory's match token (heat + miss
+        causes + ghost-tier lookups over the same digests)."""
         cap = (len(prompt_tokens) - 1) // self.block_size
         if cap <= 0:
-            return []
+            return [], None
         digests = chain_block_digests(prompt_tokens, self.block_size, cap)
         matched: List[int] = []
         for d in digests:
@@ -203,7 +224,8 @@ class BlockManager:
             matched.append(b)
         self.prefix_cache_hits += len(matched)
         self.prefix_cache_misses += len(digests) - len(matched)
-        return matched
+        token = self.observatory.record_match(digests, len(matched))
+        return matched, token
 
     def alloc(self, total_tokens: int,
               prompt_tokens: Optional[Sequence[int]] = None) -> int:
@@ -223,8 +245,9 @@ class BlockManager:
                 f"> max_blocks_per_slot {self.max_blocks_per_slot}")
         with self._lock:
             matched: List[int] = []
+            mtoken = None
             if self.prefix_cache_enabled and prompt_tokens is not None:
-                matched = self._match_prefix_locked(prompt_tokens)
+                matched, mtoken = self._match_prefix_locked(prompt_tokens)
             n_fresh = n - len(matched)
             # matched blocks parked in the LRU are consumed by the match
             # itself — they are NOT available to _take_block_locked, so
@@ -237,17 +260,24 @@ class BlockManager:
                     f"no capacity: {len(self._free_slots)} free slots, "
                     f"{avail} free/evictable blocks, need {n_fresh}")
             slot = self._free_slots.pop()
+            adopted_rcs: List[int] = []
             for b in matched:
                 rc = self._refcounts.get(b, 0)
                 if rc == 0:
                     self._lru.pop(b, None)      # leave the reusable list
                 self._refcounts[b] = rc + 1
+                adopted_rcs.append(rc + 1)
             blocks = matched + [self._take_block_locked()
                                 for _ in range(n_fresh)]
             for b in blocks[len(matched):]:
                 self._refcounts[b] = 1
             self._slot_blocks[slot] = blocks
             self._slot_cached[slot] = len(matched) * self.block_size
+            self._slot_miss_causes[slot] = (
+                (mtoken.miss_cold, mtoken.miss_evicted)
+                if mtoken is not None else (0, 0))
+            if self.prefix_cache_enabled:
+                self.observatory.record_admit(slot, mtoken, n, adopted_rcs)
             self.prefix_cache_hit_tokens += len(matched) * self.block_size
             self.tables[slot, :] = GARBAGE_BLOCK
             self.tables[slot, :n] = blocks
@@ -256,6 +286,14 @@ class BlockManager:
     def slot_cached_tokens(self, slot: int) -> int:
         with self._lock:
             return self._slot_cached.get(slot, 0)
+
+    def slot_miss_causes(self, slot: int) -> Tuple[int, int]:
+        """(cold, evicted) missed prefix blocks from this slot's
+        admission match — ``evicted`` counts digests the cache held and
+        threw away (the per-request regret the request_done record
+        surfaces as miss_evicted_blocks)."""
+        with self._lock:
+            return self._slot_miss_causes.get(slot, (0, 0))
 
     def slot_releasable_blocks(self, slot: int) -> int:
         """How many blocks ``free(slot)`` would actually return to the
@@ -270,7 +308,7 @@ class BlockManager:
                 return 0
             return sum(1 for b in blocks if self._refcounts.get(b, 1) <= 1)
 
-    def _commit_locked(self, blocks: List[int],
+    def _commit_locked(self, slot: int, blocks: List[int],
                        token_ids: Sequence[int], n_written: int) -> None:
         """Register every fully written, not-yet-registered block under
         its chain digest so later admissions can share it.  A digest that
@@ -280,15 +318,22 @@ class BlockManager:
         if full <= 0:
             return
         digests = chain_block_digests(token_ids, self.block_size, full)
+        actions: List[str] = []     # reg/live/parked, per digest (the
+        # observatory's cross-capacity inclusion audit reads these)
         for i in range(full):
             b = blocks[i]
-            if b in self._block_hash:
-                continue
             d = digests[i]
+            if b in self._block_hash:
+                actions.append("live")
+                continue
             if d in self._cache:
+                actions.append("parked" if self._cache[d] in self._lru
+                               else "live")
                 continue
             self._cache[d] = b
             self._block_hash[b] = d
+            actions.append("reg")
+        self.observatory.record_commit(slot, digests, actions)
 
     def commit_prefix(self, slot: int, token_ids: Sequence[int],
                       n_written: int) -> None:
@@ -299,7 +344,7 @@ class BlockManager:
         with self._lock:
             blocks = self._slot_blocks.get(slot)
             if blocks is not None:
-                self._commit_locked(blocks, token_ids, n_written)
+                self._commit_locked(slot, blocks, token_ids, n_written)
 
     def ensure_writable(self, slot: int, block_idx: int
                         ) -> Optional[Tuple[int, Optional[int]]]:
@@ -319,11 +364,13 @@ class BlockManager:
             blocks = self._slot_blocks.get(slot)
             if blocks is None or block_idx >= len(blocks):
                 return None
+            ghost_dropped = self.observatory.record_cow(slot, block_idx)
             b = blocks[block_idx]
             if self._refcounts.get(b, 1) <= 1:
                 d = self._block_hash.pop(b, None)
                 if d is not None:
                     del self._cache[d]
+                self._note_cow_divergences(ghost_dropped)
                 return None
             nb = self._take_block_locked()
             self._refcounts[b] -= 1
@@ -331,7 +378,18 @@ class BlockManager:
             blocks[block_idx] = nb
             self.tables[slot, block_idx] = nb
             self.cow_copies += 1
+            self._note_cow_divergences(ghost_dropped)
             return nb, b
+
+    def _note_cow_divergences(self, ghost_dropped: Sequence[bytes]) -> None:
+        """A ghost tier COW-unregistered a digest this pool still caches
+        (sole-owner canonical at the larger capacity vs. a surviving
+        private duplicate + canonical here): strict cross-capacity
+        inclusion is broken from now on, the same way a commit of an
+        already-registered digest breaks it.  Caller holds self._lock."""
+        n = sum(1 for d in ghost_dropped if d in self._cache)
+        if n:
+            self.observatory.note_inclusion_divergence(n)
 
     def free(self, slot: int, token_ids: Optional[Sequence[int]] = None,
              n_written: int = 0) -> None:
@@ -349,7 +407,7 @@ class BlockManager:
                 return
             if (self.prefix_cache_enabled and token_ids is not None
                     and n_written > 0):
-                self._commit_locked(blocks, token_ids, n_written)
+                self._commit_locked(slot, blocks, token_ids, n_written)
             for b in blocks:
                 rc = self._refcounts.get(b, 1) - 1
                 if rc > 0:
@@ -361,8 +419,11 @@ class BlockManager:
                     self._lru.move_to_end(b)
                 else:
                     self._free_blocks.append(b)
+            if self.prefix_cache_enabled:
+                self.observatory.record_free(slot)
             self._free_slots.append(slot)
             self._slot_cached.pop(slot, None)
+            self._slot_miss_causes.pop(slot, None)
             self.tables[slot, :] = GARBAGE_BLOCK
 
     # -- observability --------------------------------------------------
@@ -386,6 +447,13 @@ class BlockManager:
                 "prefix_cache_hit_tokens": self.prefix_cache_hit_tokens,
                 "cow_copies": self.cow_copies,
             }
+
+    def cache_stats(self) -> Dict[str, object]:
+        """The observatory's ``cache`` block (heat top-K, miss causes,
+        eviction forensics, ghost-tier projections) — nested under
+        ``cache`` in engine stats()/metrics; scalar leaves flatten into
+        the Prometheus exposition and fleet-sum across replicas."""
+        return self.observatory.stats()
 
     def check_invariants(self) -> None:
         """Debug/test hook: every usable block is in exactly one of
@@ -418,6 +486,14 @@ class BlockManager:
                 n = len(blocks)
                 assert list(self.tables[slot, :n]) == blocks
                 assert (self.tables[slot, n:] == GARBAGE_BLOCK).all()
+            real_cache = dict(self._cache)
+            hits, misses = self.prefix_cache_hits, self.prefix_cache_misses
+        # observatory audit outside the pool lock (lock order is
+        # pool -> observatory; the check only reads a repeatable
+        # snapshot because check_invariants callers are quiescent)
+        self.observatory.check_invariants(
+            real_cache=real_cache if self.prefix_cache_enabled else None,
+            real_hits=hits, real_misses=misses)
 
 
 def derive_num_blocks(num_slots: int, block_size: int,

@@ -107,6 +107,12 @@ class Request:
         self.slot: Optional[int] = None
         self.prefill_pos = 0            # prompt tokens already in cache
         self.cached_prompt_tokens = 0   # adopted from the prefix cache
+        # miss-cause attribution for the prefix blocks this request's
+        # admission probed and did NOT find: never-seen digests vs
+        # digests the LRU evicted (the per-request regret signal the
+        # cache observatory aggregates)
+        self.miss_cold_blocks = 0
+        self.miss_evicted_blocks = 0
         self.t_submit = time.monotonic()
         self.deadline = (self.t_submit + deadline_secs
                          if deadline_secs else None)

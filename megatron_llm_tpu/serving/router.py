@@ -941,6 +941,7 @@ class ReplicaRouter:
         per_backend: Dict[str, object] = {}
         aggregate: Dict[str, object] = {}
         per_replica: Dict[str, Dict[str, object]] = {}
+        heat_tables: List[object] = []
         for i, b in enumerate(self.backends_list()):
             snap = None
             try:
@@ -959,6 +960,25 @@ class ReplicaRouter:
             if isinstance(snap, dict):
                 _sum_numeric(aggregate, snap)
                 _collect_non_numeric(per_replica, snap, f"backend_{i}")
+                cache = snap.get("engine", {})
+                cache = cache.get("cache") if isinstance(cache, dict) else None
+                if isinstance(cache, dict) and cache.get("heat_top"):
+                    heat_tables.append(cache["heat_top"])
+        if heat_tables:
+            # _sum_numeric drops list leaves, so the fleet heat table is
+            # merged explicitly: same salted prefix (fleet-stable
+            # MEGATRON_CACHE_SALT) sums, distinct keys compete for top-K.
+            try:
+                from megatron_llm_tpu.serving.cache_observatory import (
+                    merge_heat_tops)
+
+                eng = aggregate.setdefault("engine", {})
+                if isinstance(eng, dict):
+                    sub = eng.setdefault("cache", {})
+                    if isinstance(sub, dict):
+                        sub["heat_top"] = merge_heat_tops(heat_tables)
+            except ImportError:
+                pass  # stdlib-only deployment: no fleet heat table
         hists = aggregate.get("histograms")
         if isinstance(hists, dict):
             try:

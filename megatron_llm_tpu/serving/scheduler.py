@@ -130,6 +130,10 @@ class Scheduler:
             cached = self.blocks.slot_cached_tokens(slot)
             head.prefill_pos = cached
             head.cached_prompt_tokens = cached
+            # miss-cause attribution from the same admission match (the
+            # request_done record carries these; cache_observatory.py)
+            head.miss_cold_blocks, head.miss_evicted_blocks = \
+                self.blocks.slot_miss_causes(slot)
             self.active[slot] = head
             self.admitted += 1
             admitted.append(head)

@@ -439,7 +439,15 @@ def _wants_prometheus(path: str, accept: str) -> bool:
 #    host_bubble_pct, dispatch-gap stall count, windowed recents and
 #    phase p50/p95) — see serving/loop_profiler.py and
 #    tools/serve_report.py's loop-goodput section
-TELEMETRY_SCHEMA_VERSION = 10
+# 11: + kind="serve" event="cache_stats" records (periodic KV
+#    prefix-cache observatory rollups: salted-digest heat top-K,
+#    miss-cause taxonomy cold/evicted, capacity-vs-churn eviction
+#    forensics, ghost-tier hit projections at 2x/4x/10x capacity);
+#    request_done records gain miss_cold_blocks / miss_evicted_blocks
+#    (per-request prefix miss causes; evicted = the evicted-then-
+#    wanted-again regret signal) — see serving/cache_observatory.py
+#    and tools/serve_report.py's cache-observatory section
+TELEMETRY_SCHEMA_VERSION = 11
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 

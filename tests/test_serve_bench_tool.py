@@ -176,6 +176,30 @@ def test_build_prompt_shared_prefix():
                                     shared_prefix_frac=1.0, seed=7) == "x"
 
 
+def test_build_prompt_zipf_skewed_popularity():
+    """--prefix_zipf draws the shared header from a pool with Zipf
+    popularity: a few hot prefixes dominate, a long tail churns."""
+    heads = [serve_bench.build_prompt(
+                 t, "x", prefix_tokens=8, shared_prefix_frac=1.0,
+                 seed=3, prefix_zipf=1.2, prefix_pool=8).split()[0]
+             for t in range(400)]
+    counts = {}
+    for h in heads:
+        counts[h] = counts.get(h, 0) + 1
+    assert 1 < len(counts) <= 8                  # a pool, not one prefix
+    ranked = sorted(counts.values(), reverse=True)
+    assert ranked[0] > 2 * ranked[-1]            # genuinely skewed
+    # deterministic per (seed, ticket)
+    again = serve_bench.build_prompt(5, "x", prefix_tokens=8,
+                                     shared_prefix_frac=1.0, seed=3,
+                                     prefix_zipf=1.2, prefix_pool=8)
+    assert again == serve_bench.build_prompt(
+        5, "x", prefix_tokens=8, shared_prefix_frac=1.0, seed=3,
+        prefix_zipf=1.2, prefix_pool=8)
+    # zipf ranks are uniform within the header (one prefix per ticket)
+    assert len(set(again.split()[:8])) == 1
+
+
 def test_prefix_workload_reports_engine_deltas(stub_server):
     r = serve_bench.run_bench(stub_server, clients=2, requests=4, tokens=3,
                               prefix_tokens=8, shared_prefix_frac=0.5)

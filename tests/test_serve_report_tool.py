@@ -174,6 +174,114 @@ def test_cli_table_json_and_empty_exit_codes(serve_log, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# cache observatory section (cache_stats rollups, telemetry schema 11)
+# ---------------------------------------------------------------------------
+
+def _cache_stats(probes=100, hits=60, miss_cold=30, miss_evicted=10,
+                 hit_tokens=240, heat=None, x2_hits=80, x2_tokens=320):
+    return {
+        "schema": 11, "kind": "serve", "event": "cache_stats",
+        "time_unix": 1700000050.0,
+        "match_calls": 40, "probes": probes, "hits": hits,
+        "misses": probes - hits, "hit_tokens": hit_tokens,
+        "hit_rate": round(hits / probes, 4),
+        "miss_cold": miss_cold, "miss_evicted": miss_evicted,
+        "evictions_capacity": 3, "evictions_churn": 7,
+        "pool_resets": 0, "inclusion_divergences": 0,
+        "heat_entries": len(heat or ()), "heat_evicted": 0,
+        "heat_top": heat or [],
+        "ghost": {
+            "x2": {"capacity_blocks": 24, "hits": x2_hits,
+                   "misses": probes - x2_hits, "hit_tokens": x2_tokens,
+                   "evictions": 1, "entries": 5,
+                   "hit_rate": round(x2_hits / probes, 4)},
+            "x10": {"capacity_blocks": 120, "hits": 95,
+                    "misses": probes - 95, "hit_tokens": 380,
+                    "evictions": 0, "entries": 9, "hit_rate": 0.95},
+        },
+    }
+
+
+def _heat_entry(prefix, hits, regret=0):
+    return {"prefix": prefix, "hits": hits, "hit_tokens": hits * 4,
+            "residency": hits, "peak_refcount": 2, "evictions": 1,
+            "regret": regret, "last_access_age": 3}
+
+
+def test_analyze_cache_observatory_section(tmp_path):
+    """Schema-11 cache_stats rollups: final-record totals, merged heat
+    top-K, the miss-cause split, and the ghost capacity projection
+    priced at the log's measured prefill throughput."""
+    recs = [_record(i) for i in range(4)]
+    log = _write_log(str(tmp_path / "r"), recs)
+    with open(os.path.join(log, "telemetry.jsonl"), "a") as f:
+        # two rollups: cumulative, so only the final one counts
+        f.write(json.dumps(_cache_stats(probes=50, hits=20)) + "\n")
+        f.write(json.dumps(_cache_stats(
+            heat=[_heat_entry("aaaa", 12, regret=2),
+                  _heat_entry("bbbb", 3)])) + "\n")
+    r = serve_report.analyze([log])
+    cache = r["cache"]
+    assert cache["probes"] == 100 and cache["hits"] == 60
+    assert cache["hit_rate"] == pytest.approx(0.6)
+    assert cache["miss_cold"] == 30 and cache["miss_evicted"] == 10
+    assert cache["evictions_capacity"] == 3
+    assert cache["evictions_churn"] == 7
+    assert [e["prefix"] for e in cache["heat_top"]] == ["aaaa", "bbbb"]
+    # ghost projection: x2 gains 320-240=80 tokens, priced at the
+    # prefill throughput measured from the request_done records
+    tps = r["prefill"]["tokens_per_sec"]
+    x2 = cache["ghost"]["x2"]
+    assert x2["hit_rate"] == pytest.approx(0.8)
+    assert x2["extra_hit_tokens"] == 80
+    assert x2["prefill_saved_secs_total"] == pytest.approx(80 / tps)
+    assert x2["ttft_saved_secs_per_request"] == pytest.approx(
+        80 / tps / 4)
+    # tiers come out ordered by capacity
+    assert list(cache["ghost"]) == ["x2", "x10"]
+
+
+def test_analyze_cache_merges_replicas_and_heat(tmp_path):
+    a = _write_log(str(tmp_path / "ra"), [_record(0)])
+    b = _write_log(str(tmp_path / "rb"), [_record(1)])
+    for log, heat in ((a, [_heat_entry("aaaa", 10, regret=1)]),
+                      (b, [_heat_entry("aaaa", 5),
+                           _heat_entry("cccc", 2)])):
+        with open(os.path.join(log, "telemetry.jsonl"), "a") as f:
+            f.write(json.dumps(_cache_stats(heat=heat)) + "\n")
+    cache = serve_report.analyze([a, b])["cache"]
+    assert cache["probes"] == 200                # summed across replicas
+    top = {e["prefix"]: e for e in cache["heat_top"]}
+    assert top["aaaa"]["hits"] == 15             # same-salt keys merge
+    assert top["aaaa"]["regret"] == 1
+    assert top["cccc"]["hits"] == 2
+
+
+def test_cli_renders_cache_observatory(tmp_path):
+    log = _write_log(str(tmp_path / "r"), [_record(i) for i in range(4)])
+    with open(os.path.join(log, "telemetry.jsonl"), "a") as f:
+        f.write(json.dumps(_cache_stats(
+            heat=[_heat_entry("aaaa", 12, regret=2)])) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "serve_report.py"), log],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
+    assert "cache observatory" in out.stdout
+    assert "miss causes" in out.stdout
+    assert "evicted-then-wanted" in out.stdout
+    assert "capacity projection" in out.stdout
+    assert "x2" in out.stdout and "x10" in out.stdout
+    assert "aaaa" in out.stdout
+    # a pre-schema-11 log renders without the section
+    plain = _write_log(str(tmp_path / "old"), [_record(0)])
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "serve_report.py"), plain],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
+    assert "cache observatory" not in out.stdout
+
+
+# ---------------------------------------------------------------------------
 # fleet-event timeline (kind "fleet", supervisor / serve_fleet.py)
 # ---------------------------------------------------------------------------
 

@@ -337,6 +337,29 @@ def test_sum_numeric_and_aggregated_metrics(stubs):
     assert set(m["backends"]) == {"backend_0", "backend_1"}
 
 
+def _heat(prefix, hits):
+    return {"prefix": prefix, "hits": hits, "hit_tokens": hits * 4,
+            "residency": hits, "peak_refcount": 1, "evictions": 0,
+            "regret": 0, "last_access_age": 1}
+
+
+def test_aggregated_metrics_merges_cache_heat_tables(stubs):
+    """Numeric cache counters sum via _sum_numeric, but heat_top is a
+    list (silently dropped by the numeric fold) — the router must merge
+    it explicitly by salted prefix across replicas."""
+    a = stubs("a", metrics_extra={"engine": {"cache": {
+        "probes": 10, "hits": 6,
+        "heat_top": [_heat("aaaa", 5), _heat("bbbb", 1)]}}})
+    b = stubs("b", metrics_extra={"engine": {"cache": {
+        "probes": 4, "hits": 2,
+        "heat_top": [_heat("aaaa", 2)]}}})
+    router = ReplicaRouter([a.url, b.url], health_interval_secs=999)
+    cache = router.aggregated_metrics()["aggregate"]["engine"]["cache"]
+    assert cache["probes"] == 14 and cache["hits"] == 8
+    top = {e["prefix"]: e["hits"] for e in cache["heat_top"]}
+    assert top == {"aaaa": 7, "bbbb": 1}
+
+
 @pytest.fixture
 def router_server(stubs):
     a, b = stubs("a"), stubs("b")

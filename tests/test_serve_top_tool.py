@@ -33,6 +33,11 @@ def _replica_snap(requests=10, tokens=500, bubble=None):
             "queue_depth": 1, "mean_batch_occupancy": 2.5,
             "prefix_cache_hits": 6, "prefix_cache_misses": 2,
             "engine_restarts": 1,
+            "cache": {
+                "probes": 8, "hits": 6,
+                "evictions_capacity": 1, "evictions_churn": 3,
+                "ghost": {"x10": {"hit_rate": 0.9}},
+            },
         },
     }
     if bubble is not None:
@@ -84,6 +89,12 @@ def test_build_snapshot_router_view():
     assert r0["occupancy"] == 2.5
     assert r0["ttft_p95_secs"] == 0.12
     assert r0["cache_hit_rate"] == pytest.approx(0.75)
+    # cache observatory cumulative counters ride into the row; the
+    # windowed rates need a previous frame (add_rates)
+    assert r0["cache_probes"] == 8 and r0["cache_hits"] == 6
+    assert r0["cache_evictions"] == 4
+    assert r0["ghost_x10_hit_rate"] == pytest.approx(0.9)
+    assert r0["cache_hit_rate_window"] is None
     assert r0["host_bubble_pct"] == 35.5
     assert r0["loop_stalls"] == 2
     assert r0["engine_restarts"] == 1
@@ -112,6 +123,10 @@ def test_add_rates_from_frame_deltas():
     doc = _fleet_doc()
     doc["backends"]["backend_0"]["tokens_generated"] += 50
     doc["backends"]["backend_2"]["tokens_generated"] += 30
+    cache0 = doc["backends"]["backend_0"]["engine"]["cache"]
+    cache0["probes"] += 10                  # this frame: 5/10 hit
+    cache0["hits"] += 5
+    cache0["evictions_churn"] += 6          # 6 evictions / 2s
     cur = serve_top.build_snapshot("http://x", doc)
     cur["time_unix"] = 102.0
     serve_top.add_rates(cur, prev)
@@ -120,6 +135,11 @@ def test_add_rates_from_frame_deltas():
     assert rows["backend_2"]["tokens_per_sec"] == pytest.approx(15.0)
     assert rows["backend_1"]["tokens_per_sec"] is None
     assert cur["fleet"]["tokens_per_sec"] == pytest.approx(40.0)
+    # windowed cache hit rate is THIS frame's delta, not lifetime
+    assert rows["backend_0"]["cache_hit_rate_window"] == pytest.approx(0.5)
+    assert rows["backend_0"]["evictions_per_sec"] == pytest.approx(3.0)
+    assert rows["backend_2"]["cache_hit_rate_window"] is None  # no delta
+    assert rows["backend_1"]["evictions_per_sec"] is None
     # first frame: no previous, rates stay None
     fresh = serve_top.build_snapshot("http://x", _fleet_doc())
     serve_top.add_rates(fresh, {})
@@ -183,8 +203,8 @@ def test_cli_once_table_renders(stub_fleet, capsys):
     assert "replicas 2/3" in out
     assert "routers 2/2" in out
     assert "BROWNOUT" in out
-    for col in ("replica", "occ", "tok/s", "ttft_p95", "bubble%",
-                "stalls", "restarts"):
+    for col in ("replica", "occ", "tok/s", "ttft_p95", "hit%", "whit%",
+                "g10%", "ev/s", "bubble%", "stalls", "restarts"):
         assert col in out
     assert "DOWN" in out and "DRAIN" in out
 

@@ -268,8 +268,10 @@ def test_engine_zero_recompiles_after_warmup(engine, model_and_params,
     lengths, mixed sampling params, churn through slots) triggers ZERO
     XLA compiles — the continuous-batching property the fixed-shape
     step design exists for.  The full observability stack (JSONL stream,
-    per-request phase attribution, SLO histograms) runs during the
-    traffic: it is host-side-only bookkeeping and must stay free."""
+    per-request phase attribution, SLO histograms, and the cache
+    observatory's heat/forensics/ghost-tier bookkeeping — prefix
+    caching is on by default) runs during the traffic: it is
+    host-side-only bookkeeping and must stay free."""
     from megatron_llm_tpu import telemetry
     from megatron_llm_tpu.text_generation_server import ServerMetrics
 
@@ -336,7 +338,7 @@ def test_request_done_schema_golden(engine, tmp_path):
     the schema history comment in telemetry.py)."""
     from megatron_llm_tpu import telemetry
 
-    assert telemetry.TELEMETRY_SCHEMA_VERSION == 10
+    assert telemetry.TELEMETRY_SCHEMA_VERSION == 11
     captured = []
     engine.request_done_hook = captured.append
     stream = telemetry.TelemetryStream(str(tmp_path))
@@ -361,7 +363,8 @@ def test_request_done_schema_golden(engine, tmp_path):
         "accept_rate", "finish_reason", "ttft_secs", "latency_secs",
         "tpot_secs", "phases", "paged_kernel", "prefill_kernel",
         "queue_depth", "blocks_free", "blocks_in_use",
-        "blocks_cached_reusable"))
+        "blocks_cached_reusable", "miss_cold_blocks",
+        "miss_evicted_blocks"))
     assert frozenset(rec["phases"]) == frozenset((
         "queue_secs", "admission_secs", "prefill_secs", "decode_secs",
         "stream_write_secs"))
@@ -422,6 +425,14 @@ def test_engine_stats_shape(engine):
         pytest.approx(100.0, abs=0.01)
     assert loop["window"]["dispatches"] > 0
     assert "loop_device_secs" in loop["histograms"]
+    # the cache observatory block (cache_observatory.py) rides along too
+    cache = s["cache"]
+    assert cache["probes"] == cache["hits"] + cache["misses"]
+    assert cache["misses"] == cache["miss_cold"] + cache["miss_evicted"]
+    assert set(cache["ghost"]) == {"x2", "x4", "x10"}
+    for tier in cache["ghost"].values():
+        assert tier["hits"] >= 0 and tier["capacity_blocks"] > 0
+    assert isinstance(cache["heat_top"], list)
 
 
 # ---------------------------------------------------------------------------

@@ -94,6 +94,13 @@ JSON_SCHEMA_KEYS = (
     # device-busy vs host-bubble share of the loop's busy time — the
     # before/after line a host/device-overlap A/B reads
     "device_busy_pct", "host_bubble_pct",
+    # cache observatory (engine cache block deltas over the run):
+    # skewed-popularity workload knobs, the miss-cause split, eviction
+    # forensics, and per-ghost-tier projected hit rates ({"x2": ...})
+    "prefix_zipf", "prefix_pool",
+    "cache_miss_cold", "cache_miss_evicted",
+    "cache_evictions_capacity", "cache_evictions_churn",
+    "ghost_hit_rates",
 )
 
 
@@ -241,19 +248,43 @@ def _one_request_to(base_url: str, payload: dict, stream: bool,
                 "error": f"{type(e).__name__}: {e}"}
 
 
+def _zipf_rank(rng, pool: int, alpha: float) -> int:
+    """Draw a rank in [0, pool) with probability proportional to
+    1/(rank+1)**alpha — rank 0 is the hottest prefix."""
+    weights = [1.0 / (r + 1) ** alpha for r in range(max(pool, 1))]
+    u = rng.random() * sum(weights)
+    acc = 0.0
+    for r, w in enumerate(weights):
+        acc += w
+        if u <= acc:
+            return r
+    return len(weights) - 1
+
+
 def build_prompt(ticket: int, prompt: str, prefix_tokens: int,
-                 shared_prefix_frac: float, seed: int) -> str:
+                 shared_prefix_frac: float, seed: int,
+                 prefix_zipf: float = 0.0, prefix_pool: int = 16) -> str:
     """Per-ticket prompt for the repeated-prefix workload.  A
     ``shared_prefix_frac`` fraction of tickets open with the same
     ``prefix_tokens``-word header (one small-number word ≈ one token for
     numeric tokenizers) and differ only in a short unique tail; the rest
-    get fully unique prompts.  Deterministic in (ticket, seed)."""
+    get fully unique prompts.  Deterministic in (ticket, seed).
+
+    With ``prefix_zipf`` > 0 the shared header is instead drawn from a
+    pool of ``prefix_pool`` distinct prefixes with Zipf(alpha)-skewed
+    popularity — the workload the cache observatory's heat table and
+    ghost capacity tiers are built to attribute (a few hot prefixes,
+    a long cold tail that churns the LRU)."""
     if prefix_tokens <= 0:
         return prompt
     rng = random.Random(seed * 100003 + ticket)
     tail = " ".join(str(rng.randrange(10, 50)) for _ in range(4))
     if rng.random() < shared_prefix_frac:
-        header = " ".join(["7"] * prefix_tokens)
+        if prefix_zipf > 0:
+            word = str(100 + _zipf_rank(rng, prefix_pool, prefix_zipf))
+        else:
+            word = "7"
+        header = " ".join([word] * prefix_tokens)
         return f"{header} {tail}"
     # unique header of the same length: submits the same prefill volume
     # but can never hit the shared-prefix cache entries
@@ -268,6 +299,8 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
               timeout: float = 300.0, seed: int = 0,
               prefix_tokens: int = 0,
               shared_prefix_frac: float = 1.0,
+              prefix_zipf: float = 0.0,
+              prefix_pool: int = 16,
               rate_schedule: str = None,
               temperature: float = None) -> dict:
     """Drive the load and aggregate results (importable — the tier-1
@@ -322,7 +355,8 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                 time.sleep(rng.expovariate(rate / max(clients, 1)))
             payload = {"prompts": [build_prompt(
                            ticket, prompt, prefix_tokens,
-                           shared_prefix_frac, seed)],
+                           shared_prefix_frac, seed,
+                           prefix_zipf, prefix_pool)],
                        "tokens_to_generate": int(tokens),
                        "no_log": True}
             if temperature is not None:
@@ -396,6 +430,8 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         "segments": None,
         "prefix_tokens": prefix_tokens,
         "shared_prefix_frac": shared_prefix_frac,
+        "prefix_zipf": prefix_zipf,
+        "prefix_pool": prefix_pool,
         # prefix-cache effectiveness (engine /metrics deltas; None when
         # the server has no engine metrics to delta)
         "prefill_tokens_submitted": None,
@@ -428,6 +464,14 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         # engine-loop goodput (loop_profiler deltas over the run)
         "device_busy_pct": None,
         "host_bubble_pct": None,
+        # cache observatory (engine cache block deltas over the run):
+        # miss-cause split, eviction forensics, and per-ghost-tier
+        # projected hit rates computed from hit/probe counter deltas
+        "cache_miss_cold": None,
+        "cache_miss_evicted": None,
+        "cache_evictions_capacity": None,
+        "cache_evictions_churn": None,
+        "ghost_hit_rates": None,
     }
     if schedule:
         segs = []
@@ -503,6 +547,40 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                 # themselves never delta or sum; a router's aggregate
                 # sums the per-replica counters, which still deltas
                 # correctly)
+                # cache observatory: miss-cause / forensics deltas and
+                # ghost tier hit rates over this run's probes only
+                c0 = e0.get("cache")
+                c1 = e1.get("cache")
+                if isinstance(c0, dict) and isinstance(c1, dict):
+                    def cache_delta(key):
+                        a, b = c0.get(key), c1.get(key)
+                        if isinstance(a, (int, float)) \
+                                and isinstance(b, (int, float)):
+                            return b - a
+                        return None
+                    out["cache_miss_cold"] = cache_delta("miss_cold")
+                    out["cache_miss_evicted"] = cache_delta("miss_evicted")
+                    out["cache_evictions_capacity"] = cache_delta(
+                        "evictions_capacity")
+                    out["cache_evictions_churn"] = cache_delta(
+                        "evictions_churn")
+                    g0 = c0.get("ghost")
+                    g1 = c1.get("ghost")
+                    if isinstance(g0, dict) and isinstance(g1, dict):
+                        rates = {}
+                        for tier, t1 in sorted(g1.items()):
+                            t0g = g0.get(tier)
+                            if not (isinstance(t0g, dict)
+                                    and isinstance(t1, dict)):
+                                continue
+                            dh = (t1.get("hits") or 0) - \
+                                (t0g.get("hits") or 0)
+                            dp = dh + (t1.get("misses") or 0) - \
+                                (t0g.get("misses") or 0)
+                            if dp > 0:
+                                rates[tier] = round(dh / dp, 4)
+                        if rates:
+                            out["ghost_hit_rates"] = rates
                 l0 = e0.get("loop")
                 l1 = e1.get("loop")
                 if isinstance(l0, dict) and isinstance(l1, dict):
@@ -605,6 +683,19 @@ def print_table(r: dict) -> None:
              f"{_fmt(r['prefix_cache_misses'])}/"
              f"{_fmt(r['prefix_cache_evictions'])}"),
         ]
+    if r.get("cache_miss_cold") is not None:
+        rows += [
+            ("cache miss cold/evicted",
+             f"{_fmt(r['cache_miss_cold'])}/"
+             f"{_fmt(r['cache_miss_evicted'])}"),
+            ("cache evict capacity/churn",
+             f"{_fmt(r['cache_evictions_capacity'])}/"
+             f"{_fmt(r['cache_evictions_churn'])}"),
+        ]
+    if r.get("ghost_hit_rates"):
+        rows += [("ghost tier hit rates",
+                  " ".join(f"{t}={v:.3f}"
+                           for t, v in sorted(r["ghost_hit_rates"].items())))]
     w = max(len(k) for k, _ in rows)
     print(f"serve_bench: {r['clients']} clients -> {r['url']}"
           + (" (stream)" if r["stream"] else ""))
@@ -659,6 +750,13 @@ def main(argv=None):
                    help="repeated-prefix workload: shared prompt header "
                         "length in words (0 = off, all prompts identical "
                         "to --prompt)")
+    p.add_argument("--prefix_zipf", type=float, default=0.0,
+                   help="skewed-popularity prefix workload: draw each "
+                        "shared header from a pool of --prefix_pool "
+                        "distinct prefixes with Zipf(ALPHA) popularity "
+                        "(0 = single shared prefix, the default)")
+    p.add_argument("--prefix_pool", type=int, default=16,
+                   help="distinct shared prefixes for --prefix_zipf")
     p.add_argument("--shared_prefix_frac", type=float, default=1.0,
                    help="fraction of requests sharing the header; the "
                         "rest get unique same-length headers")
@@ -680,6 +778,8 @@ def main(argv=None):
               stream=args.stream, timeout=args.timeout, seed=args.seed,
               prefix_tokens=args.prefix_tokens,
               shared_prefix_frac=args.shared_prefix_frac,
+              prefix_zipf=args.prefix_zipf,
+              prefix_pool=args.prefix_pool,
               rate_schedule=args.rate_schedule,
               temperature=args.temperature)
     if args.ab:

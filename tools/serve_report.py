@@ -31,6 +31,14 @@ trace_id, per-request phase attribution, tpot_secs) and prints:
   the dispatch-gap stall count — the offline twin of ``/metrics``'
   ``engine.loop`` block; absent (and the report unchanged) on logs
   written before schema 10
+* cache observatory — ``cache_stats`` rollups (telemetry schema >= 11,
+  serving/cache_observatory.py): the per-prefix heat top-K (salted
+  digests only — never token ids), the miss-cause breakdown (cold vs
+  evicted-then-wanted-again regret), eviction forensics (capacity vs
+  churn), and the ghost capacity projection — per simulated tier
+  (2x/4x/10x the block pool) the exact hit rate a bigger cache would
+  have had on this trace plus the projected TTFT savings at the log's
+  measured prefill throughput; absent on logs before schema 11
 * per-replica comparison — pass several JSONL files/dirs (one per
   replica) and each gets its own column plus the fleet total
 * fleet-event timeline — supervisor events (``kind: "fleet"``, schema
@@ -95,12 +103,18 @@ def load_loop_stats(path: str) -> List[Dict]:
     return _load(path)[3]
 
 
+def load_cache_stats(path: str) -> List[Dict]:
+    """cache_stats rollups (telemetry schema >= 11) from a serve log,
+    in file order (cumulative per engine lifetime)."""
+    return _load(path)[4]
+
+
 def _load(path: str):
     if os.path.isdir(path):
         path = os.path.join(path, STREAM_FILENAME)
     if not os.path.exists(path):
         raise FileNotFoundError(f"no serve log at {path}")
-    records, events, fleet, loop = [], [], [], []
+    records, events, fleet, loop, cache = [], [], [], [], []
     with open(path) as f:
         for line in f:
             try:
@@ -117,9 +131,11 @@ def _load(path: str):
                 records.append(rec)
             elif rec.get("event") == "engine_loop_stats":
                 loop.append(rec)
+            elif rec.get("event") == "cache_stats":
+                cache.append(rec)
             elif rec.get("event") in RESILIENCE_EVENTS:
                 events.append(rec)
-    return records, events, fleet, loop
+    return records, events, fleet, loop, cache
 
 
 def _percentile(values: List[float], q: float) -> Optional[float]:
@@ -298,6 +314,98 @@ def loop_goodput_summary(per_path: List[List[Dict]]) -> Dict:
     return out
 
 
+CACHE_COUNTER_KEYS = ("match_calls", "probes", "hits", "misses",
+                      "hit_tokens", "miss_cold", "miss_evicted",
+                      "evictions_capacity", "evictions_churn",
+                      "pool_resets", "inclusion_divergences")
+
+# heat-table counters summed on fleet merge; mirrors
+# serving/cache_observatory.py merge_heat_tops (stdlib re-implementation)
+_HEAT_SUM_KEYS = ("hits", "hit_tokens", "residency", "evictions",
+                  "regret")
+
+
+def _merge_heat(tables: List[List[Dict]], k: int = 16) -> List[Dict]:
+    merged: Dict[str, Dict] = {}
+    for table in tables:
+        if not isinstance(table, (list, tuple)):
+            continue
+        for e in table:
+            if not isinstance(e, dict) or "prefix" not in e:
+                continue
+            cur = merged.get(e["prefix"])
+            if cur is None:
+                merged[e["prefix"]] = dict(e)
+                continue
+            for f in _HEAT_SUM_KEYS:
+                cur[f] = (cur.get(f) or 0) + (e.get(f) or 0)
+            cur["peak_refcount"] = max(cur.get("peak_refcount") or 0,
+                                       e.get("peak_refcount") or 0)
+    out = sorted(merged.values(),
+                 key=lambda e: (-(e.get("hits") or 0)))
+    return out[:k]
+
+
+def cache_observatory_summary(per_path: List[List[Dict]],
+                              prefill: Dict,
+                              requests: int = 0) -> Dict:
+    """Cache observatory rollup from ``cache_stats`` records: counters
+    are cumulative per engine lifetime, so totals come from each log's
+    final record; heat tables merge by salted prefix (fleet-wide when
+    the replicas share MEGATRON_CACHE_SALT).
+
+    The ghost capacity projection prices each simulated tier's extra
+    hit tokens at the log's measured prefill throughput: the prefill
+    seconds (≈ TTFT) a 2x/4x/10x pool would have saved on this trace."""
+    totals = {key: 0 for key in CACHE_COUNTER_KEYS}
+    ghost: Dict[str, Dict] = {}
+    heat_tables = []
+    for recs in per_path:
+        if not recs:
+            continue
+        final = recs[-1]
+        for key in CACHE_COUNTER_KEYS:
+            v = final.get(key)
+            if isinstance(v, (int, float)):
+                totals[key] += v
+        heat_tables.append(final.get("heat_top") or [])
+        for tier, t in (final.get("ghost") or {}).items():
+            if not isinstance(t, dict):
+                continue
+            g = ghost.setdefault(tier, {"hits": 0, "misses": 0,
+                                        "hit_tokens": 0, "evictions": 0,
+                                        "capacity_blocks": 0})
+            for key in g:
+                v = t.get(key)
+                if isinstance(v, (int, float)):
+                    g[key] += v
+    probes = totals["probes"]
+    out: Dict[str, object] = {
+        **totals,
+        "hit_rate": (totals["hits"] / probes) if probes else None,
+        "heat_top": _merge_heat(heat_tables),
+    }
+    prefill_tps = (prefill or {}).get("tokens_per_sec")
+    tiers = {}
+    for tier, g in ghost.items():
+        t_probes = g["hits"] + g["misses"]
+        extra_tokens = max(g["hit_tokens"] - totals["hit_tokens"], 0)
+        saved = (extra_tokens / prefill_tps
+                 if prefill_tps else None)
+        tiers[tier] = {
+            **g,
+            "hit_rate": (g["hits"] / t_probes) if t_probes else None,
+            "extra_hit_tokens": extra_tokens,
+            "prefill_saved_secs_total": saved,
+            "ttft_saved_secs_per_request": (
+                saved / requests if saved is not None and requests
+                else None),
+        }
+    out["ghost"] = dict(sorted(
+        tiers.items(), key=lambda kv: kv[1]["capacity_blocks"]))
+    return out
+
+
 def cache_stratified(records: List[Dict]) -> Dict:
     hits = [r for r in records
             if (r.get("cached_prompt_tokens") or 0) > 0]
@@ -315,12 +423,14 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
     all_events: List[Dict] = []
     all_fleet: List[Dict] = []
     loop_per_path: List[List[Dict]] = []
+    cache_per_path: List[List[Dict]] = []
     for p in paths:
-        records, events, fleet, loop = _load(p)
+        records, events, fleet, loop, cache = _load(p)
         all_records.extend(records)
         all_events.extend(events)
         all_fleet.extend(fleet)
         loop_per_path.append(loop)
+        cache_per_path.append(cache)
         if len(paths) > 1:
             per_replica[p] = {
                 **latency_summary(records),
@@ -362,6 +472,10 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
     if any(loop_per_path):
         # only on schema >= 10 logs; older logs keep the old report shape
         out["loop"] = loop_goodput_summary(loop_per_path)
+    if any(cache_per_path):
+        # only on schema >= 11 logs (cache observatory)
+        out["cache"] = cache_observatory_summary(
+            cache_per_path, out["prefill"], requests=len(all_records))
     if all_fleet:
         out["fleet"] = fleet_summary(all_fleet)
     if per_replica:
@@ -511,6 +625,53 @@ def render(report: Dict) -> str:
                 f"{len(trend)} window(s)"
                 + (f" (window p95 {p95:.1f}%)" if p95 is not None
                    else ""))
+
+    cache = report.get("cache")
+    if cache:
+        hr = cache.get("hit_rate")
+        lines.append(f"\ncache observatory ({cache['probes']} probes, "
+                     + (f"{hr * 100:.1f}% hit rate" if hr is not None
+                        else "no hit rate") + "):")
+        misses = cache.get("misses") or 0
+        mc, me = cache.get("miss_cold") or 0, cache.get("miss_evicted") or 0
+        lines.append(
+            "  miss causes: "
+            + (f"cold {mc} ({mc / misses * 100:.1f}%), evicted-then-"
+               f"wanted {me} ({me / misses * 100:.1f}%)" if misses
+               else "none"))
+        lines.append(f"  evictions: capacity {cache['evictions_capacity']}"
+                     f", churn {cache['evictions_churn']}"
+                     + (f", pool resets {cache['pool_resets']}"
+                        if cache.get("pool_resets") else ""))
+        heat = cache.get("heat_top") or []
+        if heat:
+            lines.append("  hottest prefixes (salted digests):")
+            lines.append(f"    {'prefix':<18} {'hits':>7} {'tokens':>8} "
+                         f"{'peak_rc':>7} {'evict':>6} {'regret':>6}")
+            for e in heat[:10]:
+                lines.append(
+                    f"    {e.get('prefix', '?'):<18} "
+                    f"{e.get('hits', 0):>7} "
+                    f"{e.get('hit_tokens', 0):>8} "
+                    f"{e.get('peak_refcount', 0):>7} "
+                    f"{e.get('evictions', 0):>6} "
+                    f"{e.get('regret', 0):>6}")
+        ghost = cache.get("ghost") or {}
+        if ghost:
+            lines.append("  capacity projection (ghost tiers — exact "
+                         "replay, not an estimate):")
+            lines.append(f"    {'tier':<5} {'blocks':>7} {'hit rate':>9} "
+                         f"{'extra tok':>10} {'ttft saved/req':>15}")
+            for tier, g in ghost.items():
+                ghr = g.get("hit_rate")
+                saved = g.get("ttft_saved_secs_per_request")
+                lines.append(
+                    f"    {tier:<5} {g.get('capacity_blocks', 0):>7} "
+                    + (f"{ghr * 100:>8.1f}%" if ghr is not None
+                       else f"{'-':>9}")
+                    + f" {g.get('extra_hit_tokens', 0):>10} "
+                    + (f"{saved:>14.4f}s" if saved is not None
+                       else f"{'-':>15}"))
 
     fleet = report.get("fleet")
     if fleet:

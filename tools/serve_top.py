@@ -4,7 +4,9 @@
 Polls one endpoint's ``GET /metrics`` — point it at any router of a
 sharded front door for the peer-merged fleet view, or directly at a
 single replica — and renders a refreshing per-replica table:
-occupancy, tokens/sec, TTFT/TPOT p95, prefix-cache hit rate, the
+occupancy, tokens/sec, TTFT/TPOT p95, prefix-cache hit rate (lifetime
+and frame-windowed), the ghost x10 projected hit rate and evictions/sec
+from the cache observatory (serving/cache_observatory.py), the
 engine-loop ``host bubble %`` (serving/loop_profiler.py), engine
 restarts, and router brownout state.
 
@@ -87,6 +89,10 @@ def _replica_row(name: str, url, snap) -> dict:
         "occupancy": None, "queue_depth": None,
         "ttft_p95_secs": None, "tpot_p95_secs": None,
         "cache_hit_rate": None,
+        "cache_probes": None, "cache_hits": None,
+        "cache_hit_rate_window": None,
+        "cache_evictions": None, "evictions_per_sec": None,
+        "ghost_x10_hit_rate": None,
         "device_busy_pct": None, "host_bubble_pct": None,
         "loop_stalls": None, "engine_restarts": None,
         "draining": False,
@@ -117,6 +123,17 @@ def _replica_row(name: str, url, snap) -> dict:
         row["host_bubble_pct"] = _num(eng, "loop", "host_bubble_pct")
         row["loop_stalls"] = _num(eng, "loop", "stalls")
         row["engine_restarts"] = _num(eng, "engine_restarts")
+        # cache observatory block (serving/cache_observatory.py):
+        # cumulative counters here; the windowed rates come from frame
+        # deltas in add_rates
+        row["cache_probes"] = _num(eng, "cache", "probes")
+        row["cache_hits"] = _num(eng, "cache", "hits")
+        ec = _num(eng, "cache", "evictions_capacity")
+        eh = _num(eng, "cache", "evictions_churn")
+        if ec is not None or eh is not None:
+            row["cache_evictions"] = (ec or 0) + (eh or 0)
+        row["ghost_x10_hit_rate"] = _num(eng, "cache", "ghost", "x10",
+                                         "hit_rate")
     return row
 
 
@@ -194,6 +211,23 @@ def add_rates(snapshot: dict, prev: dict) -> None:
         row["tokens_per_sec"] = round(rate, 2)
         fleet_rate += rate
         any_rate = True
+    for row in snapshot["replicas"]:
+        p = prev_rows.get(row["name"])
+        if p is None:
+            continue
+        # windowed cache hit rate: hits/probes over this frame only
+        if (row["cache_probes"] is not None
+                and p.get("cache_probes") is not None):
+            dp = row["cache_probes"] - p["cache_probes"]
+            dh = (row["cache_hits"] or 0) - (p.get("cache_hits") or 0)
+            if dp > 0:
+                row["cache_hit_rate_window"] = round(
+                    max(min(dh / dp, 1.0), 0.0), 4)
+        if (row["cache_evictions"] is not None
+                and p.get("cache_evictions") is not None):
+            row["evictions_per_sec"] = round(
+                max(row["cache_evictions"] - p["cache_evictions"], 0) / dt,
+                2)
     if any_rate:
         snapshot["fleet"]["tokens_per_sec"] = round(fleet_rate, 2)
 
@@ -217,6 +251,9 @@ COLUMNS = (
     ("ttft_p95", 9, "ttft_p95_secs", ".3f"),
     ("tpot_p95", 9, "tpot_p95_secs", ".4f"),
     ("hit%", 7, None, ""),
+    ("whit%", 7, None, ""),
+    ("g10%", 6, None, ""),
+    ("ev/s", 6, "evictions_per_sec", ".1f"),
     ("bubble%", 8, "host_bubble_pct", ".1f"),
     ("stalls", 7, "loop_stalls", "d"),
     ("restarts", 8, "engine_restarts", "d"),
@@ -249,8 +286,10 @@ def render(snapshot: dict) -> str:
             if h == "up":
                 v = ("DRAIN" if row["draining"]
                      else "up" if row["alive"] else "DOWN")
-            elif h == "hit%":
-                hr = row["cache_hit_rate"]
+            elif h in ("hit%", "whit%", "g10%"):
+                hr = row[{"hit%": "cache_hit_rate",
+                          "whit%": "cache_hit_rate_window",
+                          "g10%": "ghost_x10_hit_rate"}[h]]
                 v = _fmt(100.0 * hr, ".1f") if hr is not None else "-"
             else:
                 v = _fmt(row.get(key), spec)

@@ -142,6 +142,14 @@ MANIFEST: List[Step] = [
          "python -m pytest tests/test_loop_profiler.py "
          "-m slow -k loop_overhead -q -p no:cacheprovider",
          900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+    # cache observatory overhead gate: heat attribution + eviction
+    # forensics + three synchronous ghost tiers must stay under 2% of a
+    # measured CPU dispatch — the observability tax may not erode the
+    # goodput it exists to project
+    Step("serve_cache_overhead",
+         "python -m pytest tests/test_cache_observatory.py "
+         "-m slow -k cache_overhead -q -p no:cacheprovider",
+         900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
 ]
 
 
