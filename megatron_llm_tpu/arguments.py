@@ -470,6 +470,14 @@ def _add_inference_args(parser):
                    help="share KV pages across requests with equal "
                         "prompt prefixes (refcounted copy-on-write "
                         "pages, LRU reuse); 0 disables")
+    g.add_argument("--serve_host_cache_bytes", type=int, default=0,
+                   help="host-RAM budget (bytes) for the hierarchical "
+                        "KV cache spill tier under the prefix cache: "
+                        "pages falling off the HBM LRU spill "
+                        "asynchronously and swap back in with one "
+                        "fixed-shape host-to-device scatter on a later "
+                        "prefix match (serving/host_cache.py); 0 "
+                        "disables the tier")
     # serving resilience (serving/resilience.py;
     # docs/guide/fault_tolerance.md "Serving resilience")
     g.add_argument("--serve_watchdog_secs", type=float, default=0.0,

@@ -13,6 +13,7 @@ from megatron_llm_tpu.serving.cache_observatory import (
     merge_heat_tops,
 )
 from megatron_llm_tpu.serving.engine import EngineConfig, InferenceEngine
+from megatron_llm_tpu.serving.host_cache import HostKVCache
 from megatron_llm_tpu.serving.kv_blocks import (
     BlockManager,
     NoCapacity,
@@ -74,6 +75,7 @@ __all__ = [
     "FINISH_NONFINITE",
     "FleetSnapshot",
     "FleetSupervisor",
+    "HostKVCache",
     "InferenceEngine",
     "LOOP_PHASES",
     "LocalProcessBackend",

@@ -243,13 +243,14 @@ class _MatchToken:
     """Opaque result of record_match(), handed back to record_admit()
     on alloc success so the pair needs no hidden shared state."""
 
-    __slots__ = ("digests", "real_matched", "ghost_matched",
+    __slots__ = ("digests", "real_matched", "host_matched", "ghost_matched",
                  "miss_cold", "miss_evicted")
 
-    def __init__(self, digests, real_matched, ghost_matched,
+    def __init__(self, digests, real_matched, host_matched, ghost_matched,
                  miss_cold, miss_evicted):
         self.digests = digests
         self.real_matched = real_matched
+        self.host_matched = host_matched
         self.ghost_matched = ghost_matched
         self.miss_cold = miss_cold
         self.miss_evicted = miss_evicted
@@ -280,6 +281,10 @@ class CacheObservatory:
         "evictions_churn": "_lock",
         "pool_resets": "_lock",
         "inclusion_divergences": "_lock",
+        "host_hits": "_lock",
+        "host_hit_tokens": "_lock",
+        "swap_in_blocks": "_lock",
+        "_host": "_lock",
         "_heat": "_lock",
         "_heat_evicted": "_lock",
         "_evicted": "_lock",
@@ -333,8 +338,23 @@ class CacheObservatory:
         self.evictions_churn = 0
         self.pool_resets = 0
         self.inclusion_divergences = 0    # see record_commit / record_cow
+        # host spill tier (serving/host_cache.py), attached by the
+        # engine when --serve_host_cache_bytes > 0.  ``hits`` above is
+        # the TWO-TIER rate (HBM + host) — directly comparable to the
+        # ghost tiers' counterfactuals; host_hits attributes the subset
+        # the spill tier rescued.
+        self._host = None
+        self.host_hits = 0
+        self.host_hit_tokens = 0
+        self.swap_in_blocks = 0
         self._emitted_at_matches = 0
         self._emitted_at_time = self._clock()
+
+    def attach_host(self, host) -> None:
+        """Wire the host spill tier's stats into the ``cache`` block
+        (the tier is engine-lifetime, like this object)."""
+        with self._lock:
+            self._host = host
 
     # -- keys -----------------------------------------------------------
 
@@ -366,25 +386,29 @@ class CacheObservatory:
 
     # -- BlockManager hooks (called with the manager lock held) ---------
 
-    def record_match(self, digests: Sequence[bytes],
-                     matched: int) -> _MatchToken:
+    def record_match(self, digests: Sequence[bytes], matched: int,
+                     host_matched: int = 0) -> _MatchToken:
         """One _match_prefix_locked call: ``matched`` of ``digests``
-        hit the real cache.  Updates heat for the hits, classifies the
-        misses (regret vs cold), and runs every ghost tier's lookup.
-        The returned token goes to record_admit() if the alloc
-        succeeds — a NoCapacity alloc counted its probes, like the
-        real counters do."""
+        hit the real (HBM) cache and the next ``host_matched`` hit the
+        host spill tier.  ``hits`` counts both — the two-tier rate —
+        with host_hits attributing the spill tier's share.  Updates
+        heat for the hits (tier-agnostic: a rescued prefix is just as
+        hot), classifies the misses (regret vs cold), and runs every
+        ghost tier's lookup.  The returned token goes to
+        record_admit() if the alloc succeeds — a NoCapacity alloc
+        counted its probes, like the real counters do."""
         with self._lock:
             self.match_calls += 1
             self.probes += len(digests)
-            self.hits += matched
-            self.misses += len(digests) - matched
-            for d in digests[:matched]:
+            self.hits += matched + host_matched
+            self.host_hits += host_matched
+            self.misses += len(digests) - matched - host_matched
+            for d in digests[:matched + host_matched]:
                 e = self._heat_touch_locked(d)
                 e["hits"] += 1
                 e["hit_tokens"] += self.block_size
             miss_cold = miss_evicted = 0
-            for d in digests[matched:]:
+            for d in digests[matched + host_matched:]:
                 if d in self._evicted:
                     miss_evicted += 1
                     key = self.salted_key(d)
@@ -396,7 +420,7 @@ class CacheObservatory:
             self.miss_cold += miss_cold
             self.miss_evicted += miss_evicted
             ghost = {t.mult: t.lookup_locked(digests) for t in self._tiers}
-        return _MatchToken(list(digests), matched, ghost,
+        return _MatchToken(list(digests), matched, host_matched, ghost,
                            miss_cold, miss_evicted)
 
     def record_admit(self, slot: int, token: Optional[_MatchToken],
@@ -406,7 +430,9 @@ class CacheObservatory:
         digests accrue refcount-weighted residency."""
         with self._lock:
             if token is not None:
-                self.hit_tokens += token.real_matched * self.block_size
+                self.hit_tokens += (token.real_matched
+                                    + token.host_matched) * self.block_size
+                self.host_hit_tokens += token.host_matched * self.block_size
                 for d, rc in zip(token.digests, refcounts):
                     e = self._heat.get(self.salted_key(d))
                     if e is not None:
@@ -476,6 +502,23 @@ class CacheObservatory:
         longer holds; stop asserting it."""
         with self._lock:
             self.inclusion_divergences += int(n)
+
+    def record_swap_in(self, registered: Sequence[bytes],
+                       n_blocks: int) -> None:
+        """complete_swap_ins registered ``registered`` digests back
+        into the HBM cache after scattering ``n_blocks`` host pages to
+        device.  A swapped-in digest the smallest ghost tier does not
+        hold breaks the real⊆ghost stack property (the two-tier real
+        cache resurrects digests a single-tier counterfactual lost) —
+        counted like the other inclusion divergences so
+        check_invariants() stops asserting strict inclusion, which is
+        genuinely no longer the cache's discipline."""
+        with self._lock:
+            self.swap_in_blocks += int(n_blocks)
+            if self._tiers:
+                t0 = self._tiers[0]
+                self.inclusion_divergences += sum(
+                    1 for d in registered if d not in t0.table)
 
     def record_free(self, slot: int) -> None:
         with self._lock:
@@ -547,6 +590,9 @@ class CacheObservatory:
                 "hit_tokens": self.hit_tokens,
                 "hit_rate": (round(self.hits / probes, 4)
                              if probes else None),
+                "host_hits": self.host_hits,
+                "host_hit_tokens": self.host_hit_tokens,
+                "swap_in_blocks": self.swap_in_blocks,
                 "miss_cold": self.miss_cold,
                 "miss_evicted": self.miss_evicted,
                 "evictions_capacity": self.evictions_capacity,
@@ -557,6 +603,8 @@ class CacheObservatory:
                 "heat_evicted": self._heat_evicted,
                 "heat_top": self._heat_top_locked(),
                 "ghost": {f"x{t.mult}": t.stats() for t in self._tiers},
+                "host": (self._host.stats() if self._host is not None
+                         else {"enabled": 0}),
             }
 
     def cache_stats_record(self) -> Dict[str, Any]:
@@ -594,10 +642,13 @@ class CacheObservatory:
     def check_invariants(self,
                          real_cache: Optional[Dict[bytes, int]] = None,
                          real_hits: Optional[int] = None,
-                         real_misses: Optional[int] = None) -> None:
+                         real_misses: Optional[int] = None,
+                         real_host_hits: Optional[int] = None) -> None:
         with self._lock:
             assert self.hits + self.misses == self.probes
             assert self.miss_cold + self.miss_evicted == self.misses
+            assert self.host_hits <= self.hits, \
+                "host-tier hits exceed two-tier total"
             # heat keys only ever come from digests the cache touched;
             # every hit digest was registered, so (within the bounded
             # seen-ledger horizon) heat ⊆ seen
@@ -639,6 +690,8 @@ class CacheObservatory:
                 assert self.hits == real_hits
             if real_misses is not None:
                 assert self.misses == real_misses
+            if real_host_hits is not None:
+                assert self.host_hits == real_host_hits
 
 
 def merge_heat_tops(tables: Sequence[Sequence[Dict[str, Any]]],

@@ -154,6 +154,14 @@ class EngineConfig:
     # prefix-cache hit rate at N x the pool ("cache" stats block,
     # cache_stats JSONL records)
     cache_ghost_multiples: Tuple[int, ...] = (2, 4, 10)
+    # hierarchical KV cache (--serve_host_cache_bytes;
+    # serving/host_cache.py): host-RAM budget for the spill tier under
+    # the BlockManager.  0 disables the tier entirely (no thread, no
+    # extra compiles).  Pages falling off the HBM LRU spill
+    # asynchronously; admissions match digests against both tiers and
+    # swap matched cold prefixes back with one fixed-shape host→device
+    # scatter compiled at warmup.
+    host_cache_bytes: int = 0
 
 
 def _key_from_seed(seed: int) -> np.ndarray:
@@ -291,13 +299,35 @@ class InferenceEngine:
             self._num_blocks - 1, cfg.block_size,
             ghost_multiples=cfg.cache_ghost_multiples)
 
+        # host spill tier (serving/host_cache.py): constructed after the
+        # first state so the per-block byte size can be read off the
+        # actual page arrays (dtype- and quantization-aware), then wired
+        # into the manager + observatory.  Engine-lifetime like both.
+        self.host_cache = None
         self._st = self._new_state(gen=0)
+        if cfg.host_cache_bytes > 0 and cfg.prefix_cache:
+            from megatron_llm_tpu.serving.host_cache import HostKVCache
+            block_bytes = sum(
+                int(np.prod(v.shape[1:])) * v.dtype.itemsize
+                for p in self._st.pages for v in p.values())
+            self.host_cache = HostKVCache(
+                cfg.host_cache_bytes, block_bytes,
+                fetch=self._spill_fetch)
+            self.cache_observatory.attach_host(self.host_cache)
+            self._st.blocks.attach_host_cache(self.host_cache)
+            self.host_cache.start()
 
         self._decode_step = jax.jit(self._decode_impl)
         self._verify_step = jax.jit(self._verify_impl)
         self._prefill_step = jax.jit(self._prefill_impl)
         self._sample_first = jax.jit(self._sample_first_impl)
         self._cow_copy = jax.jit(self._cow_copy_impl)
+        # host-tier device programs: one fixed-shape whole-page gather
+        # (device→host spill source) and one whole-page scatter
+        # (host→device swap-in), both over traced int32 block indices —
+        # compiled once at warmup, zero steady-state recompiles
+        self._fetch_block = jax.jit(self._fetch_block_impl)
+        self._host_load = jax.jit(self._host_load_impl)
 
         # counters (read by stats()/the HTTP /metrics endpoint)
         self.decode_steps = 0
@@ -348,10 +378,15 @@ class InferenceEngine:
             # the fresh pool starts empty: ghost slots release their
             # blocks but digest residency survives the restart
             self.cache_observatory.on_pool_reset()
+            if self.host_cache is not None:
+                # queued spills reference the abandoned pool; resident
+                # host entries and counters survive the restart
+                self.host_cache.on_pool_reset()
         blocks = BlockManager(self._num_blocks, cfg.block_size,
                               cfg.num_slots, self._max_blocks_per_slot,
                               prefix_cache=cfg.prefix_cache,
-                              observatory=self.cache_observatory)
+                              observatory=self.cache_observatory,
+                              host_cache=self.host_cache)
         sched = Scheduler(self.queue, blocks, cfg.max_model_len,
                           draft_k=self.draft_k)
         if carry is not None:
@@ -360,6 +395,7 @@ class InferenceEngine:
             sched.rejected_len = old.rejected_len
             sched.deadline_evictions = old.deadline_evictions
             sched.preemptions = old.preemptions
+            sched.swap_in_blocks_reserved = old.swap_in_blocks_reserved
             # prefix-cache counters carry too: the observatory's shadow
             # counters are cumulative across restarts (it is shared, see
             # on_pool_reset above), and check_invariants asserts the
@@ -369,6 +405,7 @@ class InferenceEngine:
             blocks.prefix_cache_misses = ob.prefix_cache_misses
             blocks.prefix_cache_evictions = ob.prefix_cache_evictions
             blocks.prefix_cache_hit_tokens = ob.prefix_cache_hit_tokens
+            blocks.prefix_cache_host_hits = ob.prefix_cache_host_hits
             blocks.cow_copies = ob.cow_copies
         S = cfg.num_slots
         return _EngineState(
@@ -521,6 +558,40 @@ class InferenceEngine:
             out.append(q)
         return out
 
+    def _fetch_block_impl(self, pages, src):
+        # whole physical page src across every layer's pool arrays, as a
+        # [per-layer dict] pytree — the spill thread device_gets this to
+        # host RAM.  src is a traced int32 scalar: one compile (at
+        # warmup) covers every spill.
+        return [{k: jax.lax.dynamic_index_in_dim(v, src, axis=0,
+                                                 keepdims=False)
+                 for k, v in p.items()} for p in pages]
+
+    def _host_load_impl(self, pages, host_block, dst):
+        # scatter one host page pytree (the _fetch_block_impl layout)
+        # into physical page dst — the swap-in path.  dst is a traced
+        # int32 scalar, host_block arrays are traced inputs of fixed
+        # per-layer shapes: one compile covers every swap-in.
+        out = []
+        for p, h in zip(pages, host_block):
+            out.append({k: jax.lax.dynamic_update_index_in_dim(
+                v, h[k], dst, axis=0) for k, v in p.items()})
+        return out
+
+    def _spill_fetch(self, manager, block: int):
+        """host_cache spill-thread callback: device→host copy of one
+        page.  Runs on the spill thread with no locks held; the
+        abandoned-manager guard keeps a post-restart queue drain from
+        reading the fresh pool through a stale block id.  Reading live
+        pages without a lock is safe: the spill tier only fetches
+        digest-registered pages, whose content is frozen (COW and
+        eviction both unregister first), and the caller re-validates
+        the (block, epoch) mapping after this returns."""
+        st = self._st
+        if st.blocks is not manager:
+            return None
+        return jax.device_get(self._fetch_block(st.pages, np.int32(block)))
+
     def _sample_first_impl(self, logits, key, top_k, top_p, temp,
                            ban_a, ban_b, last_prompt_tok):
         finite = jnp.isfinite(logits).all()     # sentinel, pre-masking
@@ -615,6 +686,10 @@ class InferenceEngine:
         for req in list(st.scheduler.active.values()):
             req._finish(FINISH_ABORTED)
             st.scheduler.evict(req)
+        # stop the spill thread before the final flushes so the host
+        # block of the flushed cache_stats is its terminal state
+        if self.host_cache is not None:
+            self.host_cache.close()
         # final loop-goodput + cache-observatory flush BEFORE
         # engine_stop, so the last engine_loop_stats / cache_stats
         # records and stats() agree exactly (no dispatches or
@@ -865,9 +940,51 @@ class InferenceEngine:
             st.pages = self._cow_copy(st.pages, np.int32(src_b),
                                       np.int32(new_b))
 
+    def _swap_in(self, st: _EngineState, req: Request) -> None:
+        """Replay the slot's host-tier hits: one fixed-shape
+        host→device scatter per pending block, before the first prefill
+        chunk touches the slot.  A missing host entry (only possible
+        across an engine restart, which clears pins) truncates the
+        cached prefix at the first gap — the tail recomputes through
+        the normal prefill path instead."""
+        pending = st.blocks.take_pending_swap_ins(req.slot)
+        if not pending:
+            return
+        t0 = time.perf_counter()
+        host = self.host_cache
+        loaded: List[Tuple[int, bytes]] = []
+        valid_blocks: Optional[int] = None
+        for i, (block_idx, block, digest) in enumerate(pending):
+            data = host.take_for_swap_in(digest)
+            if data is None:
+                valid_blocks = block_idx
+                host.unpin([dg for _, _, dg in pending[i + 1:]])
+                break
+            st.pages = self._host_load(st.pages, data, np.int32(block))
+            loaded.append((block, digest))
+        jax.block_until_ready(st.pages[0])
+        secs = time.perf_counter() - t0
+        if valid_blocks is not None:
+            cached = valid_blocks * self.config.block_size
+            lost = max(req.cached_prompt_tokens - cached, 0)
+            req.prefill_pos = min(req.prefill_pos, cached)
+            req.cached_prompt_tokens = cached
+            self.prefill_tokens_cached -= lost
+        st.blocks.complete_swap_ins(req.slot, loaded)
+        req.swap_in_secs += secs
+        req.host_hit_blocks = len(loaded)
+        host.note_swap_in(len(loaded), secs)
+        tracing.instant("host_swap_in", "serve", request=req.id,
+                        trace=req.trace_id, blocks=len(loaded),
+                        secs=round(secs, 6))
+
     def _run_prefill_chunk(self, st: _EngineState, req: Request,
                            d: DispatchRecord) -> None:
         d.kind = "prefill"
+        if self.host_cache is not None:
+            # consume pending host-tier swap-ins first (no-op after the
+            # slot's first chunk); accounted to the build_inputs bucket
+            self._swap_in(st, req)
         C = self.config.prefill_chunk
         # prefill over the full context — prompt plus anything generated
         # before a preemption/restart requeued this request (identical to
@@ -1216,6 +1333,8 @@ class InferenceEngine:
             "blocks_cached_reusable": bstats["blocks_cached_reusable"],
             "miss_cold_blocks": req.miss_cold_blocks,
             "miss_evicted_blocks": req.miss_evicted_blocks,
+            "host_hit_blocks": req.host_hit_blocks,
+            "swap_in_secs": round(req.swap_in_secs, 6),
         }
         stream = telemetry.get_stream()
         if stream is not None:
@@ -1264,6 +1383,13 @@ class InferenceEngine:
         # compile the copy-on-write page copy (garbage -> garbage is a
         # no-op) so a later COW event can't trip the recompile detector
         st.pages = self._cow_copy(st.pages, np.int32(0), np.int32(0))
+        if self.host_cache is not None:
+            # compile the host-tier pair the same way: gather the
+            # garbage page to host, scatter it straight back — both
+            # no-ops, after which spills and swap-ins are compile-free
+            garbage = jax.device_get(
+                self._fetch_block(st.pages, np.int32(0)))
+            st.pages = self._host_load(st.pages, garbage, np.int32(0))
         jax.block_until_ready(st.pages[0])
         self.warmed_up = True
         # compile-time gaps between warmup dispatches are expected —

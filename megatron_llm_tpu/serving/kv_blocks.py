@@ -47,6 +47,15 @@ Prefix caching (``prefix_cache=True``):
   evicting the least-recently-used reusable page (``prefix_cache_evictions``).
   Reserved-but-unwritten pages of a slot released mid-prefill go back to
   the free list immediately — they hold no reusable KV.
+
+Hierarchical tier (``host_cache``, serving/host_cache.py): with a host
+spill tier attached, registrations and parkings additionally enqueue an
+asynchronous device→host page copy, and the admission match extends its
+digest walk into the host tier — host-resident digests are pinned,
+fresh device blocks are reserved for them, and the engine consumes the
+slot's ``pending swap-ins`` (one fixed-shape host→device scatter per
+block) before prefilling the uncached tail, after which
+``complete_swap_ins`` registers the pages back into the HBM cache.
 """
 
 from __future__ import annotations
@@ -128,14 +137,17 @@ class BlockManager:
     _lock_protected_ = (
         "_free_blocks", "_free_slots", "_slot_blocks", "tables",
         "_refcounts", "_cache", "_block_hash", "_lru", "_slot_cached",
-        "_slot_miss_causes",
+        "_slot_miss_causes", "_slot_swap_ins", "_slot_host_hits",
+        "_block_epoch", "host_cache",
         "prefix_cache_hits", "prefix_cache_misses",
-        "prefix_cache_evictions", "prefix_cache_hit_tokens", "cow_copies",
+        "prefix_cache_evictions", "prefix_cache_hit_tokens",
+        "prefix_cache_host_hits", "cow_copies",
     )
 
     def __init__(self, num_blocks: int, block_size: int, num_slots: int,
                  max_blocks_per_slot: int, prefix_cache: bool = False,
-                 observatory: Optional[CacheObservatory] = None):
+                 observatory: Optional[CacheObservatory] = None,
+                 host_cache=None):
         assert num_blocks >= 2, "need at least one block beyond the garbage"
         assert block_size >= 1 and num_slots >= 1
         self.num_blocks = int(num_blocks)
@@ -160,6 +172,19 @@ class BlockManager:
         # slot -> (cold, evicted) missed prefix blocks from its alloc
         # match (the request_done miss-cause fields read these)
         self._slot_miss_causes: Dict[int, Tuple[int, int]] = {}
+        # host spill tier (serving/host_cache.py): slot -> pending
+        # swap-ins [(block_idx, block, digest), ...] the engine must
+        # replay host→device before the slot's uncached-tail prefill;
+        # slot -> host-tier hit blocks from its admission match
+        self._slot_swap_ins: Dict[int, List[Tuple[int, int, bytes]]] = {}
+        self._slot_host_hits: Dict[int, int] = {}
+        # per-block allocation epoch: bumped every time a physical
+        # block is handed to a new owner, so the spill thread's
+        # lock-free device read can detect digest→block ABA re-mapping
+        # (host_cache._process_spill validates (block, epoch) before
+        # and after the fetch via host_spill_check)
+        self._block_epoch: Dict[int, int] = {}
+        self.host_cache = host_cache
         # cache observatory (serving/cache_observatory.py): heat table,
         # eviction forensics, ghost capacity tiers.  Hook calls happen
         # inside this class's locked sections; the observatory has its
@@ -167,11 +192,20 @@ class BlockManager:
         # engine shares one across restarts' BlockManager instances.
         self.observatory = observatory if observatory is not None else \
             CacheObservatory(int(num_blocks) - 1, int(block_size))
-        self.prefix_cache_hits = 0                  # block-granular
+        self.prefix_cache_hits = 0                  # block-granular,
+        # two-tier: HBM adoptions + host-tier rescues both count
         self.prefix_cache_misses = 0
         self.prefix_cache_evictions = 0
         self.prefix_cache_hit_tokens = 0
+        self.prefix_cache_host_hits = 0             # host-tier subset
         self.cow_copies = 0
+
+    def attach_host_cache(self, host_cache) -> None:
+        """Wire the host spill tier after construction (the engine
+        builds the tier once it knows the per-block byte size, which
+        needs the first state's pages)."""
+        with self._lock:
+            self.host_cache = host_cache
 
     # -- capacity -------------------------------------------------------
 
@@ -187,11 +221,21 @@ class BlockManager:
 
     # -- alloc / free ---------------------------------------------------
 
+    def _bump_epoch_locked(self, b: int) -> int:
+        """The physical block is being handed to a new owner: any
+        in-flight spill that captured the previous (block, epoch) pair
+        must fail its re-validation."""
+        e = self._block_epoch.get(b, 0) + 1
+        self._block_epoch[b] = e
+        return e
+
     def _take_block_locked(self) -> int:
         """One fresh private block: free list first, else evict the
         least-recently-used refcount-zero cached block."""
         if self._free_blocks:
-            return self._free_blocks.pop()
+            b = self._free_blocks.pop()
+            self._bump_epoch_locked(b)
+            return b
         if self._lru:
             # forensics classifies this eviction from the pool balance
             # at the moment of eviction (free list is empty here, so
@@ -202,19 +246,38 @@ class BlockManager:
             digest = self._block_hash.pop(b)
             del self._cache[digest]
             self.prefix_cache_evictions += 1
+            self._bump_epoch_locked(b)
             self.observatory.record_evict(digest, in_use, lru_len)
             return b
         raise NoCapacity("pool exhausted (no free or evictable blocks)")
 
+    def host_spill_check(self, digest: bytes) -> Optional[Tuple[int, int]]:
+        """Spill-thread validation hook: the ``(block, epoch)`` the
+        digest currently maps to, or None when it is no longer
+        registered.  Called with no other locks held (lock order:
+        manager -> host; the spill thread holds neither here)."""
+        with self._lock:
+            b = self._cache.get(digest)
+            if b is None:
+                return None
+            return b, self._block_epoch.get(b, 0)
+
     def _match_prefix_locked(self, prompt_tokens: Sequence[int]):
         """Longest run of cached blocks covering the prompt, capped so at
         least one prompt token stays uncached (the engine needs a real
-        prefill step to produce the first-token logits).  Returns the
-        matched blocks plus the observatory's match token (heat + miss
-        causes + ghost-tier lookups over the same digests)."""
+        prefill step to produce the first-token logits).
+
+        With a host spill tier attached the digest walk continues past
+        the HBM match into the tier: host-resident digests are pinned
+        (the host LRU cannot drop them mid-admission) and returned for
+        alloc() to reserve fresh device blocks against — the engine
+        swaps them in before prefilling the remaining tail.  Returns
+        ``(matched_blocks, host_digests, token)`` where token is the
+        observatory's match record (heat + miss causes + ghost-tier
+        lookups over the same digests)."""
         cap = (len(prompt_tokens) - 1) // self.block_size
         if cap <= 0:
-            return [], None
+            return [], [], None
         digests = chain_block_digests(prompt_tokens, self.block_size, cap)
         matched: List[int] = []
         for d in digests:
@@ -222,10 +285,17 @@ class BlockManager:
             if b is None:
                 break
             matched.append(b)
-        self.prefix_cache_hits += len(matched)
-        self.prefix_cache_misses += len(digests) - len(matched)
-        token = self.observatory.record_match(digests, len(matched))
-        return matched, token
+        host_digests: List[bytes] = []
+        if self.host_cache is not None and len(matched) < len(digests):
+            host_digests = self.host_cache.match_and_pin(
+                digests[len(matched):])
+        self.prefix_cache_hits += len(matched) + len(host_digests)
+        self.prefix_cache_host_hits += len(host_digests)
+        self.prefix_cache_misses += (len(digests) - len(matched)
+                                     - len(host_digests))
+        token = self.observatory.record_match(
+            digests, len(matched), len(host_digests))
+        return matched, host_digests, token
 
     def alloc(self, total_tokens: int,
               prompt_tokens: Optional[Sequence[int]] = None) -> int:
@@ -245,9 +315,11 @@ class BlockManager:
                 f"> max_blocks_per_slot {self.max_blocks_per_slot}")
         with self._lock:
             matched: List[int] = []
+            host_digests: List[bytes] = []
             mtoken = None
             if self.prefix_cache_enabled and prompt_tokens is not None:
-                matched, mtoken = self._match_prefix_locked(prompt_tokens)
+                matched, host_digests, mtoken = \
+                    self._match_prefix_locked(prompt_tokens)
             n_fresh = n - len(matched)
             # matched blocks parked in the LRU are consumed by the match
             # itself — they are NOT available to _take_block_locked, so
@@ -256,6 +328,10 @@ class BlockManager:
             avail = (len(self._free_blocks) + len(self._lru)
                      - sum(1 for b in matched if b in self._lru))
             if not self._free_slots or n_fresh > avail:
+                if host_digests:
+                    # the pinned host entries will not be consumed —
+                    # release them before the retry path gives up
+                    self.host_cache.unpin(host_digests)
                 raise NoCapacity(
                     f"no capacity: {len(self._free_slots)} free slots, "
                     f"{avail} free/evictable blocks, need {n_fresh}")
@@ -272,13 +348,23 @@ class BlockManager:
             for b in blocks[len(matched):]:
                 self._refcounts[b] = 1
             self._slot_blocks[slot] = blocks
-            self._slot_cached[slot] = len(matched) * self.block_size
+            # host-tier hits ride the fresh allocation: the first
+            # len(host_digests) fresh blocks become swap-in targets the
+            # engine fills from host RAM instead of recomputing, so the
+            # slot's cached-token count covers both tiers
+            m, h = len(matched), len(host_digests)
+            if h:
+                self._slot_swap_ins[slot] = [
+                    (m + i, blocks[m + i], host_digests[i])
+                    for i in range(h)]
+            self._slot_host_hits[slot] = h
+            self._slot_cached[slot] = (m + h) * self.block_size
             self._slot_miss_causes[slot] = (
                 (mtoken.miss_cold, mtoken.miss_evicted)
                 if mtoken is not None else (0, 0))
             if self.prefix_cache_enabled:
                 self.observatory.record_admit(slot, mtoken, n, adopted_rcs)
-            self.prefix_cache_hit_tokens += len(matched) * self.block_size
+            self.prefix_cache_hit_tokens += (m + h) * self.block_size
             self.tables[slot, :] = GARBAGE_BLOCK
             self.tables[slot, :n] = blocks
             return slot
@@ -286,6 +372,47 @@ class BlockManager:
     def slot_cached_tokens(self, slot: int) -> int:
         with self._lock:
             return self._slot_cached.get(slot, 0)
+
+    def slot_host_hits(self, slot: int) -> int:
+        """Host-tier hit blocks from this slot's admission match (the
+        request_done ``host_hit_blocks`` field reads this)."""
+        with self._lock:
+            return self._slot_host_hits.get(slot, 0)
+
+    def take_pending_swap_ins(self, slot: int
+                              ) -> List[Tuple[int, int, bytes]]:
+        """Pop the slot's pending host→device swap-ins
+        ``[(block_idx, block, digest), ...]``.  The engine consumes
+        these exactly once, right before the slot's first prefill
+        chunk; each digest is pinned in the host tier until
+        ``take_for_swap_in`` (or ``free`` of an aborted slot) releases
+        it."""
+        with self._lock:
+            return self._slot_swap_ins.pop(slot, [])
+
+    def complete_swap_ins(self, slot: int,
+                          loaded: List[Tuple[int, bytes]]) -> None:
+        """The engine scattered ``loaded`` ``(block, digest)`` host
+        pages into the device pool: register them back into the HBM
+        cache so subsequent admissions share them by reference.  A
+        digest that was re-registered concurrently (another request
+        prefilled it between this slot's alloc and now) keeps its
+        canonical entry — this slot's copy stays private, exactly like
+        a duplicate commit."""
+        if not loaded:
+            return
+        with self._lock:
+            blocks = self._slot_blocks.get(slot)
+            owned = set(blocks) if blocks is not None else set()
+            registered: List[bytes] = []
+            for b, d in loaded:
+                if b not in owned or d in self._cache \
+                        or b in self._block_hash:
+                    continue
+                self._cache[d] = b
+                self._block_hash[b] = d
+                registered.append(d)
+            self.observatory.record_swap_in(registered, len(loaded))
 
     def slot_miss_causes(self, slot: int) -> Tuple[int, int]:
         """(cold, evicted) missed prefix blocks from this slot's
@@ -333,6 +460,11 @@ class BlockManager:
             self._cache[d] = b
             self._block_hash[b] = d
             actions.append("reg")
+            if self.host_cache is not None:
+                # freshly registered content is frozen from here on —
+                # widest possible copy window for the spill thread
+                self.host_cache.enqueue_spill(
+                    self, d, b, self._block_epoch.get(b, 0))
         self.observatory.record_commit(slot, digests, actions)
 
     def commit_prefix(self, slot: int, token_ids: Sequence[int],
@@ -417,6 +549,12 @@ class BlockManager:
                 if b in self._block_hash:
                     self._lru[b] = None
                     self._lru.move_to_end(b)
+                    if self.host_cache is not None:
+                        # parked refcount-zero pages are next in line
+                        # for eviction: last chance to spill them
+                        self.host_cache.enqueue_spill(
+                            self, self._block_hash[b], b,
+                            self._block_epoch.get(b, 0))
                 else:
                     self._free_blocks.append(b)
             if self.prefix_cache_enabled:
@@ -424,6 +562,12 @@ class BlockManager:
             self._free_slots.append(slot)
             self._slot_cached.pop(slot, None)
             self._slot_miss_causes.pop(slot, None)
+            pending = self._slot_swap_ins.pop(slot, None)
+            if pending and self.host_cache is not None:
+                # aborted before the engine consumed its swap-ins:
+                # release the admission-time pins
+                self.host_cache.unpin([d for _, _, d in pending])
+            self._slot_host_hits.pop(slot, None)
             self.tables[slot, :] = GARBAGE_BLOCK
 
     # -- observability --------------------------------------------------
@@ -445,6 +589,7 @@ class BlockManager:
                 "prefix_cache_misses": self.prefix_cache_misses,
                 "prefix_cache_evictions": self.prefix_cache_evictions,
                 "prefix_cache_hit_tokens": self.prefix_cache_hit_tokens,
+                "prefix_cache_host_hits": self.prefix_cache_host_hits,
                 "cow_copies": self.cow_copies,
             }
 
@@ -486,14 +631,29 @@ class BlockManager:
                 n = len(blocks)
                 assert list(self.tables[slot, :n]) == blocks
                 assert (self.tables[slot, n:] == GARBAGE_BLOCK).all()
+            for slot, pending in self._slot_swap_ins.items():
+                blocks = self._slot_blocks.get(slot)
+                assert blocks is not None, \
+                    f"pending swap-ins for dead slot {slot}"
+                for idx, b, _ in pending:
+                    assert idx < len(blocks) and blocks[idx] == b, \
+                        f"swap-in target {b} not at slot {slot}[{idx}]"
+            assert set(self._slot_host_hits) <= \
+                set(self._slot_blocks) | set(self._slot_swap_ins)
+            assert (self.prefix_cache_host_hits
+                    <= self.prefix_cache_hits), "host hits exceed total"
             real_cache = dict(self._cache)
             hits, misses = self.prefix_cache_hits, self.prefix_cache_misses
-        # observatory audit outside the pool lock (lock order is
-        # pool -> observatory; the check only reads a repeatable
-        # snapshot because check_invariants callers are quiescent)
+            host_hits = self.prefix_cache_host_hits
+        # observatory + host-tier audits outside the pool lock (lock
+        # order is pool -> observatory and pool -> host; the checks
+        # only read a repeatable snapshot because check_invariants
+        # callers are quiescent)
         self.observatory.check_invariants(
             real_cache=real_cache if self.prefix_cache_enabled else None,
-            real_hits=hits, real_misses=misses)
+            real_hits=hits, real_misses=misses, real_host_hits=host_hits)
+        if self.host_cache is not None:
+            self.host_cache.check_invariants()
 
 
 def derive_num_blocks(num_slots: int, block_size: int,

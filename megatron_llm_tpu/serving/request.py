@@ -113,6 +113,11 @@ class Request:
         # cache observatory aggregates)
         self.miss_cold_blocks = 0
         self.miss_evicted_blocks = 0
+        # hierarchical KV cache (serving/host_cache.py): prefix blocks
+        # rescued from the host spill tier, and the host→device
+        # swap-in time this request paid for them
+        self.host_hit_blocks = 0
+        self.swap_in_secs = 0.0
         self.t_submit = time.monotonic()
         self.deadline = (self.t_submit + deadline_secs
                          if deadline_secs else None)

@@ -63,6 +63,11 @@ class Scheduler:
         self.rejected_len = 0
         self.deadline_evictions = 0
         self.preemptions = 0
+        # host-tier reservation accounting: blocks reserved at admission
+        # for in-flight swap-ins (the engine fills them from host RAM
+        # before the slot's first prefill chunk, so between admission
+        # and that chunk they hold a reservation, not KV)
+        self.swap_in_blocks_reserved = 0
 
     # -- admission ------------------------------------------------------
 
@@ -134,6 +139,12 @@ class Scheduler:
             # request_done record carries these; cache_observatory.py)
             head.miss_cold_blocks, head.miss_evicted_blocks = \
                 self.blocks.slot_miss_causes(slot)
+            # host-tier hits ride the slot's fresh-block reservation;
+            # the engine's swap-in step fills them from host RAM (and
+            # overwrites host_hit_blocks with the count it actually
+            # loaded, normally the same number)
+            head.host_hit_blocks = self.blocks.slot_host_hits(slot)
+            self.swap_in_blocks_reserved += head.host_hit_blocks
             self.active[slot] = head
             self.admitted += 1
             admitted.append(head)
@@ -256,5 +267,6 @@ class Scheduler:
             "rejected_len_total": self.rejected_len,
             "deadline_evictions_total": self.deadline_evictions,
             "preemptions": self.preemptions,
+            "swap_in_blocks_reserved": self.swap_in_blocks_reserved,
         })
         return s

@@ -447,7 +447,13 @@ def _wants_prometheus(path: str, accept: str) -> bool:
 #    (per-request prefix miss causes; evicted = the evicted-then-
 #    wanted-again regret signal) — see serving/cache_observatory.py
 #    and tools/serve_report.py's cache-observatory section
-TELEMETRY_SCHEMA_VERSION = 11
+# 12: hierarchical KV cache (host-RAM spill tier under the HBM pool;
+#    serving/host_cache.py): request_done records gain host_hit_blocks
+#    (prefix blocks rescued from the host tier) and swap_in_secs (the
+#    host→device scatter time the request paid for them); cache_stats
+#    records gain host_hits / host_hit_tokens / swap_in_blocks and a
+#    "host" sub-block (spill/eviction/swap-in counters, budget usage)
+TELEMETRY_SCHEMA_VERSION = 12
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 

@@ -64,6 +64,8 @@ def main():
                    help="engine watchdog timeout; 0 disables")
     p.add_argument("--serve_num_blocks", type=int, default=0,
                    help="KV pool pages; 0 = full per-slot backing")
+    p.add_argument("--serve_host_cache_bytes", type=int, default=0,
+                   help="host-RAM spill tier budget; 0 disables")
     p.add_argument("--serve_max_queue_depth", type=int, default=32,
                    help="admission queue bound (fleet-autoscale tests "
                         "raise it so a spike backlogs instead of 429s)")
@@ -98,6 +100,7 @@ def main():
     engine = InferenceEngine(model, params, EngineConfig(
         num_slots=4, block_size=8, prefill_chunk=16, max_model_len=64,
         num_blocks=args.serve_num_blocks,
+        host_cache_bytes=args.serve_host_cache_bytes,
         max_queue_depth=args.serve_max_queue_depth,
         default_deadline_secs=args.serve_deadline_secs,
         paged_kernel=args.paged_kernel,

@@ -1,0 +1,525 @@
+"""Hierarchical KV cache: the host-RAM spill tier (serving/host_cache.py).
+
+Covers the PR-19 acceptance criteria: asynchronous spill off the HBM
+LRU with epoch-validated lost-race drops, two-tier admission matching
+with pinning, swap-in re-registration into the HBM cache, cross-tier
+``check_invariants()`` under 400-step random churn, engine-level
+host-hit rescue with greedy token parity and zero steady-state
+recompiles, and restart semantics (tier survives, queued spills drop).
+"""
+
+import random
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from megatron_llm_tpu import tracing
+from megatron_llm_tpu.models.llama import LlamaModel, llama_config
+from megatron_llm_tpu.serving import (
+    BlockManager,
+    EngineConfig,
+    HostKVCache,
+    InferenceEngine,
+    NoCapacity,
+    SamplingParams,
+    chain_block_digests,
+)
+
+BS = 4
+
+
+def _fake_fetch(manager, block):
+    """Host-side stand-in for the engine's device→host page gather:
+    returns a recognizable token so tests can assert which physical
+    page a host entry was copied from."""
+    return ("page", block)
+
+
+def _host(capacity_blocks=8, **kw):
+    # block_bytes=1 makes capacity_bytes the block capacity directly
+    return HostKVCache(capacity_blocks, 1, fetch=_fake_fetch, **kw)
+
+
+def _bm(num_blocks=13, num_slots=3, host_cache=None, **kw):
+    kw.setdefault("prefix_cache", True)
+    return BlockManager(num_blocks=num_blocks, block_size=BS,
+                        num_slots=num_slots, max_blocks_per_slot=8,
+                        host_cache=host_cache, **kw)
+
+
+def _consume_swap_ins(bm, host, slot):
+    """What the engine's _swap_in step does, minus the device scatter:
+    pop the slot's pending swap-ins, take each host entry, register the
+    pages back into the HBM cache."""
+    pending = bm.take_pending_swap_ins(slot)
+    loaded = []
+    for _idx, b, d in pending:
+        data = host.take_for_swap_in(d)
+        assert data is not None, "pinned entry vanished"
+        loaded.append((b, d))
+    bm.complete_swap_ins(slot, loaded)
+    if loaded:
+        host.note_swap_in(len(loaded), 0.0)
+    return loaded
+
+
+# ---------------------------------------------------------------------------
+# spill path (block manager + spill thread)
+# ---------------------------------------------------------------------------
+
+def test_spill_then_evict_then_host_hit_and_swap_in():
+    host = _host(capacity_blocks=64).start()
+    bm = _bm(host_cache=host)
+    try:
+        prompt = list(range(1, 10))          # 9 toks: cap = 2 full blocks
+        s0 = bm.alloc(12, prompt_tokens=prompt)
+        bm.commit_prefix(s0, prompt, n_written=9)
+        digests = chain_block_digests(prompt, BS, 2)
+        bm.free(s0, token_ids=prompt, n_written=9)
+        assert host.drain(), "spill queue did not drain"
+        assert all(host.contains(d) for d in digests)
+        st = host.stats()
+        assert st["spills_completed"] >= 2
+        bm.check_invariants()
+        # cycle the HBM LRU until the prompt's pages are gone
+        filler_id = 100
+        while any(bm.host_spill_check(d) for d in digests):
+            f = [filler_id + i for i in range(9)]
+            filler_id += 10
+            s = bm.alloc(12, prompt_tokens=f)
+            _consume_swap_ins(bm, host, s)
+            bm.free(s, token_ids=f, n_written=9)
+        assert host.drain()
+        bm.check_invariants()
+        # the host tier rescues what the HBM LRU evicted
+        s1 = bm.alloc(12, prompt_tokens=prompt)
+        assert bm.slot_cached_tokens(s1) == 8       # 2 host-tier blocks
+        assert bm.slot_host_hits(s1) == 2
+        loaded = _consume_swap_ins(bm, host, s1)
+        assert len(loaded) == 2
+        # swapped-in pages are registered: a second admission shares by
+        # reference (a plain HBM hit, no new swap-in)
+        s2 = bm.alloc(12, prompt_tokens=prompt)
+        assert bm.slot_cached_tokens(s2) == 8
+        assert bm.slot_host_hits(s2) == 0
+        assert bm.take_pending_swap_ins(s2) == []
+        assert bm.tables[s1][:2].tolist() == bm.tables[s2][:2].tolist()
+        st = bm.stats()
+        assert st["prefix_cache_host_hits"] == 2
+        assert host.stats()["swap_in_blocks"] == 2
+        bm.free(s1, token_ids=prompt, n_written=9)
+        bm.free(s2, token_ids=prompt, n_written=9)
+        bm.check_invariants()
+    finally:
+        host.close()
+
+
+def test_spill_lost_race_is_dropped_by_epoch_validation():
+    host = _host()                  # no thread: we drive spills by hand
+    bm = _bm(host_cache=host)
+    prompt = list(range(1, 10))
+    s0 = bm.alloc(12, prompt_tokens=prompt)
+    bm.commit_prefix(s0, prompt, n_written=9)
+    bm.free(s0, token_ids=prompt, n_written=9)
+    item = host._queue.get_nowait()     # (manager, digest, block, epoch)
+    host._queue.task_done()
+    _, digest, block, epoch = item
+    assert bm.host_spill_check(digest) == (block, epoch)
+    # evict the page before the spill runs: the digest unregisters and
+    # the block's epoch bumps when it is handed to a new owner
+    filler_id = 100
+    while bm.host_spill_check(digest) is not None:
+        f = [filler_id + i for i in range(9)]
+        filler_id += 10
+        s = bm.alloc(12, prompt_tokens=f)
+        bm.free(s, token_ids=f, n_written=9)
+    dropped_before = host.stats()["spills_dropped"]
+    host._process_spill(bm, digest, block, epoch)
+    assert not host.contains(digest)
+    assert host.stats()["spills_dropped"] == dropped_before + 1
+    host.check_invariants()
+
+
+def test_host_lru_eviction_spares_pinned_entries():
+    host = _host(capacity_blocks=2)
+    # install three entries by hand through the spill path machinery
+    bm = _bm(host_cache=host)
+    prompts = [[10 * k + i for i in range(5)] for k in range(1, 4)]
+    digests = []
+    for p in prompts:
+        s = bm.alloc(8, prompt_tokens=p)
+        bm.commit_prefix(s, p, n_written=5)
+        digests.append(chain_block_digests(p, BS, 1)[0])
+        bm.free(s, token_ids=p, n_written=5)
+    # drive the queued spills synchronously: capacity 2 evicts the LRU
+    while True:
+        try:
+            item = host._queue.get_nowait()
+        except Exception:
+            break
+        host._queue.task_done()
+        host._process_spill(*item)
+    assert host.stats()["entries"] == 2
+    assert not host.contains(digests[0])        # LRU head evicted
+    assert host.stats()["evictions"] == 1
+    # pin the survivor pair: a further spill must drop, not evict them
+    assert host.match_and_pin([digests[1]]) == [digests[1]]
+    assert host.match_and_pin([digests[2]]) == [digests[2]]
+    p = [77, 78, 79, 80, 81]
+    s = bm.alloc(8, prompt_tokens=p)
+    bm.commit_prefix(s, p, n_written=5)
+    bm.free(s, token_ids=p, n_written=5)
+    dropped_before = host.stats()["spills_dropped"]
+    while True:
+        try:
+            item = host._queue.get_nowait()
+        except Exception:
+            break
+        host._queue.task_done()
+        host._process_spill(*item)
+    assert host.stats()["spills_dropped"] > dropped_before
+    assert host.contains(digests[1]) and host.contains(digests[2])
+    host.unpin([digests[1], digests[2]])
+    host.check_invariants()
+    bm.check_invariants()
+
+
+def test_nocapacity_after_host_match_unpins():
+    host = _host(capacity_blocks=64).start()
+    bm = _bm(num_blocks=13, num_slots=1, host_cache=host)
+    try:
+        prompt = list(range(1, 10))
+        s0 = bm.alloc(12, prompt_tokens=prompt)
+        bm.commit_prefix(s0, prompt, n_written=9)
+        bm.free(s0, token_ids=prompt, n_written=9)
+        assert host.drain()
+        digests = chain_block_digests(prompt, BS, 2)
+        filler_id = 100
+        while any(bm.host_spill_check(d) for d in digests):
+            f = [filler_id + i for i in range(9)]
+            filler_id += 10
+            s = bm.alloc(12, prompt_tokens=f)
+            _consume_swap_ins(bm, host, s)
+            bm.free(s, token_ids=f, n_written=9)
+        assert host.drain()
+        # occupy the only slot: the next admission matches the host
+        # tier (pins 2 entries) and then fails on slot exhaustion — the
+        # pins must be released on the way out
+        blocker = bm.alloc(12, prompt_tokens=[50, 51, 52])
+        _consume_swap_ins(bm, host, blocker)
+        with pytest.raises(NoCapacity):
+            bm.alloc(12, prompt_tokens=prompt)
+        assert host.stats()["pinned"] == 0, \
+            "NoCapacity admission leaked host pins"
+        bm.free(blocker)
+        bm.check_invariants()
+    finally:
+        host.close()
+
+
+def test_free_with_unconsumed_swap_ins_unpins():
+    host = _host(capacity_blocks=64).start()
+    bm = _bm(host_cache=host)
+    try:
+        prompt = list(range(1, 10))
+        s0 = bm.alloc(12, prompt_tokens=prompt)
+        bm.commit_prefix(s0, prompt, n_written=9)
+        bm.free(s0, token_ids=prompt, n_written=9)
+        assert host.drain()
+        digests = chain_block_digests(prompt, BS, 2)
+        filler_id = 100
+        while any(bm.host_spill_check(d) for d in digests):
+            f = [filler_id + i for i in range(9)]
+            filler_id += 10
+            s = bm.alloc(12, prompt_tokens=f)
+            _consume_swap_ins(bm, host, s)
+            bm.free(s, token_ids=f, n_written=9)
+        assert host.drain()
+        s1 = bm.alloc(12, prompt_tokens=prompt)
+        assert bm.slot_host_hits(s1) == 2
+        assert host.stats()["pinned"] == 2
+        # aborted before the engine consumed the swap-ins
+        bm.free(s1)
+        assert host.stats()["pinned"] == 0
+        bm.check_invariants()
+    finally:
+        host.close()
+
+
+def test_on_pool_reset_clears_pins_and_queue():
+    host = _host()
+    bm = _bm(host_cache=host)
+    prompt = list(range(1, 10))
+    s0 = bm.alloc(12, prompt_tokens=prompt)
+    bm.commit_prefix(s0, prompt, n_written=9)   # spills queued, no thread
+    assert host._queue.qsize() > 0
+    queued_before = host.stats()["spills_queued"]
+    host.on_pool_reset()
+    st = host.stats()
+    assert st["pool_resets"] == 1
+    assert st["pinned"] == 0
+    assert host._queue.qsize() == 0
+    # dropped spills stay accounted: completed + dropped <= queued holds
+    assert st["spills_dropped"] > 0
+    assert st["spills_queued"] == queued_before
+    host.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# cross-tier invariants under churn (the PR-6 churn test, two-tier)
+# ---------------------------------------------------------------------------
+
+def test_two_tier_invariants_under_random_churn():
+    rng = random.Random(0)
+    host = _host(capacity_blocks=6).start()
+    bm = _bm(num_blocks=13, num_slots=3, host_cache=host)
+    try:
+        prompts = [[rng.randrange(1, 6)
+                    for _ in range(rng.randrange(3, 17))]
+                   for _ in range(6)]
+        live = {}
+        for step in range(400):
+            op = rng.random()
+            if op < 0.45 and len(live) < 3:
+                p = rng.choice(prompts)
+                total = len(p) + rng.randrange(1, 8)
+                try:
+                    s = bm.alloc(total, prompt_tokens=p)
+                except NoCapacity:
+                    continue
+                _consume_swap_ins(bm, host, s)
+                live[s] = (p, bm.slot_cached_tokens(s))
+            elif op < 0.65 and live:
+                s = rng.choice(list(live))
+                p, cached = live[s]
+                n_written = rng.randrange(cached, len(p) + 1)
+                bm.commit_prefix(s, p, n_written)
+            elif op < 0.8 and live:
+                s = rng.choice(list(live))
+                p, _ = live[s]
+                try:
+                    bm.ensure_writable(
+                        s, rng.randrange(0, bm.blocks_needed(len(p))))
+                except NoCapacity:
+                    # COW with every page live: the engine preempts the
+                    # slot here; the churn just skips the write
+                    pass
+            elif live:
+                s = rng.choice(list(live))
+                p, cached = live[s]
+                bm.free(s, token_ids=p,
+                        n_written=rng.randrange(0, len(p) + 1))
+                del live[s]
+            if step % 20 == 0:
+                assert host.drain()
+            bm.check_invariants()       # cross-tier: observatory + host
+        for s, (p, _) in list(live.items()):
+            bm.free(s, token_ids=p, n_written=len(p))
+        assert host.drain()
+        bm.check_invariants()
+        st = bm.stats()
+        assert st["blocks_in_use"] == 0
+        assert st["blocks_free"] + st["blocks_cached_reusable"] == 12
+        hs = host.stats()
+        assert hs["spills_completed"] > 0, "churn never exercised spill"
+        assert st["prefix_cache_host_hits"] > 0, \
+            "churn never exercised a host-tier rescue"
+    finally:
+        host.close()
+
+
+# ---------------------------------------------------------------------------
+# engine integration (tiny model, CPU)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = llama_config("tiny", num_layers=2, seq_length=64,
+                       max_position_embeddings=64, padded_vocab_size=64,
+                       use_flash_attn=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _engine(model_and_params, host_cache_bytes, num_blocks=13):
+    model, params = model_and_params
+    return InferenceEngine(model, params, EngineConfig(
+        num_slots=4, block_size=8, prefill_chunk=16, max_model_len=64,
+        num_blocks=num_blocks, max_queue_depth=32,
+        default_deadline_secs=0.0, host_cache_bytes=host_cache_bytes))
+
+
+GREEDY = dict(temperature=0.0, eod_id=63)
+PROMPT = [1, 2, 3, 4, 5, 6, 7, 8] * 4 + [9]     # 33 toks: 4 full blocks
+
+
+def _evict_prompt_from_hbm(eng, prompt):
+    """Run distinct filler prompts until none of the prompt's prefix
+    digests remain in the HBM cache (they survive in the host tier)."""
+    digests = chain_block_digests(
+        prompt, eng.config.block_size,
+        (len(prompt) - 1) // eng.config.block_size)
+    for i in range(40):
+        if not any(eng.blocks.host_spill_check(d) for d in digests):
+            return
+        filler = [10 + i] * 25 + [i % 7 + 1]
+        eng.submit(filler, SamplingParams(max_new_tokens=2, **GREEDY)
+                   ).result(timeout=120)
+        assert eng.host_cache.drain()
+    raise AssertionError("fillers never evicted the prompt from HBM")
+
+
+def test_engine_host_hit_after_hbm_eviction_token_parity(model_and_params):
+    eng = _engine(model_and_params, host_cache_bytes=64 << 20)
+    eng.warmup()
+    tracer = tracing.SpanTracer()
+    det = tracing.RecompileDetector(tracer)
+    tracing.install_tracing(tracing.Tracing(tracer=tracer, recompile=det))
+    eng.start()
+    try:
+        det.mark_steady()
+        sp = SamplingParams(max_new_tokens=4, **GREEDY)
+        r1 = eng.submit(PROMPT, sp)
+        r1.result(timeout=120)
+        assert eng.host_cache.drain(), "spills did not drain"
+        assert eng.host_cache.stats()["spills_completed"] >= 4
+        _evict_prompt_from_hbm(eng, PROMPT)
+        # the re-submission misses HBM, hits the host tier, swaps in
+        r2 = eng.submit(PROMPT, sp)
+        r2.result(timeout=120)
+        assert r2.host_hit_blocks == 4, \
+            f"expected 4 host-tier blocks, got {r2.host_hit_blocks}"
+        assert r2.cached_prompt_tokens == 32
+        assert r2.swap_in_secs > 0
+        # greedy parity: swapped-in KV is a byte copy of the pages the
+        # first run computed, so the continuation is token-identical
+        assert r2.out_tokens == r1.out_tokens
+        assert det.recompiles == 0, \
+            f"{det.recompiles} recompiles: {list(det.events)}"
+        st = eng.stats()
+        assert st["cache"]["host_hits"] >= 4
+        assert st["cache"]["host"]["swap_in_blocks"] >= 4
+        assert st["cache"]["swap_in_blocks"] >= 4
+        assert st["swap_in_blocks_reserved"] >= 4
+        assert st["prefix_cache_host_hits"] >= 4
+        eng.blocks.check_invariants()
+    finally:
+        tracing.install_tracing(None)
+        eng.stop()
+
+
+def test_engine_restart_carries_host_counters(model_and_params):
+    eng = _engine(model_and_params, host_cache_bytes=64 << 20)
+    eng.warmup()
+    eng.start()
+    try:
+        sp = SamplingParams(max_new_tokens=3, **GREEDY)
+        eng.submit(PROMPT, sp).result(timeout=120)
+        assert eng.host_cache.drain()
+        entries_before = eng.host_cache.stats()["entries"]
+        assert entries_before > 0
+        hits_before = eng.blocks.stats()["prefix_cache_host_hits"]
+        eng.restart("test")
+        # the tier and its residency survive the pool swap
+        assert eng.host_cache.stats()["entries"] == entries_before
+        assert eng.host_cache.stats()["pool_resets"] == 1
+        assert eng.blocks.stats()["prefix_cache_host_hits"] == hits_before
+        # the fresh (empty) HBM pool rescues the prompt from host RAM
+        r = eng.submit(PROMPT, sp)
+        r.result(timeout=120)
+        assert r.host_hit_blocks == 4
+        eng.blocks.check_invariants()
+    finally:
+        eng.stop()
+
+
+def test_engine_without_host_cache_unchanged(model_and_params):
+    eng = _engine(model_and_params, host_cache_bytes=0)
+    assert eng.host_cache is None
+    eng.warmup()
+    eng.start()
+    try:
+        r = eng.submit(PROMPT, SamplingParams(max_new_tokens=3, **GREEDY))
+        r.result(timeout=120)
+        assert r.host_hit_blocks == 0 and r.swap_in_secs == 0.0
+        st = eng.stats()
+        assert st["cache"]["host"] == {"enabled": 0}
+        assert st["cache"]["host_hits"] == 0
+        eng.blocks.check_invariants()
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# bookkeeping overhead gate (PR 17/18 convention)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_host_cache_overhead_under_2pct():
+    """Per-request host-tier bookkeeping (two-tier match with pinning,
+    swap-in consume/complete, spill enqueue, free-time unpin) must cost
+    < 2% of a real CPU dispatch of the tiny engine.  The device copies
+    themselves are off the hot path (spill thread) or replace prefill
+    compute (swap-in), so the gate prices the pure accounting."""
+    from megatron_llm_tpu import telemetry
+
+    cfg = llama_config("tiny", num_layers=2, seq_length=64,
+                       max_position_embeddings=64, padded_vocab_size=64,
+                       use_flash_attn=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = InferenceEngine(model, params, EngineConfig(
+        num_slots=4, block_size=8, prefill_chunk=16, max_model_len=64,
+        max_queue_depth=32, default_deadline_secs=0.0))
+    eng.warmup()
+    eng.start()
+    try:
+        reqs = [eng.submit([1 + i, 2, 3, 4],
+                           SamplingParams(max_new_tokens=12,
+                                          temperature=0.0, eod_id=63))
+                for i in range(8)]
+        for r in reqs:
+            r.result(timeout=180)
+        loop = eng.stats()["loop"]
+    finally:
+        eng.stop()
+    assert loop["dispatches"] > 0
+    mean_dispatch_secs = loop["wall_secs"] / loop["dispatches"]
+
+    # arm B: one full two-tier request lifecycle per iteration over a
+    # warm host tier (match+pin -> alloc -> consume swap-ins -> free),
+    # with a live (null-file) telemetry stream — the worst-case path
+    stream = telemetry.TelemetryStream(None)
+    telemetry.install_stream(stream)
+    try:
+        host = _host(capacity_blocks=32)        # no thread: pure host cost
+        bm = _bm(num_blocks=13, num_slots=3, host_cache=host)
+        prompt = list(range(1, 10))
+        s = bm.alloc(12, prompt_tokens=prompt)
+        bm.commit_prefix(s, prompt, n_written=9)
+        bm.free(s, token_ids=prompt, n_written=9)
+        while True:             # drive queued spills synchronously
+            try:
+                item = host._queue.get_nowait()
+            except Exception:
+                break
+            host._queue.task_done()
+            host._process_spill(*item)
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            s = bm.alloc(12, prompt_tokens=prompt)
+            _consume_swap_ins(bm, host, s)
+            bm.free(s, token_ids=prompt, n_written=9)
+        cost_per_alloc = (time.perf_counter() - t0) / n
+    finally:
+        telemetry.install_stream(None)
+        stream.close()
+    frac = cost_per_alloc / mean_dispatch_secs
+    assert frac < 0.02, (
+        f"host-tier bookkeeping {cost_per_alloc * 1e6:.1f}us/request = "
+        f"{frac * 100:.2f}% of a {mean_dispatch_secs * 1e3:.2f}ms dispatch")

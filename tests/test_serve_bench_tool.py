@@ -78,6 +78,20 @@ def _start_stub(paged_kernel="xla", prefill_kernel="xla"):
                         "gap_secs": 0.0025 * n,
                         "device_secs": 0.008 * n,
                     },
+                    # observatory + host spill tier: 2 host-rescued
+                    # blocks and 3 device->host spills per request
+                    "cache": {
+                        "miss_cold": n,
+                        "miss_evicted": 0,
+                        "evictions_capacity": 0,
+                        "evictions_churn": 0,
+                        "host_hits": 2 * n,
+                        "swap_in_blocks": 2 * n,
+                        "host": {
+                            "spills_completed": 3 * n,
+                            "swap_in_secs": 0.004 * n,
+                        },
+                    },
                 }
                 self._json(200, body)
             else:
@@ -227,6 +241,19 @@ def test_bench_reports_speculative_deltas(stub_server):
     assert r["accept_rate"] == pytest.approx(8 / 12, abs=1e-4)
     assert r["accepted_tokens_per_sec"] == pytest.approx(
         8 / r["wall_secs"], rel=0.01)
+
+
+def test_bench_reports_host_tier_deltas(stub_server):
+    """The hierarchical-cache keys delta the observatory's two-tier
+    attribution counters (cache.host_hits / cache.swap_in_blocks) and
+    the spill tier's own sub-block (cache.host.spills_completed /
+    swap_in_secs)."""
+    r = serve_bench.run_bench(stub_server, clients=2, requests=4, tokens=3)
+    assert r["cache_host_hits"] == 8
+    assert r["cache_swap_in_blocks"] == 8
+    assert r["cache_host_spills"] == 12
+    assert r["cache_swap_in_secs"] == pytest.approx(0.016, abs=1e-6)
+    assert r["cache_miss_cold"] == 4
 
 
 def test_bench_reports_loop_goodput_delta(stub_server):
@@ -530,6 +557,54 @@ def test_ab_prefill_end_to_end_two_replicas(capsys):
             # the arm's prompt tokens all ran through chunked prefill
             assert r["prefill_tokens_per_sec"] > 0
             assert r["ttft_mean_secs"] is None or r["ttft_mean_secs"] >= 0
+    finally:
+        for p in (p_on, p_off):
+            p.kill()
+            p.wait()
+
+
+@pytest.mark.slow
+def test_ab_host_cache_end_to_end_two_replicas(capsys):
+    """Acceptance: --ab serve_host_cache_bytes runs end-to-end on CPU —
+    two real engine subprocesses with a 13-block HBM pool (96 cacheable
+    tokens) under a Zipf prefix workload whose pool (12 prefixes x 2
+    blocks) is twice the HBM budget.  The ON arm rescues evicted
+    prefixes from host RAM (host-tier hits, device->host spills); the
+    OFF arm recomputes them."""
+    p_on, port_on = _spawn_replica(
+        "off", extra_args=("--serve_num_blocks", "13",
+                           "--serve_host_cache_bytes", str(64 << 20)))
+    p_off, port_off = _spawn_replica(
+        "off", extra_args=("--serve_num_blocks", "13"))
+    try:
+        rc = serve_bench.main([
+            "--url", f"http://127.0.0.1:{port_on}",
+            "--ab", "serve_host_cache_bytes",
+            "--ab_url", f"http://127.0.0.1:{port_off}",
+            "--clients", "2", "--requests", "32", "--tokens", "4",
+            "--prefix_tokens", "16", "--prefix_zipf", "1.0",
+            "--prefix_pool", "12", "--shared_prefix_frac", "1.0",
+            "--temperature", "0",
+            "--timeout", "180", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        rows = out["rows"]
+        assert [r["ab_arm"] for r in rows] == ["on", "off"]
+        on, off = rows
+        for r in rows:
+            assert r["errors"] == 0 and r["tokens_per_sec"] > 0
+        # the ON arm spilled evicted pages to host RAM and rescued
+        # some of them on re-admission
+        assert on["cache_host_spills"] > 0
+        assert on["cache_host_hits"] > 0
+        assert on["cache_swap_in_blocks"] > 0
+        assert on["cache_swap_in_secs"] >= 0
+        # the OFF arm has no host tier: its counters never move
+        assert off["cache_host_hits"] == 0
+        assert off["cache_host_spills"] is None
+        # host-tier rescues count as prefix-cache hits: the two-tier
+        # arm serves at least as many cached prefix blocks
+        assert on["prefix_cache_hits"] >= off["prefix_cache_hits"]
     finally:
         for p in (p_on, p_off):
             p.kill()

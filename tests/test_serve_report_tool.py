@@ -178,7 +178,15 @@ def test_cli_table_json_and_empty_exit_codes(serve_log, tmp_path):
 # ---------------------------------------------------------------------------
 
 def _cache_stats(probes=100, hits=60, miss_cold=30, miss_evicted=10,
-                 hit_tokens=240, heat=None, x2_hits=80, x2_tokens=320):
+                 hit_tokens=240, heat=None, x2_hits=80, x2_tokens=320,
+                 host_hits=0, host_hit_tokens=0, host=None):
+    if host is not None:
+        return {**_cache_stats(probes, hits, miss_cold, miss_evicted,
+                               hit_tokens, heat, x2_hits, x2_tokens),
+                "schema": 12, "host_hits": host_hits,
+                "host_hit_tokens": host_hit_tokens,
+                "swap_in_blocks": host.get("swap_in_blocks", 0),
+                "host": host}
     return {
         "schema": 11, "kind": "serve", "event": "cache_stats",
         "time_unix": 1700000050.0,
@@ -239,6 +247,70 @@ def test_analyze_cache_observatory_section(tmp_path):
         80 / tps / 4)
     # tiers come out ordered by capacity
     assert list(cache["ghost"]) == ["x2", "x10"]
+
+
+def test_analyze_host_tier_section(tmp_path):
+    """Schema-12 hierarchical-cache rollups: host-tier hit attribution
+    out of the two-tier rate, spill/swap-in volume from the ``host``
+    sub-block, and the TTFT-saved projection priced NET of the
+    measured swap-in seconds."""
+    recs = [_record(i) for i in range(4)]
+    # two requests swapped 2 blocks each out of host RAM
+    for r in recs[:2]:
+        r["host_hit_blocks"] = 2
+        r["swap_in_secs"] = 0.003
+    log = _write_log(str(tmp_path / "r"), recs)
+    with open(os.path.join(log, "telemetry.jsonl"), "a") as f:
+        f.write(json.dumps(_cache_stats(
+            host_hits=10, host_hit_tokens=80,
+            host={"enabled": 1, "capacity_blocks": 256, "entries": 40,
+                  "spills_queued": 30, "spills_completed": 25,
+                  "spills_dropped": 5, "evictions": 2, "swap_ins": 4,
+                  "swap_in_blocks": 10, "swap_in_secs": 0.02,
+                  "pool_resets": 0})) + "\n")
+    r = serve_report.analyze([log])
+    # request-level aggregation rides the prefill summary
+    assert r["prefill"]["host_hit_blocks"] == 4
+    assert r["prefill"]["swap_in_secs"] == pytest.approx(0.006)
+    assert r["prefill"]["requests_swapping"] == 2
+    host = r["cache"]["host_tier"]
+    assert host["hits"] == 10 and host["hit_tokens"] == 80
+    assert host["hit_rate"] == pytest.approx(0.10)      # 10/100 probes
+    assert host["hbm_hit_rate"] == pytest.approx(0.50)  # (60-10)/100
+    assert host["spills_completed"] == 25
+    assert host["spills_dropped"] == 5
+    assert host["swap_ins"] == 4
+    assert host["swap_in_secs"] == pytest.approx(0.02)
+    # pricing: 80 host-hit tokens at measured prefill throughput,
+    # minus the 0.02s the swap-in scatters actually cost
+    tps = r["prefill"]["tokens_per_sec"]
+    assert host["prefill_saved_secs_total"] == pytest.approx(80 / tps)
+    assert host["net_saved_secs_total"] == pytest.approx(80 / tps - 0.02)
+    assert host["ttft_saved_secs_per_request"] == pytest.approx(
+        (80 / tps - 0.02) / 4)
+    # a schema-11 log (no host sub-block) reports no host tier
+    old = _write_log(str(tmp_path / "old"), [_record(0)])
+    with open(os.path.join(old, "telemetry.jsonl"), "a") as f:
+        f.write(json.dumps(_cache_stats()) + "\n")
+    assert serve_report.analyze([old])["cache"]["host_tier"] is None
+
+
+def test_cli_renders_host_tier(tmp_path):
+    log = _write_log(str(tmp_path / "r"), [_record(i) for i in range(4)])
+    with open(os.path.join(log, "telemetry.jsonl"), "a") as f:
+        f.write(json.dumps(_cache_stats(
+            host_hits=10, host_hit_tokens=80,
+            host={"enabled": 1, "spills_completed": 25,
+                  "spills_dropped": 5, "evictions": 2, "swap_ins": 4,
+                  "swap_in_blocks": 10, "swap_in_secs": 0.02})) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "serve_report.py"), log],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
+    assert "host spill tier" in out.stdout
+    assert "two-tier hit rate" in out.stdout
+    assert "ghost projection" in out.stdout
+    assert "net of measured swap-in time" in out.stdout
 
 
 def test_analyze_cache_merges_replicas_and_heat(tmp_path):

@@ -37,6 +37,8 @@ def _replica_snap(requests=10, tokens=500, bubble=None):
                 "probes": 8, "hits": 6,
                 "evictions_capacity": 1, "evictions_churn": 3,
                 "ghost": {"x10": {"hit_rate": 0.9}},
+                "host_hits": 2,
+                "host": {"enabled": 1, "spills_completed": 4},
             },
         },
     }
@@ -95,6 +97,11 @@ def test_build_snapshot_router_view():
     assert r0["cache_evictions"] == 4
     assert r0["ghost_x10_hit_rate"] == pytest.approx(0.9)
     assert r0["cache_hit_rate_window"] is None
+    # host spill tier counters ride into the row; windowed rates need
+    # a previous frame too
+    assert r0["cache_host_hits"] == 2 and r0["host_spills"] == 4
+    assert r0["host_hit_rate_window"] is None
+    assert r0["host_spills_per_sec"] is None
     assert r0["host_bubble_pct"] == 35.5
     assert r0["loop_stalls"] == 2
     assert r0["engine_restarts"] == 1
@@ -127,6 +134,8 @@ def test_add_rates_from_frame_deltas():
     cache0["probes"] += 10                  # this frame: 5/10 hit
     cache0["hits"] += 5
     cache0["evictions_churn"] += 6          # 6 evictions / 2s
+    cache0["host_hits"] += 3                # this frame: 3/10 host-tier
+    cache0["host"]["spills_completed"] += 8  # 8 spills / 2s
     cur = serve_top.build_snapshot("http://x", doc)
     cur["time_unix"] = 102.0
     serve_top.add_rates(cur, prev)
@@ -140,6 +149,11 @@ def test_add_rates_from_frame_deltas():
     assert rows["backend_0"]["evictions_per_sec"] == pytest.approx(3.0)
     assert rows["backend_2"]["cache_hit_rate_window"] is None  # no delta
     assert rows["backend_1"]["evictions_per_sec"] is None
+    # host tier: windowed hit share of this frame's probes, spills/sec
+    assert rows["backend_0"]["host_hit_rate_window"] == pytest.approx(0.3)
+    assert rows["backend_0"]["host_spills_per_sec"] == pytest.approx(4.0)
+    assert rows["backend_2"]["host_hit_rate_window"] is None
+    assert rows["backend_1"]["host_spills_per_sec"] is None
     # first frame: no previous, rates stay None
     fresh = serve_top.build_snapshot("http://x", _fleet_doc())
     serve_top.add_rates(fresh, {})
@@ -204,7 +218,8 @@ def test_cli_once_table_renders(stub_fleet, capsys):
     assert "routers 2/2" in out
     assert "BROWNOUT" in out
     for col in ("replica", "occ", "tok/s", "ttft_p95", "hit%", "whit%",
-                "g10%", "ev/s", "bubble%", "stalls", "restarts"):
+                "g10%", "hhit%", "ev/s", "sp/s", "bubble%", "stalls",
+                "restarts"):
         assert col in out
     assert "DOWN" in out and "DRAIN" in out
 

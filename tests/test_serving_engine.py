@@ -338,7 +338,7 @@ def test_request_done_schema_golden(engine, tmp_path):
     the schema history comment in telemetry.py)."""
     from megatron_llm_tpu import telemetry
 
-    assert telemetry.TELEMETRY_SCHEMA_VERSION == 11
+    assert telemetry.TELEMETRY_SCHEMA_VERSION == 12
     captured = []
     engine.request_done_hook = captured.append
     stream = telemetry.TelemetryStream(str(tmp_path))
@@ -364,7 +364,7 @@ def test_request_done_schema_golden(engine, tmp_path):
         "tpot_secs", "phases", "paged_kernel", "prefill_kernel",
         "queue_depth", "blocks_free", "blocks_in_use",
         "blocks_cached_reusable", "miss_cold_blocks",
-        "miss_evicted_blocks"))
+        "miss_evicted_blocks", "host_hit_blocks", "swap_in_secs"))
     assert frozenset(rec["phases"]) == frozenset((
         "queue_secs", "admission_secs", "prefill_secs", "decode_secs",
         "stream_write_secs"))

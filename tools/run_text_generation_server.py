@@ -87,6 +87,7 @@ def main():
             default_deadline_secs=args.serve_deadline_secs,
             int8_kv_cache=args.int8_kv_cache,
             prefix_cache=bool(args.serve_prefix_cache),
+            host_cache_bytes=args.serve_host_cache_bytes,
             paged_kernel=args.serve_paged_kernel,
             prefill_kernel=args.serve_prefill_kernel,
             speculative=bool(args.serve_speculative),

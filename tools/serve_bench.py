@@ -101,6 +101,12 @@ JSON_SCHEMA_KEYS = (
     "cache_miss_cold", "cache_miss_evicted",
     "cache_evictions_capacity", "cache_evictions_churn",
     "ghost_hit_rates",
+    # hierarchical KV cache (host-RAM spill tier deltas over the run):
+    # blocks rescued from host RAM, pages spilled device->host, and the
+    # swap-in volume/time — the numbers a --serve_host_cache_bytes A/B
+    # moves when the prefix pool exceeds the HBM budget
+    "cache_host_hits", "cache_host_spills", "cache_swap_in_blocks",
+    "cache_swap_in_secs",
 )
 
 
@@ -472,6 +478,11 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         "cache_evictions_capacity": None,
         "cache_evictions_churn": None,
         "ghost_hit_rates": None,
+        # hierarchical KV cache (host-RAM spill tier counter deltas)
+        "cache_host_hits": None,
+        "cache_host_spills": None,
+        "cache_swap_in_blocks": None,
+        "cache_swap_in_secs": None,
     }
     if schedule:
         segs = []
@@ -581,6 +592,27 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
                                 rates[tier] = round(dh / dp, 4)
                         if rates:
                             out["ghost_hit_rates"] = rates
+                    # host-RAM spill tier: two-tier hit attribution
+                    # lives on the observatory (host_hits,
+                    # swap_in_blocks); spill/swap-in volume on the
+                    # tier's own sub-block (cache.host.*)
+                    out["cache_host_hits"] = cache_delta("host_hits")
+                    out["cache_swap_in_blocks"] = cache_delta(
+                        "swap_in_blocks")
+                    h0 = c0.get("host")
+                    h1 = c1.get("host")
+                    if isinstance(h0, dict) and isinstance(h1, dict):
+                        def host_delta(key):
+                            a, b = h0.get(key), h1.get(key)
+                            if isinstance(a, (int, float)) \
+                                    and isinstance(b, (int, float)):
+                                return b - a
+                            return None
+                        out["cache_host_spills"] = host_delta(
+                            "spills_completed")
+                        sw = host_delta("swap_in_secs")
+                        if sw is not None:
+                            out["cache_swap_in_secs"] = round(sw, 6)
                 l0 = e0.get("loop")
                 l1 = e1.get("loop")
                 if isinstance(l0, dict) and isinstance(l1, dict):
@@ -696,6 +728,13 @@ def print_table(r: dict) -> None:
         rows += [("ghost tier hit rates",
                   " ".join(f"{t}={v:.3f}"
                            for t, v in sorted(r["ghost_hit_rates"].items())))]
+    if r.get("cache_host_hits") is not None:
+        rows += [("host tier hit/spill/swap-in",
+                  f"{_fmt(r['cache_host_hits'])}/"
+                  f"{_fmt(r['cache_host_spills'])}/"
+                  f"{_fmt(r['cache_swap_in_blocks'])}"
+                  + (f" ({_fmt(r['cache_swap_in_secs'], 's')} swap)"
+                     if r.get("cache_swap_in_secs") is not None else ""))]
     w = max(len(k) for k, _ in rows)
     print(f"serve_bench: {r['clients']} clients -> {r['url']}"
           + (" (stream)" if r["stream"] else ""))
@@ -823,6 +862,15 @@ def main(argv=None):
                       f"(host bubble "
                       f"{_fmt(on.get('host_bubble_pct'), '%')} / "
                       f"{_fmt(off.get('host_bubble_pct'), '%')})")
+            if on.get("cache_host_hits") or off.get("cache_host_hits"):
+                # the hierarchical-cache A/B readout: blocks rescued
+                # from host RAM, and did mean TTFT follow?
+                print(f"A/B host-tier hit blocks on/off: "
+                      f"{_fmt(on.get('cache_host_hits'))} / "
+                      f"{_fmt(off.get('cache_host_hits'))} "
+                      f"(ttft mean "
+                      f"{_fmt(on.get('ttft_mean_secs'), 's')} / "
+                      f"{_fmt(off.get('ttft_mean_secs'), 's')})")
         return 0 if all(r["errors"] == 0 for r in rows) else 1
     r = run_bench(base_url, **kw)
     if args.as_json:

@@ -39,6 +39,11 @@ trace_id, per-request phase attribution, tpot_secs) and prints:
   (2x/4x/10x the block pool) the exact hit rate a bigger cache would
   have had on this trace plus the projected TTFT savings at the log's
   measured prefill throughput; absent on logs before schema 11
+* host spill tier — hierarchical KV cache rollups (telemetry schema
+  >= 12, serving/host_cache.py): host-tier hit share of the two-tier
+  rate, spill/eviction/swap-in volume, the two-tier hit rate compared
+  against the ghost projections it realizes, and the TTFT saved per
+  request net of the measured host->device swap-in time
 * per-replica comparison — pass several JSONL files/dirs (one per
   replica) and each gets its own column plus the fleet total
 * fleet-event timeline — supervisor events (``kind: "fleet"``, schema
@@ -224,11 +229,19 @@ def prefill_summary(records: List[Dict]) -> Dict:
         k = r.get("prefill_kernel")
         if k:
             kernels[k] = kernels.get(k, 0) + 1
+    # hierarchical KV cache (schema >= 12): blocks served out of host
+    # RAM instead of recomputed, and what the swap-in scatters cost
+    host_blocks = sum(r.get("host_hit_blocks") or 0 for r in records)
+    swap_secs = sum(r.get("swap_in_secs") or 0 for r in records)
+    swapping = sum(1 for r in records if (r.get("host_hit_blocks") or 0))
     return {
         "computed_tokens": toks,
         "compute_secs": secs,
         "tokens_per_sec": (toks / secs) if secs > 0 else None,
         "kernel": kernels,
+        "host_hit_blocks": host_blocks,
+        "swap_in_secs": swap_secs,
+        "requests_swapping": swapping,
     }
 
 
@@ -317,7 +330,13 @@ def loop_goodput_summary(per_path: List[List[Dict]]) -> Dict:
 CACHE_COUNTER_KEYS = ("match_calls", "probes", "hits", "misses",
                       "hit_tokens", "miss_cold", "miss_evicted",
                       "evictions_capacity", "evictions_churn",
-                      "pool_resets", "inclusion_divergences")
+                      "pool_resets", "inclusion_divergences",
+                      "host_hits", "host_hit_tokens", "swap_in_blocks")
+
+# host spill tier counters summed from each log's final cache_stats
+# record's ``host`` sub-block (telemetry schema >= 12)
+_HOST_TIER_KEYS = ("spills_completed", "spills_dropped", "evictions",
+                   "swap_ins", "swap_in_secs")
 
 # heat-table counters summed on fleet merge; mirrors
 # serving/cache_observatory.py merge_heat_tops (stdlib re-implementation)
@@ -358,6 +377,8 @@ def cache_observatory_summary(per_path: List[List[Dict]],
     hit tokens at the log's measured prefill throughput: the prefill
     seconds (≈ TTFT) a 2x/4x/10x pool would have saved on this trace."""
     totals = {key: 0 for key in CACHE_COUNTER_KEYS}
+    host_totals = {key: 0 for key in _HOST_TIER_KEYS}
+    host_enabled = False
     ghost: Dict[str, Dict] = {}
     heat_tables = []
     for recs in per_path:
@@ -368,6 +389,13 @@ def cache_observatory_summary(per_path: List[List[Dict]],
             v = final.get(key)
             if isinstance(v, (int, float)):
                 totals[key] += v
+        h = final.get("host")
+        if isinstance(h, dict) and h.get("enabled"):
+            host_enabled = True
+            for key in _HOST_TIER_KEYS:
+                v = h.get(key)
+                if isinstance(v, (int, float)):
+                    host_totals[key] += v
         heat_tables.append(final.get("heat_top") or [])
         for tier, t in (final.get("ghost") or {}).items():
             if not isinstance(t, dict):
@@ -403,6 +431,30 @@ def cache_observatory_summary(per_path: List[List[Dict]],
         }
     out["ghost"] = dict(sorted(
         tiers.items(), key=lambda kv: kv[1]["capacity_blocks"]))
+    # host spill tier: the realized two-tier rate the ghost tiers only
+    # project, with the hit tokens priced at prefill throughput NET of
+    # the measured host->device swap-in time (a ghost hit is free; a
+    # host hit costs one scatter)
+    out["host_tier"] = None
+    if host_enabled:
+        host_hits = totals["host_hits"]
+        saved = (totals["host_hit_tokens"] / prefill_tps
+                 if prefill_tps else None)
+        net = (saved - host_totals["swap_in_secs"]
+               if saved is not None else None)
+        out["host_tier"] = {
+            **host_totals,
+            "hits": host_hits,
+            "hit_tokens": totals["host_hit_tokens"],
+            "hit_rate": (host_hits / probes) if probes else None,
+            "hbm_hit_rate": ((totals["hits"] - host_hits) / probes)
+            if probes else None,
+            "prefill_saved_secs_total": saved,
+            "net_saved_secs_total": net,
+            "ttft_saved_secs_per_request": (
+                net / requests if net is not None and requests
+                else None),
+        }
     return out
 
 
@@ -559,6 +611,12 @@ def render(report: Dict) -> str:
                      f"in {_fmt(pf['compute_secs'])} -> "
                      + (f"{tps:.1f} tok/s" if tps else "-")
                      + f" (kernel: {kern})")
+        if pf.get("host_hit_blocks"):
+            lines.append(
+                f"  host swap-ins: {pf['host_hit_blocks']} block(s) "
+                f"across {pf['requests_swapping']} request(s) in "
+                f"{_fmt(pf['swap_in_secs'])} (prefill skipped, "
+                f"scatter paid)")
 
     sp = report.get("speculative") or {}
     if sp.get("drafted_tokens"):
@@ -672,6 +730,37 @@ def render(report: Dict) -> str:
                     + f" {g.get('extra_hit_tokens', 0):>10} "
                     + (f"{saved:>14.4f}s" if saved is not None
                        else f"{'-':>15}"))
+        host = cache.get("host_tier")
+        if host:
+            lines.append(
+                f"  host spill tier: {host['hits']} hit(s) "
+                f"({host['hit_rate'] * 100:.1f}% of probes)"
+                if host.get("hit_rate") is not None else
+                f"  host spill tier: {host['hits']} hit(s)")
+            lines.append(
+                f"    spills {host['spills_completed']} "
+                f"(dropped {host['spills_dropped']}, "
+                f"evicted {host['evictions']}), swap-ins "
+                f"{host['swap_ins']} in {_fmt(host['swap_in_secs'])}")
+            # the realized-vs-projected line: the ghost tiers say what
+            # a bigger HBM pool WOULD hit; the host tier is the tier we
+            # actually bought — compare the two-tier rate against each
+            # projection
+            two_tier = cache.get("hit_rate")
+            if two_tier is not None and ghost:
+                proj = " ".join(
+                    f"{t}={g['hit_rate'] * 100:.1f}%"
+                    for t, g in ghost.items()
+                    if g.get("hit_rate") is not None)
+                if proj:
+                    lines.append(
+                        f"    two-tier hit rate {two_tier * 100:.1f}% "
+                        f"vs ghost projection {proj}")
+            net = host.get("ttft_saved_secs_per_request")
+            if net is not None:
+                lines.append(
+                    f"    ttft saved/req {net:.4f}s "
+                    f"(net of measured swap-in time)")
 
     fleet = report.get("fleet")
     if fleet:

@@ -7,8 +7,10 @@ single replica — and renders a refreshing per-replica table:
 occupancy, tokens/sec, TTFT/TPOT p95, prefix-cache hit rate (lifetime
 and frame-windowed), the ghost x10 projected hit rate and evictions/sec
 from the cache observatory (serving/cache_observatory.py), the
-engine-loop ``host bubble %`` (serving/loop_profiler.py), engine
-restarts, and router brownout state.
+windowed host-tier hit rate and device->host spills/sec from the
+hierarchical KV cache (serving/host_cache.py), the engine-loop ``host
+bubble %`` (serving/loop_profiler.py), engine restarts, and router
+brownout state.
 
 Stdlib only (no jax, no requests): runs on a laptop against a tunnel,
 like serve_bench / serve_report.
@@ -93,6 +95,8 @@ def _replica_row(name: str, url, snap) -> dict:
         "cache_hit_rate_window": None,
         "cache_evictions": None, "evictions_per_sec": None,
         "ghost_x10_hit_rate": None,
+        "cache_host_hits": None, "host_spills": None,
+        "host_hit_rate_window": None, "host_spills_per_sec": None,
         "device_busy_pct": None, "host_bubble_pct": None,
         "loop_stalls": None, "engine_restarts": None,
         "draining": False,
@@ -134,6 +138,11 @@ def _replica_row(name: str, url, snap) -> dict:
             row["cache_evictions"] = (ec or 0) + (eh or 0)
         row["ghost_x10_hit_rate"] = _num(eng, "cache", "ghost", "x10",
                                          "hit_rate")
+        # hierarchical KV cache: host-tier rescues out of the two-tier
+        # hit attribution, device->host spills from the tier itself
+        row["cache_host_hits"] = _num(eng, "cache", "host_hits")
+        row["host_spills"] = _num(eng, "cache", "host",
+                                  "spills_completed")
     return row
 
 
@@ -228,6 +237,21 @@ def add_rates(snapshot: dict, prev: dict) -> None:
             row["evictions_per_sec"] = round(
                 max(row["cache_evictions"] - p["cache_evictions"], 0) / dt,
                 2)
+        # windowed host-tier hit rate: host-rescued blocks / probes
+        # over this frame only (lifetime counters mask regressions)
+        if (row["cache_probes"] is not None
+                and p.get("cache_probes") is not None
+                and row["cache_host_hits"] is not None
+                and p.get("cache_host_hits") is not None):
+            dp = row["cache_probes"] - p["cache_probes"]
+            dh = row["cache_host_hits"] - p["cache_host_hits"]
+            if dp > 0:
+                row["host_hit_rate_window"] = round(
+                    max(min(dh / dp, 1.0), 0.0), 4)
+        if (row["host_spills"] is not None
+                and p.get("host_spills") is not None):
+            row["host_spills_per_sec"] = round(
+                max(row["host_spills"] - p["host_spills"], 0) / dt, 2)
     if any_rate:
         snapshot["fleet"]["tokens_per_sec"] = round(fleet_rate, 2)
 
@@ -253,7 +277,9 @@ COLUMNS = (
     ("hit%", 7, None, ""),
     ("whit%", 7, None, ""),
     ("g10%", 6, None, ""),
+    ("hhit%", 7, None, ""),
     ("ev/s", 6, "evictions_per_sec", ".1f"),
+    ("sp/s", 6, "host_spills_per_sec", ".1f"),
     ("bubble%", 8, "host_bubble_pct", ".1f"),
     ("stalls", 7, "loop_stalls", "d"),
     ("restarts", 8, "engine_restarts", "d"),
@@ -286,10 +312,11 @@ def render(snapshot: dict) -> str:
             if h == "up":
                 v = ("DRAIN" if row["draining"]
                      else "up" if row["alive"] else "DOWN")
-            elif h in ("hit%", "whit%", "g10%"):
+            elif h in ("hit%", "whit%", "g10%", "hhit%"):
                 hr = row[{"hit%": "cache_hit_rate",
                           "whit%": "cache_hit_rate_window",
-                          "g10%": "ghost_x10_hit_rate"}[h]]
+                          "g10%": "ghost_x10_hit_rate",
+                          "hhit%": "host_hit_rate_window"}[h]]
                 v = _fmt(100.0 * hr, ".1f") if hr is not None else "-"
             else:
                 v = _fmt(row.get(key), spec)

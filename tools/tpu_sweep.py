@@ -150,6 +150,23 @@ MANIFEST: List[Step] = [
          "python -m pytest tests/test_cache_observatory.py "
          "-m slow -k cache_overhead -q -p no:cacheprovider",
          900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+    # hierarchical KV cache A/B smoke: serve_bench
+    # --ab serve_host_cache_bytes against two real CPU replicas with an
+    # HBM pool half the size of the Zipf prefix working set — the ON
+    # arm must rescue evicted prefixes from host RAM (host-tier hits +
+    # device->host spills), the OFF arm recomputes them
+    Step("serve_host_cache_ab",
+         "python -m pytest tests/test_serve_bench_tool.py "
+         "-m slow -k ab_host_cache -q -p no:cacheprovider",
+         900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+    # host spill tier overhead gate: the two-tier bookkeeping
+    # (match+pin, swap-in consume, spill enqueue, free-time unpin) must
+    # stay under 2% of a measured CPU dispatch — the spill tier's wins
+    # come from the copies it avoids, not from taxing the hot path
+    Step("serve_host_cache_overhead",
+         "python -m pytest tests/test_host_cache.py "
+         "-m slow -k host_cache_overhead -q -p no:cacheprovider",
+         900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
 ]
 
 
