@@ -499,6 +499,29 @@ def _add_inference_args(parser):
                         "'nan@12,hang@30:5,slow@40:250,oom@8' (1-based "
                         "engine dispatch indices; each trigger fires "
                         "once).  Testing only.")
+    # SLO sentinel (serving/alerts.py; docs/guide/observability.md
+    # "Alerting & incidents")
+    g.add_argument("--serve_alerts", type=int, default=1,
+                   help="SLO sentinel (serving/alerts.py): evaluate "
+                        "burn-rate/threshold/rate alert rules over "
+                        "/metrics on the alert-eval thread, surface "
+                        "firing alerts in /metrics + schema-13 "
+                        "alert_transition JSONL events, and capture a "
+                        "postmortem bundle under "
+                        "<structured_log_dir>/incidents on each firing; "
+                        "0 disables the evaluator")
+    g.add_argument("--alert_rules", type=str, default=None,
+                   help="alert rule set replacing the built-in defaults: "
+                        "inline JSON (a list of rule objects, or "
+                        "{'interval_secs':..,'rules':[..]}) or a path "
+                        "to a JSON file (see "
+                        "serving/alerts.py DEFAULT_RULES for the rule "
+                        "grammar)")
+    g.add_argument("--alert_webhook", type=str, default=None,
+                   help="POST every firing/resolved alert transition "
+                        "to this URL as JSON (bounded retry with "
+                        "backoff; delivery is best-effort and never "
+                        "blocks serving)")
 
 
 def _add_resilience_args(parser):

@@ -49,7 +49,6 @@ import json
 import math
 import os
 import signal
-import sys
 import threading
 import time
 import traceback
@@ -201,11 +200,12 @@ def dump_stacks_and_memory(printer: Callable[[str], None] = print) -> str:
     """Python stacks for every thread + per-device memory_stats().  Returns
     the dump as a string (also sent through ``printer``)."""
     lines = ["==== watchdog: python stacks ===="]
-    frames = sys._current_frames()
-    names = {t.ident: t.name for t in threading.enumerate()}
-    for ident, frame in frames.items():
-        lines.append(f"-- thread {names.get(ident, '?')} ({ident}) --")
-        lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+    # shared all-thread stack capture (telemetry.py): the same report
+    # the serving alert engine's postmortem bundles embed, so training
+    # and serving forensics read identically
+    from megatron_llm_tpu import telemetry as _telemetry
+
+    lines.append(_telemetry.capture_thread_stacks())
     lines.append("==== watchdog: device memory ====")
     try:
         import jax

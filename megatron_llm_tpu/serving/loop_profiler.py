@@ -225,6 +225,12 @@ class LoopProfiler:
         dev = 100.0 * min(device / busy, 1.0)
         return round(dev, 3), round(100.0 - dev, 3)
 
+    def ring_records(self) -> List[Dict[str, Any]]:
+        """Copy of the per-dispatch ring — the raw material postmortem
+        bundles freeze when an alert fires (serving/alerts.py)."""
+        with self._lock:
+            return list(self._ring)
+
     def stats(self) -> Dict[str, Any]:
         """JSON-able rollup for the engine's ``/metrics`` block.  The
         phase histograms carry the mergeable ``Histogram.snapshot()``

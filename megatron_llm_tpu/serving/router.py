@@ -325,7 +325,7 @@ class ReplicaRouter:
         "requests_total", "failovers_total", "mid_stream_failures_total",
         "throttled_total", "no_backend_total", "affinity_hits",
         "_affinity", "backends", "_brownout_until", "brownout_429s_total",
-        "peers", "_fleet_stats_data", "_dispatch_hist",
+        "peers", "_fleet_stats_data", "_dispatch_hist", "_peer_cache",
     )
 
     def __init__(self, backend_urls: Sequence[str],
@@ -363,6 +363,11 @@ class ReplicaRouter:
         # sibling-router URLs (never containing this router) for the
         # peer-merged fleet /metrics view
         self.peers: List[str] = []
+        # last good one-hop snapshot per peer URL -> (snapshot, scraped
+        # monotonic): a transient scrape failure serves the cached view
+        # with its age visible (router_tier.last_scrape_age_secs)
+        # instead of silently dropping the peer from the merge
+        self._peer_cache: Dict[str, Tuple[Dict[str, object], float]] = {}
         self._lock = threading.Lock()
         self.requests_total = 0
         self.failovers_total = 0
@@ -942,6 +947,7 @@ class ReplicaRouter:
         aggregate: Dict[str, object] = {}
         per_replica: Dict[str, Dict[str, object]] = {}
         heat_tables: List[object] = []
+        alert_blocks: Dict[str, object] = {}
         for i, b in enumerate(self.backends_list()):
             snap = None
             try:
@@ -958,12 +964,27 @@ class ReplicaRouter:
                 snap = None
             per_backend[f"backend_{i}"] = snap
             if isinstance(snap, dict):
-                _sum_numeric(aggregate, snap)
-                _collect_non_numeric(per_replica, snap, f"backend_{i}")
+                # alert states are facts about one replica: excluded
+                # from the numeric sum (which would add counters and
+                # drop the firing lists) and merged explicitly below
+                if isinstance(snap.get("alerts"), dict):
+                    alert_blocks[b.url] = snap["alerts"]
+                summable = {k: v for k, v in snap.items()
+                            if k != "alerts"}
+                _sum_numeric(aggregate, summable)
+                _collect_non_numeric(per_replica, summable, f"backend_{i}")
                 cache = snap.get("engine", {})
                 cache = cache.get("cache") if isinstance(cache, dict) else None
                 if isinstance(cache, dict) and cache.get("heat_top"):
                     heat_tables.append(cache["heat_top"])
+        if alert_blocks:
+            try:
+                from megatron_llm_tpu.serving.alerts import (
+                    merge_alert_blocks)
+
+                aggregate["alerts"] = merge_alert_blocks(alert_blocks)
+            except ImportError:
+                pass  # stdlib-only vendored router without the package
         if heat_tables:
             # _sum_numeric drops list leaves, so the fleet heat table is
             # merged explicitly: same salted prefix (fleet-stable
@@ -1049,13 +1070,37 @@ class ReplicaRouter:
         _sum_numeric(merged, self._tier_view(local))
         peers = self.peers_list()
         reporting = 1
+        now = time.monotonic()
+        # the local view is by definition fresh; peers report their
+        # scrape age so a cache-served (stale) snapshot is visible in
+        # the merged tier view instead of passing as current
+        ages: Dict[str, object] = {"router_0": 0.0}
         for i, url in enumerate(peers):
+            key = f"router_{i + 1}"
             snap = self._get_json(url, "/metrics?scope=router")
             rsnap = snap.get("router") if isinstance(snap, dict) else None
-            per_router[f"router_{i + 1}"] = rsnap
+            if isinstance(rsnap, dict):
+                with self._lock:
+                    self._peer_cache[url] = (rsnap, now)
+                ages[key] = 0.0
+                reporting += 1
+            else:
+                with self._lock:
+                    cached = self._peer_cache.get(url)
+                if cached is not None:
+                    rsnap = cached[0]
+                    ages[key] = round(now - cached[1], 3)
+                else:
+                    ages[key] = None    # never answered: nothing to age
+            per_router[key] = rsnap
             if isinstance(rsnap, dict):
                 _sum_numeric(merged, self._tier_view(rsnap))
-                reporting += 1
+        with self._lock:
+            # bound the cache to the current peer set (scale-downs and
+            # dead routers must not pin their final snapshot forever)
+            live = set(peers)
+            for url in [u for u in self._peer_cache if u not in live]:
+                self._peer_cache.pop(url, None)
         hists = merged.get("histograms")
         if isinstance(hists, dict):
             try:
@@ -1075,6 +1120,7 @@ class ReplicaRouter:
         out["router_tier"] = {
             "routers_total": 1 + len(peers),
             "routers_reporting": reporting,
+            "last_scrape_age_secs": ages,
             "merged": merged,
             "per_router": per_router,
         }

@@ -726,7 +726,9 @@ class FleetSupervisor:
                  event_log_path: Optional[str] = None,
                  event_sink: Optional[Callable[[dict], None]] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 router_backend: Optional[ReplicaBackend] = None):
+                 router_backend: Optional[ReplicaBackend] = None,
+                 alert_rules: Optional[List[dict]] = None,
+                 alert_webhook: Optional[str] = None):
         self.router = router
         self.backend = backend
         self.router_backend = router_backend
@@ -756,6 +758,25 @@ class FleetSupervisor:
         self._spawn_secs_ema: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # fleet-scope SLO sentinel: the same rule engine each replica
+        # runs locally, here evaluated over the router's *merged*
+        # aggregate every control-loop turn (so fleet burn rates come
+        # from merged histogram buckets, never summed percentiles).
+        # Pumped from observe() — no thread of its own — and emitting
+        # kind="fleet" alert_transition events through _emit.  Lazy
+        # import keeps this module's stdlib-only contract: alerts.py is
+        # itself stdlib-only, but vendored deployments may ship
+        # supervisor.py alone.
+        self.alerts = None
+        try:
+            from megatron_llm_tpu.serving.alerts import AlertEngine
+
+            self.alerts = AlertEngine(
+                rules=alert_rules, scope="fleet", clock=clock,
+                transition_sink=self._emit_alert_transition,
+                webhook_url=alert_webhook)
+        except ImportError:
+            pass
         router.set_fleet_stats(self.stats)
 
     # -- events ----------------------------------------------------------
@@ -776,6 +797,13 @@ class FleetSupervisor:
             except ValueError:
                 pass            # closed mid-shutdown
         return rec
+
+    def _emit_alert_transition(self, payload: dict) -> None:
+        """AlertEngine transition sink: wrap the payload in the fleet
+        event envelope (schema stamp, kind="fleet") and fan it out to
+        the event ring / sink / JSONL like every other fleet event."""
+        fields = {k: v for k, v in payload.items() if k != "event"}
+        self._emit("alert_transition", **fields)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -980,6 +1008,11 @@ class FleetSupervisor:
             agg = self.router.aggregated_metrics().get("aggregate", {})
         except Exception:   # noqa: BLE001 - observation must not die
             agg = {}
+        if self.alerts is not None and agg:
+            try:
+                self.alerts.evaluate(snapshot=agg, now=now)
+            except Exception:   # noqa: BLE001 - sentinel must not kill us
+                pass
         hist = None
         hists = agg.get("histograms")
         if isinstance(hists, dict):
@@ -1230,4 +1263,9 @@ class FleetSupervisor:
             "routers_ready": sum(r.state == "ready" for r in routers),
         }
         out.update(counters)
+        if self.alerts is not None:
+            # fleet-scope alert states ride the router's /metrics under
+            # fleet.alerts (the tier merge excludes "fleet", so the
+            # block is never numeric-summed across sibling routers)
+            out["alerts"] = self.alerts.snapshot()
         return out

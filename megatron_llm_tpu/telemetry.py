@@ -200,11 +200,95 @@ class FlightRecorder:
             "capacity": self.capacity,
             "records": self.records(),
         }
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(payload, f, indent=1)
-        os.replace(tmp, path)
-        return path
+        return atomic_write_json(path, payload)
+
+
+# ---------------------------------------------------------------------------
+# Atomic snapshot writing (shared by the flight recorder, the crash
+# path's stack dump, and the serving alert engine's postmortem bundles)
+# ---------------------------------------------------------------------------
+
+def atomic_write_json(path: str, payload: Any, indent: int = 1) -> str:
+    """Write JSON atomically (tmp + rename): a reader — or a scraper
+    racing process death — sees either the old file or the complete new
+    one, never a truncated write."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=indent, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def capture_thread_stacks() -> str:
+    """All-thread stack report (the watchdog/crash dump and the alert
+    bundles share this): one block per thread with name/daemon flag and
+    the formatted frames from ``sys._current_frames``."""
+    import sys
+    import traceback
+
+    frames = sys._current_frames()
+    names = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in sorted(frames.items()):
+        t = names.get(ident)
+        label = f"{t.name}{' (daemon)' if t.daemon else ''}" \
+            if t is not None else "unknown"
+        out.append(f"--- thread {label} (ident {ident}) ---")
+        out.append("".join(traceback.format_stack(frame)))
+    return "\n".join(out)
+
+
+def write_snapshot_bundle(dir_path: str, parts: Dict[str, Any],
+                          max_bytes_per_part: int = 2_000_000,
+                          manifest_extra: Optional[Dict[str, Any]] = None
+                          ) -> str:
+    """Write a postmortem bundle as an atomically-published directory.
+
+    ``parts`` maps part name -> payload: a str becomes ``<name>.txt``,
+    anything else JSON-serializes to ``<name>.json``.  Every part is
+    size-bounded (oversize payloads are truncated with a marker, never
+    dropped silently) and the bundle carries a ``manifest.json`` listing
+    what landed.  The whole directory is staged under a pid-suffixed tmp
+    name and published with one ``os.replace`` so a reader never sees a
+    half-written bundle — the same tmp+rename discipline as
+    :func:`atomic_write_json`, at directory granularity."""
+    tmp = f"{dir_path}.tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    manifest: Dict[str, Any] = {
+        "written_at_unix": time.time(),
+        "parts": {},
+    }
+    if manifest_extra:
+        manifest.update(manifest_extra)
+    for name, payload in sorted(parts.items()):
+        try:
+            if isinstance(payload, str):
+                fname, data = f"{name}.txt", payload
+            else:
+                fname, data = f"{name}.json", json.dumps(
+                    payload, indent=1, default=str)
+            truncated = False
+            if len(data) > max_bytes_per_part:
+                data = data[:max_bytes_per_part] \
+                    + "\n...[truncated by bundle size bound]"
+                truncated = True
+            with open(os.path.join(tmp, fname), "w") as f:
+                f.write(data)
+            manifest["parts"][name] = {"file": fname,
+                                       "bytes": len(data),
+                                       "truncated": truncated}
+        except Exception as exc:    # noqa: BLE001 - forensics: best effort
+            manifest["parts"][name] = {"error": repr(exc)}
+    atomic_write_json(os.path.join(tmp, "manifest.json"), manifest)
+    if os.path.isdir(dir_path):     # an older bundle with the same name
+        os.replace(os.path.join(tmp, "manifest.json"),
+                   os.path.join(dir_path, "manifest.json"))
+        for f in os.listdir(tmp):
+            os.replace(os.path.join(tmp, f), os.path.join(dir_path, f))
+        os.rmdir(tmp)
+    else:
+        os.replace(tmp, dir_path)
+    return dir_path
 
 
 # ---------------------------------------------------------------------------
@@ -350,8 +434,29 @@ def prometheus_exposition(snapshot: dict,
     a level, and gauge is always safe.  Histogram snapshots (the
     ``Histogram.snapshot()`` shape) render as proper Prometheus
     histograms: cumulative ``_bucket{le=...}`` series plus ``_sum`` and
-    ``_count``."""
+    ``_count``.  An ``alerts`` block (the serving alert engine's
+    snapshot shape) renders its firing list as the labeled gauge
+    ``megatron_alert_firing{rule=...,scope=...} 1`` — the one labeled
+    series in the exposition, with a fixed unprefixed name so the same
+    alerting config scrapes replica and fleet endpoints alike — and its
+    numeric counters as ordinary gauges."""
     lines = []
+
+    def esc(v):
+        return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+    def emit_alert_block(path, block):
+        lines.append("# TYPE megatron_alert_firing gauge")
+        for entry in block.get("firing") or []:
+            if not isinstance(entry, dict):
+                continue
+            lines.append(
+                f'megatron_alert_firing{{rule="{esc(entry.get("rule"))}"'
+                f',scope="{esc(entry.get("scope"))}"'
+                f',severity="{esc(entry.get("severity"))}"}} 1')
+        rest = {k: v for k, v in block.items()
+                if k not in ("firing", "pending")}
+        walk(rest, path)
 
     def emit(name, value):
         if isinstance(value, bool) or not isinstance(value, (int, float)):
@@ -379,6 +484,9 @@ def prometheus_exposition(snapshot: dict,
         for k, v in sorted(d.items()):
             if is_histogram_snapshot(v):
                 emit_histogram(f"{path}{k}", v)
+            elif k == "alerts" and isinstance(v, dict) \
+                    and isinstance(v.get("firing"), list):
+                emit_alert_block(f"{path}{k}_", v)
             elif isinstance(v, dict):
                 walk(v, f"{path}{k}_")
             else:
@@ -453,7 +561,14 @@ def _wants_prometheus(path: str, accept: str) -> bool:
 #    host→device scatter time the request paid for them); cache_stats
 #    records gain host_hits / host_hit_tokens / swap_in_blocks and a
 #    "host" sub-block (spill/eviction/swap-in counters, budget usage)
-TELEMETRY_SCHEMA_VERSION = 12
+# 13: + alert_transition events (serving/alerts.py SLO sentinel):
+#    kind="serve" per-replica (and kind="fleet" at the supervisor's
+#    merged scope) records with rule / scope / state
+#    (pending|firing|resolved) / severity / value / threshold /
+#    window_secs / since_unix / bundle (the postmortem bundle directory
+#    captured on firing) — see serving/alerts.py and
+#    tools/serve_report.py's incident timeline
+TELEMETRY_SCHEMA_VERSION = 13
 STREAM_FILENAME = "telemetry.jsonl"
 FLIGHT_RECORDER_FILENAME = "flight_recorder.json"
 

@@ -31,6 +31,7 @@ import os
 import signal
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from megatron_llm_tpu.text_generation.api import (
@@ -68,13 +69,23 @@ class ServerMetrics:
     # lint-enforced (graft-lint threads/TH001): the SLO histograms are
     # fed from the engine loop (request_done hook) and read by HTTP
     # handler threads; drained is bumped from signal context and HTTP
-    # threads and read by /metrics
-    _lock_protected_ = {"histograms": "_lock", "drained": "_lock"}
+    # threads and read by /metrics; recent_records is appended by the
+    # engine loop and read by the alert engine's bundle capture
+    _lock_protected_ = {"histograms": "_lock", "drained": "_lock",
+                        "recent_records": "_lock"}
 
-    def __init__(self, window: int = 512):
+    def __init__(self, window: int = 512, recent_records_size: int = 64):
         self._lock = threading.Lock()
         self._window = max(int(window), 1)
         self._latencies = []        # bounded: last `window` request secs
+        # last-N finished-request records, verbatim — the alert engine's
+        # postmortem bundles embed them so "what were the last requests
+        # before the alert" is answerable offline
+        self.recent_records = deque(maxlen=max(int(recent_records_size), 1))
+        # the SLO sentinel (serving/alerts.py), attached by the host
+        # (run_text_generation_server) when alerting is enabled; its
+        # snapshot rides in /metrics under "alerts"
+        self.alert_engine = None
         self.started_unix = time.time()
         self.requests = 0
         self.errors = 0
@@ -100,6 +111,7 @@ class ServerMetrics:
         engine guards it too, but belt and braces)."""
         try:
             with self._lock:
+                self.recent_records.append(dict(record))
                 self.histograms["ttft_secs"].observe(
                     record.get("ttft_secs"))
                 self.histograms["tpot_secs"].observe(
@@ -172,7 +184,18 @@ class ServerMetrics:
                 out["engine"] = fn()
             except Exception:
                 pass
+        alerts = self.alert_engine
+        if alerts is not None:
+            try:
+                out["alerts"] = alerts.snapshot()
+            except Exception:
+                pass
         return out
+
+    def recent_request_done(self) -> list:
+        """The last-N finished-request records (bundle source)."""
+        with self._lock:
+            return list(self.recent_records)
 
 
 def _count_tokens(body: dict) -> int:
@@ -746,3 +769,131 @@ class MegatronServer:
         print(f" * serving on http://{host}:{server.server_address[1]}/"
               f" (demo page) and /api", flush=True)
         server.serve_forever()
+
+
+def build_server_alerts(server, engine=None, structured_log_dir=None,
+                        alert_rules=None, alert_webhook=None,
+                        clock=None, start=True):
+    """Wire the SLO sentinel (serving/alerts.py) to a replica server.
+
+    Shared by tools/run_text_generation_server.py and the test replica
+    harness so both get identical behaviour: rules from ``--alert_rules``
+    (built-in defaults otherwise), metrics from the server's own
+    ``/metrics`` snapshot, ``alert_transition`` records on the schema-13
+    JSONL stream, and postmortem bundles frozen under
+    ``<structured_log_dir>/incidents/<rule>-<seq>`` the moment a rule
+    fires.  Returns the started :class:`AlertEngine` (or ``None`` when
+    the rules argument fails to parse — the server must keep serving
+    even with a bad ``--alert_rules``).
+    """
+    from megatron_llm_tpu.serving.alerts import AlertEngine, parse_rules_arg
+    from megatron_llm_tpu import telemetry as _telemetry
+    from megatron_llm_tpu import tracing as _tracing
+
+    rules, opts = None, {}
+    if alert_rules:
+        try:
+            rules, opts = parse_rules_arg(alert_rules)
+        except (ValueError, OSError) as exc:
+            print(f" * --alert_rules rejected ({exc}); alerting disabled",
+                  flush=True)
+            return None
+
+    metrics = server.metrics
+
+    def sink(payload: dict) -> None:
+        stream = _telemetry.get_stream()
+        if stream is not None:
+            # schema-13 contract: replica transitions are kind="serve"
+            # (the supervisor's fleet-scope engine stamps kind="fleet")
+            stream.emit({"kind": "serve", **payload})
+
+    bundle_fn = None
+    if structured_log_dir:
+        incidents_dir = os.path.join(structured_log_dir, "incidents")
+        max_bundles = int(opts.get("max_bundles", 8))
+        seq = [0]
+
+        def bundle_fn(transition: dict):
+            # Freeze everything a responder needs, bounded per part so a
+            # pathological ring can't fill the disk.  Each capture is
+            # independently best-effort: a dead trace exporter must not
+            # lose the thread stacks.
+            parts: dict = {"transition": dict(transition)}
+            try:
+                parts["metrics"] = metrics.snapshot()
+            except Exception as exc:
+                parts["metrics"] = {"error": str(exc)}
+            try:
+                parts["recent_requests"] = metrics.recent_request_done()
+            except Exception as exc:
+                parts["recent_requests"] = {"error": str(exc)}
+            try:
+                parts["thread_stacks"] = _telemetry.capture_thread_stacks()
+            except Exception as exc:
+                parts["thread_stacks"] = f"capture failed: {exc}"
+            if engine is not None:
+                try:
+                    parts["loop_ring"] = engine.loop_profiler.ring_records()
+                except Exception as exc:
+                    parts["loop_ring"] = {"error": str(exc)}
+                try:
+                    parts["cache"] = engine.cache_observatory.stats()
+                except Exception as exc:
+                    parts["cache"] = {"error": str(exc)}
+            try:
+                rec = _telemetry.get_flight_recorder()
+                if rec is not None:
+                    parts["flight_recorder"] = rec.records()
+            except Exception as exc:
+                parts["flight_recorder"] = {"error": str(exc)}
+            try:
+                trace_path = _tracing.dump_trace(
+                    reason=f"alert:{transition.get('rule')}")
+                if trace_path:
+                    parts["trace"] = {"chrome_trace_path": trace_path}
+            except Exception as exc:
+                parts["trace"] = {"error": str(exc)}
+            seq[0] += 1
+            dest = os.path.join(
+                incidents_dir, f"{transition.get('rule')}-{seq[0]:04d}")
+            path = _telemetry.write_snapshot_bundle(
+                dest, parts,
+                manifest_extra={"rule": transition.get("rule"),
+                                "scope": transition.get("scope"),
+                                "severity": transition.get("severity")})
+            _prune_incident_bundles(incidents_dir, max_bundles)
+            return path
+
+    eng = AlertEngine(
+        rules=rules,
+        metrics_fn=metrics.snapshot,
+        scope="replica",
+        interval_secs=float(opts.get("interval_secs", 2.0)),
+        transition_sink=sink,
+        bundle_fn=bundle_fn,
+        webhook_url=alert_webhook,
+        max_firing=int(opts.get("max_firing", 10)),
+        **({"clock": clock} if clock is not None else {}),
+    )
+    metrics.alert_engine = eng
+    if start:
+        eng.start()
+    return eng
+
+
+def _prune_incident_bundles(incidents_dir: str, keep: int) -> None:
+    """Cap the incidents directory at ``keep`` bundles, oldest out
+    first — incident capture must never become its own disk incident."""
+    import shutil
+    try:
+        names = [n for n in os.listdir(incidents_dir)
+                 if os.path.isdir(os.path.join(incidents_dir, n))]
+    except OSError:
+        return
+    if len(names) <= keep:
+        return
+    names.sort(key=lambda n: os.path.getmtime(
+        os.path.join(incidents_dir, n)))
+    for n in names[:len(names) - keep]:
+        shutil.rmtree(os.path.join(incidents_dir, n), ignore_errors=True)

@@ -32,7 +32,8 @@ jax.config.update("jax_platforms", "cpu")
 
 from megatron_llm_tpu.models.llama import LlamaModel, llama_config  # noqa: E402
 from megatron_llm_tpu.serving import EngineConfig, InferenceEngine  # noqa: E402
-from megatron_llm_tpu.text_generation_server import MegatronServer  # noqa: E402
+from megatron_llm_tpu.text_generation_server import (  # noqa: E402
+    MegatronServer, build_server_alerts)
 
 
 class _FakeTokenizer:
@@ -76,6 +77,14 @@ def main():
                         "(fixed-shape K+1 verify step)")
     p.add_argument("--serve_draft_k", type=int, default=4,
                    help="max draft tokens per slot per verify step")
+    p.add_argument("--serve_alerts", type=int, default=0,
+                   help="1 = run the SLO sentinel (serving/alerts.py); "
+                        "off by default so router tests stay quiet")
+    p.add_argument("--alert_rules", default=None,
+                   help="inline JSON or path overriding the built-in "
+                        "alert rules (chaos tests use tight windows)")
+    p.add_argument("--alert_webhook", default=None,
+                   help="POST firing/resolved transitions to this URL")
     args = p.parse_args()
     if args.structured_log_dir:
         from megatron_llm_tpu import telemetry
@@ -114,6 +123,11 @@ def main():
     engine.start()
     server = MegatronServer(model, params, _FakeTokenizer(),
                             engine=engine, max_prompts=4, max_tokens=32)
+    if args.serve_alerts:
+        build_server_alerts(server, engine=engine,
+                            structured_log_dir=args.structured_log_dir,
+                            alert_rules=args.alert_rules,
+                            alert_webhook=args.alert_webhook)
     # run() lives on a worker thread here, so the server can't install
     # its own SIGTERM hook — wire the graceful drain from the main thread
     signal.signal(signal.SIGTERM, lambda *_: server.begin_drain("SIGTERM"))

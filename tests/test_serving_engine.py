@@ -283,6 +283,12 @@ def test_engine_zero_recompiles_after_warmup(engine, model_and_params,
     telemetry.install_stream(stream)
     metrics = ServerMetrics()
     engine.request_done_hook = metrics.observe_request_done
+    metrics.engine_stats_fn = engine.stats
+    # the SLO sentinel rides along: its evaluator is pure host-side
+    # arithmetic over metrics snapshots and must also stay compile-free
+    from megatron_llm_tpu.serving.alerts import AlertEngine
+    sentinel = AlertEngine(metrics_fn=metrics.snapshot)
+    metrics.alert_engine = sentinel
     try:
         det.mark_steady()
         reqs = []
@@ -297,8 +303,11 @@ def test_engine_zero_recompiles_after_warmup(engine, model_and_params,
                                       trace_id=f"{i:016x}"))
         for r in reqs:
             r.result(timeout=180)
+            sentinel.evaluate()     # pump the alert evaluator mid-traffic
         assert det.recompiles == 0, \
             f"{det.recompiles} recompiles after warmup: {list(det.events)}"
+        assert sentinel.counters["evaluations"] == 10
+        assert not sentinel.snapshot()["firing"]
         # the observability stack saw every request while staying free
         # (results signal before the engine thread finishes retiring the
         # request, so give the last hook call a moment to land)
@@ -338,7 +347,7 @@ def test_request_done_schema_golden(engine, tmp_path):
     the schema history comment in telemetry.py)."""
     from megatron_llm_tpu import telemetry
 
-    assert telemetry.TELEMETRY_SCHEMA_VERSION == 12
+    assert telemetry.TELEMETRY_SCHEMA_VERSION == 13
     captured = []
     engine.request_done_hook = captured.append
     stream = telemetry.TelemetryStream(str(tmp_path))

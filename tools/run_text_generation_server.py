@@ -14,7 +14,8 @@ from megatron_llm_tpu.arguments import transformer_config_from_args
 from megatron_llm_tpu.initialize import initialize_megatron
 from megatron_llm_tpu.models import MODEL_REGISTRY
 from megatron_llm_tpu.parallel import sharding as sh
-from megatron_llm_tpu.text_generation_server import MegatronServer
+from megatron_llm_tpu.text_generation_server import (
+    MegatronServer, build_server_alerts)
 
 
 def extra_args(parser):
@@ -112,13 +113,21 @@ def main():
         if tr is not None and tr.recompile is not None:
             tr.recompile.mark_steady()
         engine.start()
-    MegatronServer(model, params, tokenizer,
-                   int8_kv_cache=args.int8_kv_cache,
-                   engine=engine,
-                   log_requests=args.log_requests,
-                   max_prompts=args.serve_max_prompts,
-                   max_tokens=args.serve_max_tokens,
-                   ).run(args.host, args.port)
+    server = MegatronServer(model, params, tokenizer,
+                            int8_kv_cache=args.int8_kv_cache,
+                            engine=engine,
+                            log_requests=args.log_requests,
+                            max_prompts=args.serve_max_prompts,
+                            max_tokens=args.serve_max_tokens)
+    # SLO sentinel (serving/alerts.py): burn-rate + threshold alerting
+    # over this replica's own /metrics, postmortem bundles under
+    # <structured_log_dir>/incidents, transitions on the JSONL stream
+    if args.serve_alerts:
+        build_server_alerts(server, engine=engine,
+                            structured_log_dir=args.structured_log_dir,
+                            alert_rules=args.alert_rules,
+                            alert_webhook=args.alert_webhook)
+    server.run(args.host, args.port)
 
 
 if __name__ == "__main__":

@@ -107,7 +107,19 @@ JSON_SCHEMA_KEYS = (
     # moves when the prefix pool exceeds the HBM budget
     "cache_host_hits", "cache_host_spills", "cache_swap_in_blocks",
     "cache_swap_in_secs",
+    # client-observed SLO attainment (--slo_gate): per-request joint
+    # pass/fail against the TTFT/TPOT targets — a failed request counts
+    # as NOT attained; requests without a streamed TTFT/TPOT sample
+    # gate on success only
+    "ttft_slo_secs", "tpot_slo_secs", "slo_joint_attainment",
+    "slo_gate",
 )
+
+# Exit codes: 0 = all requests succeeded; 1 = at least one request
+# failed; 2 = argparse/usage error; 3 = --slo_gate given and the joint
+# SLO attainment (min across arms under --ab) fell below the gate.
+# tools/tpu_sweep.py and CI read these — renumbering is a breaking
+# change.
 
 
 def parse_rate_schedule(spec: str):
@@ -308,7 +320,9 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
               prefix_zipf: float = 0.0,
               prefix_pool: int = 16,
               rate_schedule: str = None,
-              temperature: float = None) -> dict:
+              temperature: float = None,
+              ttft_slo: float = 1.0,
+              tpot_slo: float = 0.25) -> dict:
     """Drive the load and aggregate results (importable — the tier-1
     smoke test calls this directly against an in-process server).
 
@@ -393,6 +407,22 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
     ttft = [r["ttft_secs"] for r in ok if r["ttft_secs"] is not None]
     tpot = [r["tpot_secs"] for r in ok if r.get("tpot_secs") is not None]
     total_tokens = sum(r["tokens"] for r in ok)
+
+    def _slo_attained(r):
+        # joint SLO verdict per request: failures never attain; latency
+        # dimensions only gate when the client actually measured them
+        # (TTFT/TPOT need --stream)
+        if not r["ok"]:
+            return False
+        t = r.get("ttft_secs")
+        if t is not None and t > ttft_slo:
+            return False
+        tp = r.get("tpot_secs")
+        if tp is not None and tp > tpot_slo:
+            return False
+        return True
+
+    slo_attained = sum(1 for r in results if _slo_attained(r))
     by_status = {}
     for r in results:
         by_status[str(r["status"])] = by_status.get(str(r["status"]), 0) + 1
@@ -483,6 +513,13 @@ def run_bench(base_url: str, clients: int = 4, requests: int = 16,
         "cache_host_spills": None,
         "cache_swap_in_blocks": None,
         "cache_swap_in_secs": None,
+        # client-observed joint SLO attainment against the targets
+        # above; "slo_gate" echoes --slo_gate (None when no gate)
+        "ttft_slo_secs": ttft_slo,
+        "tpot_slo_secs": tpot_slo,
+        "slo_joint_attainment": (round(slo_attained / len(results), 4)
+                                 if results else None),
+        "slo_gate": None,
     }
     if schedule:
         segs = []
@@ -801,6 +838,19 @@ def main(argv=None):
                         "rest get unique same-length headers")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit one JSON object instead of the table")
+    p.add_argument("--slo_gate", type=float, default=None,
+                   metavar="FRAC",
+                   help="exit 3 unless the joint SLO attainment "
+                        "(fraction of requests succeeding within "
+                        "--ttft_slo and --tpot_slo; failures never "
+                        "attain) reaches FRAC; under --ab the MIN "
+                        "across both arms gates")
+    p.add_argument("--ttft_slo", type=float, default=1.0,
+                   help="time-to-first-token target in seconds for "
+                        "--slo_gate (matches serve_report's default)")
+    p.add_argument("--tpot_slo", type=float, default=0.25,
+                   help="per-output-token target in seconds for "
+                        "--slo_gate (matches serve_report's default)")
     p.add_argument("--ab", default=None, metavar="SERVER_FLAG",
                    help="A/B comparison over any boolean server flag "
                         "(e.g. serve_paged_kernel, serve_prefill_kernel): "
@@ -820,11 +870,29 @@ def main(argv=None):
               prefix_zipf=args.prefix_zipf,
               prefix_pool=args.prefix_pool,
               rate_schedule=args.rate_schedule,
-              temperature=args.temperature)
+              temperature=args.temperature,
+              ttft_slo=args.ttft_slo, tpot_slo=args.tpot_slo)
+
+    def slo_gate_rc(rows):
+        # exit 3 on gate miss — distinct from 1 (request errors) so a
+        # sweep can tell "server broke" from "server too slow"
+        if args.slo_gate is None:
+            return None
+        atts = [r.get("slo_joint_attainment") for r in rows]
+        worst = min((a for a in atts if a is not None), default=None)
+        if worst is None or worst < args.slo_gate:
+            print(f"SLO gate FAILED: joint attainment "
+                  f"{worst if worst is not None else 'unmeasured'} "
+                  f"< {args.slo_gate}", file=sys.stderr)
+            return 3
+        return None
+
     if args.ab:
         if not args.ab_url:
             p.error("--ab needs --ab_url (the second arm's server)")
         rows = run_ab([base_url, args.ab_url], ["on", "off"], **kw)
+        for row in rows:
+            row["slo_gate"] = args.slo_gate
         if args.as_json:
             print(json.dumps({"ab": args.ab, "rows": rows}, indent=2))
         else:
@@ -871,12 +939,19 @@ def main(argv=None):
                       f"(ttft mean "
                       f"{_fmt(on.get('ttft_mean_secs'), 's')} / "
                       f"{_fmt(off.get('ttft_mean_secs'), 's')})")
+        rc = slo_gate_rc(rows)
+        if rc is not None:
+            return rc
         return 0 if all(r["errors"] == 0 for r in rows) else 1
     r = run_bench(base_url, **kw)
+    r["slo_gate"] = args.slo_gate
     if args.as_json:
         print(json.dumps(r, indent=2))
     else:
         print_table(r)
+    rc = slo_gate_rc([r])
+    if rc is not None:
+        return rc
     return 0 if r["errors"] == 0 else 1
 
 

@@ -50,6 +50,11 @@ trace_id, per-request phase attribution, tpot_secs) and prints:
   >= 7: replica_spawned/died/respawned, scale_up/down, brownout) from a
   serve log or a ``tools/serve_fleet.py --fleet_event_log`` JSONL,
   rendered as counters plus a chronological timeline
+* incident timeline — ``alert_transition`` records (telemetry schema
+  >= 13, serving/alerts.py) reconstructed into firing->resolved
+  incidents, each correlated with the fleet events and engine restarts
+  that happened inside its window (±30s) and pointing at its
+  postmortem bundle directory
 
 Pure stdlib — no jax import, runs anywhere the files do.
 
@@ -114,17 +119,29 @@ def load_cache_stats(path: str) -> List[Dict]:
     return _load(path)[4]
 
 
+def load_alert_transitions(path: str) -> List[Dict]:
+    """alert_transition records (telemetry schema >= 13) from a serve
+    log — replica-scope (kind serve) and fleet-scope (kind fleet)."""
+    return _load(path)[5]
+
+
 def _load(path: str):
     if os.path.isdir(path):
         path = os.path.join(path, STREAM_FILENAME)
     if not os.path.exists(path):
         raise FileNotFoundError(f"no serve log at {path}")
-    records, events, fleet, loop, cache = [], [], [], [], []
+    records, events, fleet, loop, cache, alerts = [], [], [], [], [], []
     with open(path) as f:
         for line in f:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                continue
+            # alert transitions ride both kinds: "serve" from the
+            # replica sentinel, "fleet" from the supervisor's
+            # merged-histogram engine (serving/alerts.py)
+            if rec.get("event") == "alert_transition":
+                alerts.append(rec)
                 continue
             if rec.get("kind") == "fleet" \
                     and rec.get("event") in FLEET_EVENTS:
@@ -140,7 +157,7 @@ def _load(path: str):
                 cache.append(rec)
             elif rec.get("event") in RESILIENCE_EVENTS:
                 events.append(rec)
-    return records, events, fleet, loop, cache
+    return records, events, fleet, loop, cache, alerts
 
 
 def _percentile(values: List[float], q: float) -> Optional[float]:
@@ -476,13 +493,15 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
     all_fleet: List[Dict] = []
     loop_per_path: List[List[Dict]] = []
     cache_per_path: List[List[Dict]] = []
+    all_alerts: List[Dict] = []
     for p in paths:
-        records, events, fleet, loop, cache = _load(p)
+        records, events, fleet, loop, cache, alerts = _load(p)
         all_records.extend(records)
         all_events.extend(events)
         all_fleet.extend(fleet)
         loop_per_path.append(loop)
         cache_per_path.append(cache)
+        all_alerts.extend(alerts)
         if len(paths) > 1:
             per_replica[p] = {
                 **latency_summary(records),
@@ -530,9 +549,85 @@ def analyze(paths: List[str], ttft_slo: float = 1.0,
             cache_per_path, out["prefill"], requests=len(all_records))
     if all_fleet:
         out["fleet"] = fleet_summary(all_fleet)
+    if all_alerts:
+        # only on schema >= 13 logs (SLO sentinel, serving/alerts.py)
+        out["incidents"] = incident_summary(all_alerts, all_fleet,
+                                            all_events)
     if per_replica:
         out["replicas"] = per_replica
     return out
+
+
+def incident_summary(transitions: List[Dict], fleet_events: List[Dict],
+                     resilience_events: List[Dict],
+                     correlate_secs: float = 30.0) -> Dict:
+    """Incident lifecycle reconstructed from ``alert_transition``
+    records: each firing opens an incident for its (rule, scope), the
+    next resolved closes it.  Every incident carries the fleet events
+    and engine restarts that happened within ``correlate_secs`` of its
+    window — the "what else was going on" a postmortem starts from."""
+    transitions = sorted(transitions,
+                         key=lambda t: t.get("time_unix") or 0.0)
+    counts = {"firing": 0, "resolved": 0, "pending": 0}
+    open_by_key: Dict[tuple, Dict] = {}
+    incidents: List[Dict] = []
+    for tr in transitions:
+        state = tr.get("state")
+        if state in counts:
+            counts[state] += 1
+        key = (tr.get("rule"), tr.get("scope"))
+        t = tr.get("time_unix")
+        if state == "firing":
+            inc = {
+                "rule": tr.get("rule"),
+                "scope": tr.get("scope"),
+                "severity": tr.get("severity"),
+                "value": tr.get("value"),
+                "threshold": tr.get("threshold"),
+                "start_unix": t,
+                "end_unix": None,
+                "duration_secs": None,
+                "bundle": tr.get("bundle"),
+                "open": True,
+            }
+            open_by_key[key] = inc
+            incidents.append(inc)
+        elif state == "resolved" and key in open_by_key:
+            inc = open_by_key.pop(key)
+            inc["end_unix"] = t
+            inc["open"] = False
+            if isinstance(t, (int, float)) \
+                    and isinstance(inc["start_unix"], (int, float)):
+                inc["duration_secs"] = round(t - inc["start_unix"], 3)
+    # correlate each incident with concurrent fleet/resilience activity
+    context = sorted(
+        (e for e in list(fleet_events) + list(resilience_events)
+         if isinstance(e.get("time_unix"), (int, float))),
+        key=lambda e: e["time_unix"])
+    for inc in incidents:
+        start = inc.get("start_unix")
+        if not isinstance(start, (int, float)):
+            inc["correlated"] = []
+            continue
+        end = inc["end_unix"] if isinstance(inc.get("end_unix"),
+                                            (int, float)) else start
+        near = []
+        for e in context:
+            if start - correlate_secs <= e["time_unix"] \
+                    <= end + correlate_secs:
+                entry = {"event": e.get("event"),
+                         "offset_secs": round(e["time_unix"] - start, 3)}
+                for key in ("slot", "url", "reason", "requeued",
+                            "failed"):
+                    if e.get(key) is not None:
+                        entry[key] = e[key]
+                near.append(entry)
+        inc["correlated"] = near
+    return {
+        "transitions": counts,
+        "incidents": incidents,
+        "unresolved": sum(1 for i in incidents if i["open"]),
+    }
 
 
 def fleet_summary(events: List[Dict]) -> Dict:
@@ -776,6 +871,35 @@ def render(report: Dict) -> str:
                                         "spawn_secs") if k in e)
             lines.append(f"  +{t if t is not None else '?':>9}s "
                          f"{e['event']:<18} {detail}")
+
+    inc = report.get("incidents")
+    if inc:
+        tr = inc["transitions"]
+        lines.append(f"\nincidents: {len(inc['incidents'])} "
+                     f"({inc['unresolved']} unresolved; transitions: "
+                     f"pending {tr['pending']}, firing {tr['firing']}, "
+                     f"resolved {tr['resolved']})")
+        for i in inc["incidents"]:
+            dur = (f"{i['duration_secs']:.1f}s"
+                   if i.get("duration_secs") is not None
+                   else "OPEN")
+            lines.append(
+                f"  [{i.get('severity', '?'):<4}] {i.get('rule')}"
+                f"@{i.get('scope')}  {dur}"
+                + (f"  value {i['value']:.4g}"
+                   f" (threshold {i['threshold']:.4g})"
+                   if isinstance(i.get("value"), (int, float))
+                   and isinstance(i.get("threshold"), (int, float))
+                   else ""))
+            if i.get("bundle"):
+                lines.append(f"         bundle: {i['bundle']}")
+            for e in i.get("correlated", [])[:8]:
+                detail = " ".join(
+                    f"{k}={e[k]}" for k in ("slot", "url", "reason",
+                                            "requeued", "failed")
+                    if k in e)
+                lines.append(f"         {e['offset_secs']:+9.1f}s "
+                             f"{e['event']:<18} {detail}")
 
     for path, s in (report.get("replicas") or {}).items():
         lines.append(f"\nreplica {path} "

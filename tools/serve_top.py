@@ -9,8 +9,11 @@ and frame-windowed), the ghost x10 projected hit rate and evictions/sec
 from the cache observatory (serving/cache_observatory.py), the
 windowed host-tier hit rate and device->host spills/sec from the
 hierarchical KV cache (serving/host_cache.py), the engine-loop ``host
-bubble %`` (serving/loop_profiler.py), engine restarts, and router
-brownout state.
+bubble %`` (serving/loop_profiler.py), engine restarts, router
+brownout state, and ALERT badges from the SLO sentinel
+(serving/alerts.py): per-replica firing rules in the table, the
+fleet-wide union (replica-merged + supervisor fleet scope) in the
+header line.
 
 Stdlib only (no jax, no requests): runs on a laptop against a tunnel,
 like serve_bench / serve_report.
@@ -100,9 +103,16 @@ def _replica_row(name: str, url, snap) -> dict:
         "device_busy_pct": None, "host_bubble_pct": None,
         "loop_stalls": None, "engine_restarts": None,
         "draining": False,
+        "alerts_firing": None, "alert_rules": [],
     }
     if snap is None:
         return row
+    ab = snap.get("alerts")
+    if isinstance(ab, dict) and isinstance(ab.get("firing"), list):
+        rules = [f.get("rule") for f in ab["firing"]
+                 if isinstance(f, dict) and f.get("rule")]
+        row["alerts_firing"] = len(rules)
+        row["alert_rules"] = rules
     row["requests"] = _num(snap, "requests")
     row["tokens_generated"] = _num(snap, "tokens_generated")
     row["ttft_p95_secs"] = (
@@ -189,6 +199,29 @@ def build_snapshot(url: str, metrics: dict) -> dict:
             out["replicas"].append(row)
     else:
         out["replicas"].append(_replica_row("replica_0", url, metrics))
+    # alert rollup (serving/alerts.py): replica alerts fleet-merged by
+    # the router under aggregate.alerts, the supervisor's own fleet-scope
+    # engine under router.fleet.alerts; a bare replica carries its block
+    # at top level.  The ALERT badge unions all of them.
+    firing = []
+    blocks = []
+    if out["source"] == "router":
+        agg = metrics.get("aggregate")
+        if isinstance(agg, dict):
+            blocks.append(agg.get("alerts"))
+        fl = (metrics.get("router") or {}).get("fleet")
+        if isinstance(fl, dict):
+            blocks.append(fl.get("alerts"))
+    else:
+        blocks.append(metrics.get("alerts"))
+    for ab in blocks:
+        if isinstance(ab, dict) and isinstance(ab.get("firing"), list):
+            for f in ab["firing"]:
+                if isinstance(f, dict) and f.get("rule"):
+                    firing.append({"rule": f.get("rule"),
+                                   "scope": f.get("scope"),
+                                   "severity": f.get("severity")})
+    out["alerts"] = {"firing": firing, "firing_count": len(firing)}
     alive = [r for r in out["replicas"] if r["alive"]]
     out["fleet"] = {
         "replicas_total": len(out["replicas"]),
@@ -283,6 +316,7 @@ COLUMNS = (
     ("bubble%", 8, "host_bubble_pct", ".1f"),
     ("stalls", 7, "loop_stalls", "d"),
     ("restarts", 8, "engine_restarts", "d"),
+    ("alerts", 16, None, ""),
 )
 
 
@@ -301,6 +335,12 @@ def render(snapshot: dict) -> str:
         if r["brownout_active"]:
             head += (f"  BROWNOUT "
                      f"({_fmt(r['brownout_remaining_secs'], '.1f')}s)")
+    al = snapshot.get("alerts") or {}
+    if al.get("firing_count"):
+        rules = sorted({f["rule"] for f in al["firing"]})
+        head += (f"  ALERT[{al['firing_count']}] "
+                 + ",".join(rules[:4])
+                 + ("…" if len(rules) > 4 else ""))
     head += (f"  fleet {_fmt(fleet['tokens_per_sec'], '.1f')} tok/s"
              f"  {time.strftime('%H:%M:%S')}")
     lines.append(head)
@@ -312,6 +352,9 @@ def render(snapshot: dict) -> str:
             if h == "up":
                 v = ("DRAIN" if row["draining"]
                      else "up" if row["alive"] else "DOWN")
+            elif h == "alerts":
+                v = (",".join(row["alert_rules"])[:15]
+                     if row["alert_rules"] else "-")
             elif h in ("hit%", "whit%", "g10%", "hhit%"):
                 hr = row[{"hit%": "cache_hit_rate",
                           "whit%": "cache_hit_rate_window",

@@ -167,6 +167,23 @@ MANIFEST: List[Step] = [
          "python -m pytest tests/test_host_cache.py "
          "-m slow -k host_cache_overhead -q -p no:cacheprovider",
          900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+    # SLO sentinel chaos e2e: a 2-replica fleet behind a router, faults
+    # injected into one replica until its alert fires — asserts the
+    # firing state agrees across /metrics (replica + fleet-merged),
+    # the schema-13 alert_transition JSONL, and serve_top; the
+    # postmortem bundle is on disk and readable; the incident resolves
+    # after the watchdog restart heals the replica
+    Step("serve_alert_chaos",
+         "python -m pytest tests/test_alerts.py "
+         "-m chaos -k alert_chaos -q -p no:cacheprovider",
+         1200, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
+    # alert evaluator overhead gate: one full rule-set evaluation over
+    # a live metrics snapshot must stay under 2% of a measured CPU
+    # dispatch — the sentinel may not become the incident it watches for
+    Step("serve_alert_overhead",
+         "python -m pytest tests/test_alerts.py "
+         "-m slow -k alert_overhead -q -p no:cacheprovider",
+         900, wave=2, needs_tpu=False, env=dict(CPU_MESH_ENV)),
 ]
 
 
